@@ -1,0 +1,71 @@
+"""Typed configuration for the framework.
+
+Replaces the reference's three config mechanisms — positional argv, zlib-pickled
+``GlobSettings.zpkl``/``ModelDataPaths.zpkl`` dicts, and hardcoded constants
+(reference: src/solver/pcg_solver.py:113-139, examples/run_basic_script.bash:30-49)
+— with one set of dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass
+class SolverConfig:
+    """PCG solver parameters (reference SolverParam, pcg_solver.py:131-132)."""
+
+    tol: float = 1e-7
+    max_iter: int = 10000
+    # Numerical precision of the solve.  The reference is float64 throughout;
+    # on TPU f64 is emulated and slow, so f32 storage with f64 dot-product
+    # accumulation is the default performance path.
+    dtype: str = "float64"        # storage dtype: "float32" | "float64"
+    dot_dtype: str = "float64"    # accumulation dtype for reductions
+    # MATLAB-pcg compatibility knobs (pcg_solver.py:399-404)
+    max_stag_steps: int = 3
+
+
+@dataclasses.dataclass
+class TimeHistoryConfig:
+    """Quasi-static time stepping + export settings.
+
+    Mirrors the reference TimeHistoryParam (run_basic_script.bash:34-39).
+    ``time_step_delta[t]`` scales both the prescribed displacement ``Ud`` and
+    the reference load ``F`` at step t (Dirichlet lifting, pcg_solver.py:226-238).
+    """
+
+    time_step_delta: Sequence[float] = (0.0, 1.0)
+    export_flag: bool = True
+    export_frame_rate: int = 1
+    export_frames: Sequence[int] = ()
+    plot_flag: bool = False
+    export_vars: str = "U"   # subset of "U D ES PS PE PS1..PS3 PE1..PE3"
+    dt: float = 1.0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Top-level run description (paths + partitioning + solver)."""
+
+    scratch_path: str = "./scratch"
+    model_name: str = "model"
+    run_id: str = "1"
+    n_parts: int = 1
+    speed_test: bool = False
+    solver: SolverConfig = dataclasses.field(default_factory=SolverConfig)
+    time_history: TimeHistoryConfig = dataclasses.field(default_factory=TimeHistoryConfig)
+
+    @property
+    def result_path(self) -> str:
+        suffix = "_SpeedTest" if self.speed_test else ""
+        return f"{self.scratch_path}/Results_Run{self.run_id}{suffix}"
+
+    @property
+    def res_vec_path(self) -> str:
+        return f"{self.result_path}/ResVecData"
+
+    @property
+    def plot_path(self) -> str:
+        return f"{self.result_path}/PlotData"

@@ -1,0 +1,111 @@
+"""In-memory global model representation (host-side, numpy).
+
+Plays the role of the reference's on-disk Model Definition Files (MDF) bundle
+— the 12 per-element binary arrays + 7 nodal arrays + ``Ke.mat``/``Me.mat``
+element library + ``GlobN.mat`` counts (schema at partition_mesh.py:172-175,
+324-330; counts at run_metis.py:19-38) — as one typed object.  Produced either
+by the synthetic generator (models/synthetic.py) or by the MDF reader
+(models/mdf.py) for models exported in the reference's format.
+
+Element connectivity is CSR-style (flat + offsets) exactly because octree
+pattern types have differing node counts; dof ids and sign flags are stored
+per element-dof (the sign encodes mirrored-pattern reflection: the matvec is
+S.Ke.(S.u) with S = diag(+-1), pcg_solver.py:277-280).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ModelData:
+    # Counts
+    n_elem: int
+    n_node: int
+    n_dof: int
+
+    # Nodal data
+    node_coords: np.ndarray        # (n_node, 3) float64
+    F: np.ndarray                  # (n_dof,) reference load vector
+    Ud: np.ndarray                 # (n_dof,) prescribed displacement (Dirichlet values)
+    Vd: np.ndarray                 # (n_dof,) prescribed velocity (dynamics; zeros here)
+    diag_M: np.ndarray             # (n_dof,) lumped mass diagonal
+    fixed_dof: np.ndarray          # (n_fixed,) int — Dirichlet-constrained dof ids
+    dof_eff: np.ndarray            # (n_eff,) int — effective (free) dof ids
+
+    # Per-element data (CSR-style ragged)
+    elem_type: np.ndarray          # (n_elem,) int32 pattern-type id
+    elem_nodes_flat: np.ndarray    # (sum nnodes,) int
+    elem_nodes_offset: np.ndarray  # (n_elem+1,) int
+    elem_dofs_flat: np.ndarray     # (sum ndofs,) int
+    elem_dofs_offset: np.ndarray   # (n_elem+1,) int
+    elem_sign_flat: np.ndarray     # (sum ndofs,) bool — reflection sign per elem-dof
+    ck: np.ndarray                 # (n_elem,) stiffness scale  (= E*h)
+    cm: np.ndarray                 # (n_elem,) mass scale       (= rho*h^3)
+    ce: np.ndarray                 # (n_elem,) strain scale     (= 1/h)
+    level: np.ndarray              # (n_elem,) cell size h
+    poly_mat: np.ndarray           # (n_elem,) int material id
+    sctrs: np.ndarray              # (n_elem, 3) element centroids
+
+    # Element library: type id -> {'Ke','Me','Se','diagKe','n_nodes'}
+    elem_lib: Dict[int, dict]
+
+    # Materials: list of {'E','Pos','Rho'}
+    mat_prop: List[dict]
+
+    # Time step (dynamics era; quasi-statics uses it only for TimeList labels)
+    dt: float = 1.0
+
+    # Optional visualization topology (boundary faces of the mesh)
+    faces_flat: Optional[np.ndarray] = None    # (sum face nnodes,)
+    faces_offset: Optional[np.ndarray] = None  # (n_faces+1,)
+
+    def elem_nodes(self, e: int) -> np.ndarray:
+        return self.elem_nodes_flat[self.elem_nodes_offset[e]:self.elem_nodes_offset[e + 1]]
+
+    def elem_dofs(self, e: int) -> np.ndarray:
+        return self.elem_dofs_flat[self.elem_dofs_offset[e]:self.elem_dofs_offset[e + 1]]
+
+    def elem_signs(self, e: int) -> np.ndarray:
+        return self.elem_sign_flat[self.elem_dofs_offset[e]:self.elem_dofs_offset[e + 1]]
+
+    # ------------------------------------------------------------------
+    # Validation helpers (test oracle): dense/sparse global assembly.
+    # ------------------------------------------------------------------
+    def assemble_csr(self):
+        """Assemble the global stiffness K as scipy CSR (small models only).
+
+        The matrix the matrix-free path must reproduce:
+        K = sum_e  P_e^T S_e (ck_e * Ke_type) S_e P_e.
+        """
+        from scipy.sparse import coo_matrix
+
+        rows, cols, vals = [], [], []
+        for e in range(self.n_elem):
+            dofs = self.elem_dofs(e)
+            signs = self.elem_signs(e)
+            Ke = self.elem_lib[int(self.elem_type[e])]["Ke"]
+            s = np.where(signs, -1.0, 1.0)
+            Ke_e = self.ck[e] * (s[:, None] * Ke * s[None, :])
+            d = len(dofs)
+            rows.append(np.repeat(dofs, d))
+            cols.append(np.tile(dofs, d))
+            vals.append(Ke_e.ravel())
+        K = coo_matrix(
+            (np.concatenate(vals), (np.concatenate(rows), np.concatenate(cols))),
+            shape=(self.n_dof, self.n_dof),
+        )
+        return K.tocsr()
+
+    def assemble_diag(self) -> np.ndarray:
+        """Diagonal of K (Jacobi preconditioner oracle, pcg_solver.py:282-287)."""
+        diag = np.zeros(self.n_dof)
+        for e in range(self.n_elem):
+            dofs = self.elem_dofs(e)
+            dK = self.elem_lib[int(self.elem_type[e])]["diagKe"]
+            np.add.at(diag, dofs, self.ck[e] * dK)
+        return diag

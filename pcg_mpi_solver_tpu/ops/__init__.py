@@ -1,0 +1,3 @@
+from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+
+__all__ = ["Ops", "device_data"]

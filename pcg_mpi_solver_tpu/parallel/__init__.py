@@ -1,0 +1,4 @@
+from pcg_mpi_solver_tpu.parallel.partition import PartitionedModel, partition_model
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh, PARTS_AXIS
+
+__all__ = ["PartitionedModel", "partition_model", "make_mesh", "PARTS_AXIS"]

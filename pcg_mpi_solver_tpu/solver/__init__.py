@@ -1,0 +1,4 @@
+from pcg_mpi_solver_tpu.solver.pcg import pcg, PCGResult
+from pcg_mpi_solver_tpu.solver.driver import Solver, StepResult
+
+__all__ = ["pcg", "PCGResult", "Solver", "StepResult"]

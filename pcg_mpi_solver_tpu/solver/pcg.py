@@ -1,0 +1,214 @@
+"""MATLAB-``pcg``-compatible preconditioned conjugate gradients, fully
+in-graph.
+
+Re-implements the reference's PCG (pcg_solver.py:356-598) — itself a
+line-for-line port of MATLAB ``pcg`` semantics — as a single
+``lax.while_loop``: iterations never leave the device, and every decision the
+reference takes on the host (breakdown flags, stagnation, the extra
+true-residual matvec on candidate convergence, minimal-residual fallback) is
+traced control flow.
+
+Flags (reference pcg_solver.py:399,449,467-469,492-498,560-562):
+  0 converged; 1 max-iterations; 2 inf preconditioner; 3 stagnation /
+  tolerance too small; 4 rho/pq breakdown.
+
+Per iteration: 3 scalar/fused psums + 1 interface-assembly psum inside the
+matvec — the same communication count as the reference's 3 allreduces + 1
+halo exchange (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pcg_mpi_solver_tpu.ops.matvec import Ops
+
+
+class PCGResult(NamedTuple):
+    x: jnp.ndarray        # (P, n_loc) solution on effective dofs (0 elsewhere)
+    flag: jnp.ndarray     # () int32
+    relres: jnp.ndarray   # () float
+    iters: jnp.ndarray    # () int32  (1-based, MATLAB-compatible)
+
+
+def pcg(
+    ops: Ops,
+    data: dict,
+    fext: jnp.ndarray,        # (P, n_loc) rhs, already restricted to eff dofs
+    x0: jnp.ndarray,          # (P, n_loc) initial guess (eff-restricted)
+    inv_diag: jnp.ndarray,    # (P, n_loc) Jacobi M^-1 on eff dofs (0 elsewhere)
+    tol: float,
+    max_iter: int,
+    glob_n_dof_eff: int,
+    max_stag_steps: int = 3,
+) -> PCGResult:
+    eff = data["eff"]
+    w = data["weight"] * eff
+    dt = fext.dtype
+    eps = jnp.asarray(np.finfo(np.dtype(dt)).eps, ops.dot_dtype)
+
+    # MATLAB: maxmsteps = min([floor(n/50), 5, n-maxit])
+    maxmsteps = min(glob_n_dof_eff // 50, 5, glob_n_dof_eff - max_iter)
+
+    n2b = jnp.sqrt(ops.wdot(w, fext, fext))
+    tolb = tol * n2b
+
+    def amul(v):
+        """Assembled K.v restricted to effective dofs (reference computes the
+        full product then slices to LocDofEff, pcg_solver.py:482-484)."""
+        return eff * ops.matvec(data, v)
+
+    r0 = fext - amul(x0)
+    normr0 = jnp.sqrt(ops.wdot(w, r0, r0))
+
+    zero_rhs = n2b == 0
+    initial_ok = normr0 <= tolb
+
+    carry0 = dict(
+        x=x0,
+        r=r0,
+        p=jnp.zeros_like(x0),
+        rho=jnp.asarray(1.0, ops.dot_dtype),
+        i=jnp.asarray(0, jnp.int32),
+        # zero rhs => skip the loop entirely (reference early-returns,
+        # pcg_solver.py:387-395); the outputs are forced to zero below.
+        flag=jnp.where(zero_rhs | initial_ok, 0, 1).astype(jnp.int32),
+        stag=jnp.asarray(0, jnp.int32),
+        moresteps=jnp.asarray(0, jnp.int32),
+        iter_out=jnp.asarray(0, jnp.int32),
+        normr_act=normr0.astype(ops.dot_dtype),
+        normrmin=normr0.astype(ops.dot_dtype),
+        xmin=x0,
+        imin=jnp.asarray(0, jnp.int32),
+    )
+
+    def cond(c):
+        return (c["flag"] == 1) & (c["i"] < max_iter)
+
+    def body(c):
+        i = c["i"]
+        z = inv_diag * c["r"]
+
+        # The inf-preconditioner predicate must agree across shards or the
+        # while_loop exits divergently and collective counts desync; fuse its
+        # global reduction into the rho psum (still one collective).
+        inf_loc = jnp.any(jnp.isinf(z)).astype(ops.dot_dtype)
+        red = ops.wdots(w, [(z, c["r"])], extra=[inf_loc])
+        rho, flag2 = red[0], red[1] > 0
+        bad_rho = (rho == 0) | jnp.isinf(rho)
+
+        beta = (rho / c["rho"]).astype(dt)
+        bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
+        p = jnp.where(i == 0, z, z + beta * c["p"])
+
+        q = amul(p)
+        pq = ops.wdot(w, p, q)
+        bad_pq = (pq <= 0) | jnp.isinf(pq)
+        alpha = (rho / pq).astype(dt)
+        bad_alpha = jnp.isinf(alpha)
+
+        breakdown = bad_rho | bad_beta | bad_pq | bad_alpha
+        new_flag = jnp.where(flag2, 2, jnp.where(breakdown, 4, 1)).astype(jnp.int32)
+
+        def on_break(c):
+            out = dict(c)
+            out["flag"] = new_flag
+            out["iter_out"] = i
+            out["rho"] = rho
+            return out
+
+        def on_continue(c):
+            r = c["r"] - alpha * q
+            # Fused 3-norm reduction: ||p||, ||x_old||, ||r|| in ONE psum
+            # (reference pcg_solver.py:504-507).
+            sq = ops.wdots(w, [(p, p), (c["x"], c["x"]), (r, r)])
+            normp, normx, normr = jnp.sqrt(sq[0]), jnp.sqrt(sq[1]), jnp.sqrt(sq[2])
+            stag = jnp.where(normp * jnp.abs(alpha).astype(ops.dot_dtype) < eps * normx,
+                             c["stag"] + 1, 0).astype(jnp.int32)
+            x = c["x"] + alpha * p
+
+            candidate = (normr <= tolb) | (stag >= max_stag_steps) | (c["moresteps"] > 0)
+
+            def check_true(args):
+                x, r = args
+                # Recompute the ACTUAL residual with an extra matvec before
+                # declaring convergence (reference pcg_solver.py:527-533).
+                r_true = fext - amul(x)
+                normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
+                return r_true, normr_act
+
+            def no_check(args):
+                x, r = args
+                return r, normr.astype(ops.dot_dtype)
+
+            r, normr_act = jax.lax.cond(candidate, check_true, no_check, (x, r))
+
+            converged = candidate & (normr_act <= tolb)
+            # not converged on candidate: stag reset + MoreSteps bookkeeping
+            # (reference pcg_solver.py:544-552)
+            stag = jnp.where(candidate & ~converged
+                             & (stag >= max_stag_steps) & (c["moresteps"] == 0),
+                             0, stag)
+            moresteps = jnp.where(candidate & ~converged,
+                                  c["moresteps"] + 1, c["moresteps"]).astype(jnp.int32)
+            toosmall = candidate & ~converged & (moresteps >= maxmsteps)
+
+            # minimal-residual iterate bookkeeping (pcg_solver.py:554-558)
+            better = normr_act < c["normrmin"]
+            normrmin = jnp.where(better, normr_act, c["normrmin"])
+            xmin = jnp.where(better, x, c["xmin"])
+            imin = jnp.where(better, i, c["imin"])
+
+            stagnated = (stag >= max_stag_steps) & ~converged & ~toosmall
+
+            flag = jnp.where(converged, 0,
+                    jnp.where(toosmall | stagnated, 3, 1)).astype(jnp.int32)
+            stop = flag != 1
+            return dict(
+                x=x, r=r, p=p, rho=rho,
+                i=jnp.where(stop, i, i + 1).astype(jnp.int32),
+                flag=flag, stag=stag, moresteps=moresteps,
+                iter_out=i,
+                normr_act=normr_act, normrmin=normrmin, xmin=xmin, imin=imin,
+            )
+
+        return jax.lax.cond(flag2 | breakdown, on_break, on_continue, c)
+
+    c = jax.lax.while_loop(cond, body, carry0)
+
+    # ---- finalize (reference pcg_solver.py:566-584): on any non-converged
+    # exit return the minimal-residual iterate (MATLAB pcg semantics).
+    def finalize_ok(c):
+        relres = c["normr_act"] / n2b
+        return c["x"], relres, c["iter_out"]
+
+    def finalize_bad(c):
+        # MATLAB pcg: on failure return whichever of (last iterate, minimal-
+        # residual iterate) actually has the smaller true residual, with
+        # matching relres/iters.  (The reference accidentally always returns
+        # XMin while reporting the better residual, pcg_solver.py:566-582 —
+        # we keep x consistent with the reported numbers instead.)
+        r_min = fext - amul(c["xmin"])
+        normr_min = jnp.sqrt(ops.wdot(w, r_min, r_min))
+        use_min = normr_min < c["normr_act"]
+        relres = jnp.where(use_min, normr_min, c["normr_act"]) / n2b
+        iters = jnp.where(use_min, c["imin"], c["iter_out"])
+        x = jnp.where(use_min, c["xmin"], c["x"])
+        return x, relres, iters
+
+    x, relres, iters = jax.lax.cond(c["flag"] == 0, finalize_ok, finalize_bad, c)
+
+    # all-zero rhs => all-zero solution (reference pcg_solver.py:387-395)
+    x = jnp.where(zero_rhs, jnp.zeros_like(x), x)
+    relres = jnp.where(zero_rhs, 0.0, relres)
+    # +1 makes the count 1-based (MATLAB-compatible, pcg_solver.py:584);
+    # the two pre-loop early exits report 0 (pcg_solver.py:392,424).
+    iters = jnp.where(zero_rhs | initial_ok, 0, iters + 1)
+    flag = jnp.where(zero_rhs, 0, c["flag"]).astype(jnp.int32)
+
+    return PCGResult(x=x, flag=flag, relres=relres.astype(jnp.float32), iters=iters)
