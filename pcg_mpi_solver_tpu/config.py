@@ -18,11 +18,16 @@ class SolverConfig:
 
     tol: float = 1e-7
     max_iter: int = 10000
-    # Numerical precision of the solve.  The reference is float64 throughout;
-    # on TPU f64 is emulated and slow, so f32 storage with f64 dot-product
-    # accumulation is the default performance path.
+    # Numerical precision of the solve.  The reference is float64 throughout.
+    # precision_mode:
+    #   "direct" — one PCG in `dtype` (use float64 for reference parity);
+    #   "mixed"  — f32 Krylov iterations + f64 iterative-refinement restarts:
+    #              reaches f64-grade residuals at f32/MXU speed (the TPU
+    #              performance path).
+    precision_mode: str = "direct"
     dtype: str = "float64"        # storage dtype: "float32" | "float64"
     dot_dtype: str = "float64"    # accumulation dtype for reductions
+    inner_tol: float = 1e-5       # per-refinement-cycle residual reduction (mixed)
     # MATLAB-pcg compatibility knobs (pcg_solver.py:399-404)
     max_stag_steps: int = 3
 

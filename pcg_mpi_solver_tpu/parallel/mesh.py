@@ -20,6 +20,9 @@ def make_mesh(n_devices: int | None = None, devices=None) -> jax.sharding.Mesh:
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"requested {n_devices} devices, only {len(devices)} available")
             devices = devices[:n_devices]
     return jax.sharding.Mesh(np.array(devices), (PARTS_AXIS,))
 

@@ -24,7 +24,7 @@ from pcg_mpi_solver_tpu.models.model_data import ModelData
 from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
 from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
 from pcg_mpi_solver_tpu.parallel.partition import PartitionedModel, partition_model
-from pcg_mpi_solver_tpu.solver.pcg import pcg
+from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_mixed
 
 
 @dataclasses.dataclass
@@ -56,18 +56,32 @@ class Solver:
         if n_parts % n_dev != 0:
             raise ValueError(f"n_parts={n_parts} must be a multiple of device count {n_dev}")
 
-        dtype = jnp.dtype(self.config.solver.dtype)
-        dot_dtype = jnp.dtype(self.config.solver.dot_dtype)
-        if jnp.float64 in (dtype, dot_dtype) and not jax.config.jax_enable_x64:
-            # The config asked for f64 math — honor it rather than silently
-            # downgrading (the reference is f64 throughout).
-            jax.config.update("jax_enable_x64", True)
+        solver_cfg = self.config.solver
+        self.mixed = solver_cfg.precision_mode == "mixed"
+        dtype = jnp.dtype(jnp.float64) if self.mixed else jnp.dtype(solver_cfg.dtype)
+        dot_dtype = jnp.dtype(solver_cfg.dot_dtype)
+        if self.mixed or jnp.float64 in (dtype, dot_dtype):
+            if not jax.config.jax_enable_x64:
+                # The config asked for f64 math — honor it rather than
+                # silently downgrading (the reference is f64 throughout).
+                jax.config.update("jax_enable_x64", True)
         self.dtype = dtype
 
         self.pm: PartitionedModel = partition_model(model, n_parts, elem_part=elem_part)
         self.ops = Ops.from_model(self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS)
 
         data = device_data(self.pm, dtype)
+        if self.mixed:
+            # f32 shadow of the float leaves; index/bool arrays are shared
+            # (same device buffers), so the extra memory is only the f32 floats.
+            data = {
+                "f64": data,
+                "f32": jax.tree.map(
+                    lambda x: x.astype(jnp.float32)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, data),
+            }
+            self.ops32 = Ops.from_model(self.pm, dot_dtype=jnp.float32,
+                                        axis_name=PARTS_AXIS)
         self._specs = _data_specs(data)
         self.data = jax.device_put(
             data, jax.tree.map(lambda s: jax.NamedSharding(self.mesh, s), self._specs,
@@ -77,26 +91,40 @@ class Solver:
         self._part_spec = jax.sharding.PartitionSpec(PARTS_AXIS)
         self._rep_spec = jax.sharding.PartitionSpec()
 
-        solver_cfg = self.config.solver
         glob_n_eff = self.pm.glob_n_dof_eff
 
         def _step(data, un_prev, delta):
-            eff = data["eff"]
+            data64 = data["f64"] if self.mixed else data
+            eff = data64["eff"]
             # Dirichlet lifting: Fext = F*delta - K.(Ud*delta)
             # (reference updateBC, pcg_solver.py:226-238)
-            udi = data["Ud"] * delta
-            fdi = self.ops.matvec(data, udi)
-            fext = eff * (data["F"] * delta - fdi)
-            # Jacobi preconditioner rebuild (pcg_solver.py:346-352)
-            diag_k = self.ops.diag(data)
-            inv_diag = jnp.where(eff > 0, 1.0 / diag_k, 0.0)
+            udi = data64["Ud"] * delta
+            fdi = self.ops.matvec(data64, udi)
+            fext = eff * (data64["F"] * delta - fdi)
             x0 = eff * un_prev
-            res = pcg(
-                self.ops, data, fext, x0, inv_diag,
-                tol=solver_cfg.tol, max_iter=solver_cfg.max_iter,
-                glob_n_dof_eff=glob_n_eff,
-                max_stag_steps=solver_cfg.max_stag_steps,
-            )
+            if self.mixed:
+                data32 = data["f32"]
+                # Jacobi rebuild in f32 (pcg_solver.py:346-352)
+                diag32 = self.ops32.diag(data32)
+                inv_diag32 = jnp.where(data32["eff"] > 0, 1.0 / diag32, 0.0)
+                res = pcg_mixed(
+                    self.ops32, data32, self.ops, data64,
+                    fext, x0, inv_diag32,
+                    tol=solver_cfg.tol, max_iter=solver_cfg.max_iter,
+                    glob_n_dof_eff=glob_n_eff,
+                    max_stag_steps=solver_cfg.max_stag_steps,
+                    inner_tol=solver_cfg.inner_tol,
+                )
+            else:
+                # Jacobi preconditioner rebuild (pcg_solver.py:346-352)
+                diag_k = self.ops.diag(data64)
+                inv_diag = jnp.where(eff > 0, 1.0 / diag_k, 0.0)
+                res = pcg(
+                    self.ops, data64, fext, x0, inv_diag,
+                    tol=solver_cfg.tol, max_iter=solver_cfg.max_iter,
+                    glob_n_dof_eff=glob_n_eff,
+                    max_stag_steps=solver_cfg.max_stag_steps,
+                )
             un = res.x + udi
             return un, res.flag, res.relres, res.iters
 
@@ -124,14 +152,25 @@ class Solver:
         self.step_times: List[float] = []
 
     # ------------------------------------------------------------------
+    def reset_state(self):
+        """Zero the solution, preserving its device sharding (avoids a
+        silent retrace on the next step)."""
+        self.un = jax.device_put(
+            jnp.zeros((self.pm.n_parts, self.pm.n_loc), self.dtype),
+            jax.NamedSharding(self.mesh, self._part_spec),
+        )
+
     def step(self, delta: float) -> StepResult:
         t0 = time.perf_counter()
         un, flag, relres, iters = self._step_fn(
             self.data, self.un, jnp.asarray(delta, self.dtype))
-        jax.block_until_ready(un)
+        # Force a value transfer INSIDE the timed region: on tunneled devices
+        # block_until_ready can ack before execution finishes; fetching the
+        # scalars can't.
+        flag, relres, iters = int(flag), float(relres), int(iters)
         wall = time.perf_counter() - t0
         self.un = un
-        res = StepResult(int(flag), float(relres), int(iters), wall)
+        res = StepResult(flag, relres, iters, wall)
         self.flags.append(res.flag)
         self.relres.append(res.relres)
         self.iters.append(res.iters)
@@ -177,14 +216,20 @@ class Solver:
         return out
 
 
-def _data_specs(data: dict):
+_REPLICATED_KEYS = frozenset({"Ke", "diag_Ke", "Me", "Se"})
+
+
+def _data_specs(data):
     """PartitionSpec pytree for the device data: per-type constant matrices
     are replicated, everything else is sharded on the leading parts axis."""
     P = jax.sharding.PartitionSpec
-    blocks = [
-        {k: (P() if k in ("Ke", "diag_Ke") else P(PARTS_AXIS)) for k in blk}
-        for blk in data["blocks"]
-    ]
-    specs = {k: P(PARTS_AXIS) for k in data if k != "blocks"}
-    specs["blocks"] = blocks
-    return specs
+
+    def rec(node):
+        if isinstance(node, dict):
+            return {k: (P() if k in _REPLICATED_KEYS else rec(v))
+                    for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(v) for v in node)
+        return P(PARTS_AXIS)
+
+    return rec(data)
