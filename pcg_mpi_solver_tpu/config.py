@@ -48,6 +48,9 @@ class TimeHistoryConfig:
     plot_flag: bool = False
     export_vars: str = "U"   # subset of "U D ES PS PE PS1..PS3 PE1..PE3"
     dt: float = 1.0
+    # Probe dofs sampled every step into PlotData (reference RefPlotDofVec,
+    # partition_mesh.py:142 + pcg_solver.py:817-838)
+    probe_dofs: Sequence[int] = ()
 
 
 @dataclasses.dataclass

@@ -64,6 +64,11 @@ class ModelData:
     faces_flat: Optional[np.ndarray] = None    # (sum face nnodes,)
     faces_offset: Optional[np.ndarray] = None  # (n_faces+1,)
 
+    # Structured-grid metadata (nx, ny, nz, h) when the mesh is a single
+    # uniform block — unlocks the slice-based TPU fast path
+    # (parallel/structured.py); None for general octree/unstructured models.
+    grid: Optional[tuple] = None
+
     def elem_nodes(self, e: int) -> np.ndarray:
         return self.elem_nodes_flat[self.elem_nodes_offset[e]:self.elem_nodes_offset[e + 1]]
 

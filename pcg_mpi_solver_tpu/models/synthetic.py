@@ -161,6 +161,7 @@ def make_cube_model(
         dt=1.0,
         faces_flat=faces.ravel(),
         faces_offset=np.arange(len(faces) + 1) * 4,
+        grid=(nx, ny, nz, h) if n_types == 1 else None,
     )
 
 

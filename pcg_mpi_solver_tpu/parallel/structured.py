@@ -1,0 +1,253 @@
+"""Structured-block fast path: gather/scatter-free matvec + ppermute halos.
+
+TPU hardware has no vector gather/scatter — XLA lowers arbitrary indexed
+reads/writes to near-serial code (measured ~28 ms/iter at 160k dofs vs
+~0.4 ms for all dense work).  The TPU-native answer for the reference's
+problem class: octree meshes are (collections of) structured blocks, and on a
+structured block the element gather is EIGHT CONTIGUOUS SLICES of the
+displacement grid and the scatter-add is eight shifted slice-adds — pure
+dense memory traffic, with the per-cell ``ck`` heterogeneity kept as a cell
+grid.  The element matmul stays the same (24x24) MXU einsum.
+
+Domain decomposition: 1-D slabs along x, one slab per device.  Neighboring
+slabs share one node plane; after the local matvec the two copies of a shared
+plane hold partial sums which are combined by a single bidirectional
+``lax.ppermute`` of boundary planes over the mesh axis — the direct analogue
+of the reference's neighbor Isend/Recv halo exchange (pcg_solver.py:317-334)
+riding ICI.
+
+The vector/weight/eff/dot machinery and the whole PCG stack are shared with
+the general unstructured path through the same ops protocol; only
+matvec/diag/assembly differ.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pcg_mpi_solver_tpu.models.element import HEX_CORNERS
+from pcg_mpi_solver_tpu.models.model_data import ModelData
+from pcg_mpi_solver_tpu.ops.matvec import Ops
+
+
+@dataclasses.dataclass
+class StructuredPartition:
+    """Slab decomposition of a structured cube model (duck-compatible with
+    the PartitionedModel fields the driver/export layer uses)."""
+
+    n_parts: int
+    n_loc: int                  # 3 * nxn_loc * nny * nnz
+    n_iface: int                # unused (halo via ppermute); kept for protocol
+    glob_n_dof: int
+    glob_n_dof_eff: int
+    glob_n_node: int
+    nxc: int                    # local cells along x (same for every part)
+    ny: int
+    nz: int
+
+    ck: np.ndarray              # (P, nxc, ny, nz) cell stiffness scale
+    Ke: np.ndarray              # (24, 24)
+    diag_Ke: np.ndarray         # (24,)
+    weight: np.ndarray          # (P, n_loc)
+    eff: np.ndarray             # (P, n_loc)
+    F: np.ndarray               # (P, n_loc)
+    Ud: np.ndarray              # (P, n_loc)
+    dof_gid: np.ndarray         # (P, n_loc) int64
+    ndof_p: np.ndarray          # (P,)
+
+
+def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
+    """Slab-partition a structured cube model (requires model.grid set and
+    nx % n_parts == 0)."""
+    if model.grid is None:
+        raise ValueError("model has no structured-grid metadata")
+    nx, ny, nz, _h = model.grid
+    if nx % n_parts != 0:
+        raise ValueError(f"nx={nx} not divisible by n_parts={n_parts}")
+    if len(model.elem_lib) != 1 or 0 not in model.elem_lib:
+        raise ValueError("structured path expects the single-type cube library")
+
+    P = n_parts
+    nxc = nx // P
+    nxn = nxc + 1
+    nny, nnz = ny + 1, nz + 1
+    n_loc = 3 * nxn * nny * nnz
+
+    lib = model.elem_lib[0]
+
+    # cell ck grid: global element id = ex + nx*(ey + ny*ez)  (x fastest)
+    ck_glob = np.asarray(model.ck).reshape(nz, ny, nx).transpose(2, 1, 0)  # (nx,ny,nz)
+    ck = np.stack([ck_glob[p * nxc:(p + 1) * nxc] for p in range(P)])
+
+    # local node (ix,iy,iz) [x-major local layout] -> global dof ids
+    nnx = nx + 1
+    weight = np.zeros((P, n_loc))
+    eff = np.zeros((P, n_loc))
+    F = np.zeros((P, n_loc))
+    Ud = np.zeros((P, n_loc))
+    dof_gid = np.zeros((P, n_loc), dtype=np.int64)
+
+    eff_mask_glob = np.zeros(model.n_dof, dtype=bool)
+    eff_mask_glob[model.dof_eff] = True
+
+    ix = np.arange(nxn)
+    iy = np.arange(nny)
+    iz = np.arange(nnz)
+    IX, IY, IZ = np.meshgrid(ix, iy, iz, indexing="ij")
+    for p in range(P):
+        gnode = (IX + p * nxc) + nnx * (IY + nny * IZ)          # (nxn,nny,nnz)
+        gdof = (3 * gnode[..., None] + np.arange(3)).transpose(3, 0, 1, 2)
+        # local flat layout: (c, ix, iy, iz) row-major
+        g = gdof.reshape(-1)
+        dof_gid[p] = g
+        F[p] = model.F[g]
+        Ud[p] = model.Ud[g]
+        eff[p] = eff_mask_glob[g].astype(float)
+    # ownership: the lowest part containing a dof keeps weight 1 (same rule
+    # as the unstructured path / reference partition_mesh.py:885-887) — a
+    # shared plane belongs to the lower slab, so zero the lower plane of
+    # every part except the first.
+    weight = np.ones((P, 3, nxn, nny, nnz))
+    weight[1:, :, 0] = 0.0
+    weight = weight.reshape(P, n_loc)
+
+    return StructuredPartition(
+        n_parts=P,
+        n_loc=n_loc,
+        n_iface=0,
+        glob_n_dof=model.n_dof,
+        glob_n_dof_eff=len(model.dof_eff),
+        glob_n_node=model.n_node,
+        nxc=nxc, ny=ny, nz=nz,
+        ck=ck,
+        Ke=np.asarray(lib["Ke"], np.float64),
+        diag_Ke=np.asarray(lib["diagKe"], np.float64),
+        weight=weight,
+        eff=eff,
+        F=F,
+        Ud=Ud,
+        dof_gid=dof_gid,
+        ndof_p=np.full(P, n_loc),
+    )
+
+
+def device_data_structured(sp: StructuredPartition, dtype=jnp.float64) -> dict:
+    return {
+        "blocks": [{
+            "Ke": jnp.asarray(sp.Ke, dtype),
+            "diag_Ke": jnp.asarray(sp.diag_Ke, dtype),
+            "ck": jnp.asarray(sp.ck, dtype),
+        }],
+        "weight": jnp.asarray(sp.weight, dtype),
+        "eff": jnp.asarray(sp.eff, dtype),
+        "F": jnp.asarray(sp.F, dtype),
+        "Ud": jnp.asarray(sp.Ud, dtype),
+    }
+
+
+# Corner offsets in the element-dof ordering of models/element.py
+_CORNERS = HEX_CORNERS.astype(np.int64)  # (8, 3)
+
+
+@dataclasses.dataclass(frozen=True)
+class StructuredOps(Ops):
+    """Same operator protocol as Ops, slab-structured implementation."""
+
+    nxc: int = 0
+    ny: int = 0
+    nz: int = 0
+    n_parts: int = 1
+
+    @classmethod
+    def from_partition(cls, sp: StructuredPartition, dot_dtype=jnp.float64,
+                       axis_name=None, precision=jax.lax.Precision.HIGHEST):
+        return cls(n_loc=sp.n_loc, n_iface=0, dot_dtype=dot_dtype,
+                   axis_name=axis_name, precision=precision,
+                   nxc=sp.nxc, ny=sp.ny, nz=sp.nz, n_parts=sp.n_parts)
+
+    # -- grid helpers ---------------------------------------------------
+    def _grid(self, x):
+        Pl = x.shape[0]
+        return x.reshape(Pl, 3, self.nxc + 1, self.ny + 1, self.nz + 1)
+
+    def _gather_cells(self, xg):
+        """(Pl,3,nxn,nny,nnz) -> (Pl,24,nxc,ny,nz) via 8 contiguous slices."""
+        nxc, ny, nz = self.nxc, self.ny, self.nz
+        slots = []
+        for a in range(8):
+            dx, dy, dz = _CORNERS[a]
+            s = xg[:, :, dx:dx + nxc, dy:dy + ny, dz:dz + nz]
+            slots.append(s)
+        return jnp.concatenate(slots, axis=1)  # dof order: 3*corner + comp
+
+    def _scatter_cells(self, v):
+        """(Pl,24,nxc,ny,nz) -> (Pl,3,nxn,nny,nnz) via 8 shifted adds."""
+        Pl = v.shape[0]
+        nxc, ny, nz = self.nxc, self.ny, self.nz
+        y = jnp.zeros((Pl, 3, nxc + 1, ny + 1, nz + 1), v.dtype)
+        for a in range(8):
+            dx, dy, dz = _CORNERS[a]
+            y = y.at[:, :, dx:dx + nxc, dy:dy + ny, dz:dz + nz].add(
+                v[:, 3 * a:3 * a + 3])
+        return y
+
+    def _halo(self, yg):
+        """Combine partial sums on shared slab-boundary planes: one
+        bidirectional ppermute of (3,nny,nnz) planes over the mesh axis."""
+        P = self.n_parts
+        if P == 1:
+            return yg
+        if self.axis_name is None:
+            # unsharded multi-part view (testing): roll over leading axis
+            up = yg[:, :, -1]
+            dn = yg[:, :, 0]
+            from_left = jnp.roll(up, 1, axis=0).at[0].set(0.0)
+            from_right = jnp.roll(dn, -1, axis=0).at[-1].set(0.0)
+            yg = yg.at[:, :, 0].add(from_left)
+            yg = yg.at[:, :, -1].add(from_right)
+            return yg
+        idx = jax.lax.axis_index(self.axis_name)
+        up = yg[:, :, -1]
+        dn = yg[:, :, 0]
+        fwd = [(i, (i + 1) % P) for i in range(P)]
+        bwd = [(i, (i - 1) % P) for i in range(P)]
+        from_left = jax.lax.ppermute(up, self.axis_name, fwd)
+        from_right = jax.lax.ppermute(dn, self.axis_name, bwd)
+        from_left = jnp.where(idx == 0, 0.0, from_left)
+        from_right = jnp.where(idx == P - 1, 0.0, from_right)
+        yg = yg.at[:, :, 0].add(from_left)
+        yg = yg.at[:, :, -1].add(from_right)
+        return yg
+
+    # -- operator protocol ---------------------------------------------
+    def matvec_local(self, data, x):
+        blk = data["blocks"][0]
+        xg = self._grid(x)
+        u = self._gather_cells(xg)
+        v = jnp.einsum("de,pexyz->pdxyz", blk["Ke"],
+                       blk["ck"][:, None] * u, precision=self.precision)
+        yg = self._scatter_cells(v)
+        return yg.reshape(x.shape)
+
+    def matvec(self, data, x):
+        yg = self._grid(self.matvec_local(data, x))
+        return self._halo(yg).reshape(x.shape)
+
+    def diag_local(self, data):
+        blk = data["blocks"][0]
+        Pl = blk["ck"].shape[0]
+        v = blk["diag_Ke"][None, :, None, None, None] * blk["ck"][:, None]
+        yg = self._scatter_cells(v)
+        return yg.reshape(Pl, self.n_loc)
+
+    def diag(self, data):
+        yg = self._grid(self.diag_local(data))
+        return self._halo(yg).reshape(-1, self.n_loc)
+
+    def iface_assemble(self, data, y):
+        return self._halo(self._grid(y)).reshape(y.shape)

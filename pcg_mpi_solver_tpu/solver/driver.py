@@ -45,7 +45,9 @@ class Solver:
         mesh: Optional[jax.sharding.Mesh] = None,
         n_parts: Optional[int] = None,
         elem_part: Optional[np.ndarray] = None,
+        backend: str = "auto",   # "auto" | "structured" | "general"
     ):
+        self._t_init0 = time.perf_counter()
         self.config = config or RunConfig()
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
@@ -67,10 +69,39 @@ class Solver:
                 jax.config.update("jax_enable_x64", True)
         self.dtype = dtype
 
-        self.pm: PartitionedModel = partition_model(model, n_parts, elem_part=elem_part)
-        self.ops = Ops.from_model(self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS)
+        # ---- backend selection: structured slab fast path when possible ----
+        # (TPU has no vector gather/scatter; the structured path replaces
+        # them with contiguous slice shifts, parallel/structured.py.)
+        can_structured = (
+            model.grid is not None
+            and not model.elem_sign_flat.any()
+            and n_parts == n_dev
+            and model.grid[0] % n_parts == 0
+        )
+        if backend == "structured" and not can_structured:
+            raise ValueError("structured backend requested but model/partition "
+                             "layout does not allow it")
+        self.backend = "structured" if (backend in ("auto", "structured")
+                                        and can_structured) else "general"
 
-        data = device_data(self.pm, dtype)
+        if self.backend == "structured":
+            from pcg_mpi_solver_tpu.parallel.structured import (
+                StructuredOps, device_data_structured, partition_structured)
+
+            self.pm = partition_structured(model, n_parts)
+            self.ops = StructuredOps.from_partition(
+                self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS)
+            data = device_data_structured(self.pm, dtype)
+            ops32_factory = lambda: StructuredOps.from_partition(
+                self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS)
+        else:
+            self.pm = partition_model(model, n_parts, elem_part=elem_part)
+            self.ops = Ops.from_model(self.pm, dot_dtype=dot_dtype,
+                                      axis_name=PARTS_AXIS)
+            data = device_data(self.pm, dtype)
+            ops32_factory = lambda: Ops.from_model(
+                self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS)
+
         if self.mixed:
             # f32 shadow of the float leaves; index/bool arrays are shared
             # (same device buffers), so the extra memory is only the f32 floats.
@@ -80,8 +111,7 @@ class Solver:
                     lambda x: x.astype(jnp.float32)
                     if jnp.issubdtype(x.dtype, jnp.floating) else x, data),
             }
-            self.ops32 = Ops.from_model(self.pm, dot_dtype=jnp.float32,
-                                        axis_name=PARTS_AXIS)
+            self.ops32 = ops32_factory()
         self._specs = _data_specs(data)
         self.data = jax.device_put(
             data, jax.tree.map(lambda s: jax.NamedSharding(self.mesh, s), self._specs,
@@ -177,17 +207,79 @@ class Solver:
         self.step_times.append(wall)
         return res
 
-    def solve(self, on_step: Optional[Callable[[int, StepResult], None]] = None):
+    def solve(self, on_step: Optional[Callable[[int, StepResult], None]] = None,
+              store=None):
         """Run the full quasi-static schedule (skips step 0, like the
-        reference's ``range(1, RefMaxTimeStepCount)``, pcg_solver.py:1002)."""
-        deltas = self.config.time_history.time_step_delta
+        reference's ``range(1, RefMaxTimeStepCount)``, pcg_solver.py:1002),
+        exporting contour frames / history / timing into ``store`` when
+        exports are enabled."""
+        th = self.config.time_history
+        deltas = th.time_step_delta
+        do_export = store is not None and th.export_flag and not self.config.speed_test
+        do_plot = store is not None and th.plot_flag and not self.config.speed_test
+
+        t_prep = time.perf_counter() - self._t_init0
+        if do_export:
+            store.prepare()
+            store.write_map("Dof", self.export_dof_map())
+            self._export_count = 0
+            self._export_times = []
+            self._maybe_export(store, 0)
+        probe_u = []
+
         results = []
         for t in range(1, len(deltas)):
             res = self.step(deltas[t])
             results.append(res)
+            if do_export:
+                self._maybe_export(store, t)
+            if do_plot and len(th.probe_dofs) > 0:
+                u = self.displacement_global()
+                probe_u.append(u[np.asarray(th.probe_dofs)])
             if on_step is not None:
                 on_step(t, res)
+
+        if do_export:
+            store.write_time_list(self._export_times)
+        if do_plot and probe_u:
+            times = [i * th.dt for i in range(1, len(deltas))]
+            store.write_plot_data(times, np.stack(probe_u, axis=1), th.probe_dofs)
+        if store is not None and not self.config.speed_test:
+            store.write_time_data(self.pm.n_parts, self.time_data(t_prep))
         return results
+
+    def _maybe_export(self, store, t: int):
+        """Key-frame contour export (reference exportContourData,
+        pcg_solver.py:841-896)."""
+        th = self.config.time_history
+        due = th.export_frame_rate > 0 and t % th.export_frame_rate == 0
+        if t in tuple(th.export_frames):
+            due = True
+        if not due:
+            return
+        k = self._export_count
+        export_vars = th.export_vars.split() if " " in th.export_vars else [
+            v for v in ("U", "D", "ES", "PS", "PE") if v in th.export_vars]
+        if "U" in export_vars:
+            store.write_frame("U", k, self.displacement_owned())
+        self._export_times.append(t * th.dt)
+        self._export_count = k + 1
+
+    def time_data(self, t_prep: float = 0.0) -> dict:
+        """Solve metadata in the reference's TimeData schema
+        (file_operations.py:72-172, pcg_solver.py:943-961)."""
+        return {
+            "Mean_FileReadTime": t_prep,
+            "Mean_CalcTime": float(np.sum(self.step_times)),
+            "Mean_CommWaitTime": 0.0,  # collectives live inside the jitted
+                                       # program; split requires profiler traces
+            "TotalTime": t_prep + float(np.sum(self.step_times)),
+            "Flag": np.asarray(self.flags),
+            "Iter": np.asarray(self.iters),
+            "RelRes": np.asarray(self.relres),
+            "MP_NDOF": self.pm.n_loc,
+            "N_Parts": self.pm.n_parts,
+        }
 
     # ------------------------------------------------------------------
     # Host-side views for export

@@ -1,0 +1,106 @@
+"""Run-directory store and small serialization helpers.
+
+Replaces the reference's file plumbing (src/utils/file_operations.py:
+exportz/importz zlib-pickles :32-42, MPI-IO shared-file writes with sidecar
+metadata :348-531) with plain .npy/.npz per-array files — no MPI-IO needed
+since the host assembles owner-masked arrays directly.  Keeps the reference's
+results layout and .mat co-exports so downstream tooling carries over:
+
+    <scratch>/Results_Run<id>[_SpeedTest]/
+        ResVecData/   Dof.npy NodeId.npy U_<k>.npy D_<k>.npy ... Time_T.npy
+        PlotData/     <model>_PlotData.npz/.mat  <model>_MP<P>_TimeData.npz/.mat
+        VTKs/         <model>_<k>.vtu  VTKInfo.txt
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import zlib
+from datetime import datetime
+from typing import Dict
+
+import numpy as np
+
+
+def exportz(filename: str, data) -> None:
+    """zlib-compressed pickle (reference file_operations.py:32-38)."""
+    with open(filename, "wb") as f:
+        f.write(zlib.compress(pickle.dumps(data, pickle.HIGHEST_PROTOCOL)))
+
+
+def importz(filename: str):
+    with open(filename, "rb") as f:
+        return pickle.loads(zlib.decompress(f.read()))
+
+
+class RunStore:
+    """Owns one Results_Run directory."""
+
+    def __init__(self, result_path: str, model_name: str = "model"):
+        self.result_path = result_path.rstrip("/")
+        self.model_name = model_name
+        self.res_vec_path = f"{self.result_path}/ResVecData"
+        self.plot_path = f"{self.result_path}/PlotData"
+        self.vtk_path = f"{self.result_path}/VTKs"
+
+    def prepare(self) -> None:
+        """Create result dirs; an existing run dir is renamed with a
+        timestamp (crude run protection, reference pcg_solver.py:67-70)."""
+        if os.path.exists(self.result_path):
+            stamp = datetime.now().strftime("%d%m%Y_%H%M%S")
+            os.rename(self.result_path, f"{self.result_path}_{stamp}")
+        os.makedirs(self.res_vec_path)
+        os.makedirs(self.plot_path)
+
+    # -- maps and frames ------------------------------------------------
+    def write_map(self, name: str, ids: np.ndarray) -> None:
+        np.save(f"{self.res_vec_path}/{name}.npy", ids)
+
+    def read_map(self, name: str) -> np.ndarray:
+        return np.load(f"{self.res_vec_path}/{name}.npy")
+
+    def write_frame(self, var: str, k: int, values: np.ndarray) -> None:
+        np.save(f"{self.res_vec_path}/{var}_{k}.npy", values)
+
+    def read_frame(self, var: str, k: int) -> np.ndarray:
+        return np.load(f"{self.res_vec_path}/{var}_{k}.npy")
+
+    def n_frames(self, var: str) -> int:
+        import glob
+
+        return len(glob.glob(f"{self.res_vec_path}/{var}_*.npy"))
+
+    def write_time_list(self, times) -> None:
+        np.save(f"{self.res_vec_path}/Time_T.npy", np.asarray(times))
+
+    def read_time_list(self) -> np.ndarray:
+        return np.load(f"{self.res_vec_path}/Time_T.npy")
+
+    # -- history / timing ----------------------------------------------
+    def write_plot_data(self, plot_t, plot_u, plot_dofs) -> None:
+        """Probe-dof displacement history
+        (reference exportHistoryPlotData, pcg_solver.py:899-940)."""
+        data = {"Plot_T": np.asarray(plot_t), "Plot_U": np.asarray(plot_u),
+                "Plot_Dof": np.asarray(plot_dofs) + 1}
+        np.savez_compressed(f"{self.plot_path}/{self.model_name}_PlotData",
+                            PlotData=np.array(data, dtype=object))
+        _savemat(f"{self.plot_path}/{self.model_name}_PlotData.mat", data)
+
+    def write_time_data(self, n_parts: int, time_data: Dict) -> None:
+        """Solve metadata: per-step Flag/RelRes/Iter + timing buckets
+        (reference exportTimeData, pcg_solver.py:943-961)."""
+        name = f"{self.plot_path}/{self.model_name}_MP{n_parts}_TimeData"
+        np.savez_compressed(name, TimeData=np.array(time_data, dtype=object))
+        _savemat(name + ".mat", time_data)
+
+    def read_time_data(self, n_parts: int) -> Dict:
+        name = f"{self.plot_path}/{self.model_name}_MP{n_parts}_TimeData.npz"
+        return np.load(name, allow_pickle=True)["TimeData"].item()
+
+
+def _savemat(path: str, data: Dict) -> None:
+    import scipy.io
+
+    scipy.io.savemat(path, {k: np.asarray(v) for k, v in data.items()})

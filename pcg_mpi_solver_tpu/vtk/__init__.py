@@ -1,0 +1,3 @@
+from pcg_mpi_solver_tpu.vtk.writer import write_vtu, VTK_HEXAHEDRON, VTK_POLYGON, VTK_QUAD, VTK_TETRA
+
+__all__ = ["write_vtu", "VTK_HEXAHEDRON", "VTK_POLYGON", "VTK_QUAD", "VTK_TETRA"]
