@@ -46,9 +46,12 @@ def device_data(pm: PartitionedModel, dtype=jnp.float64) -> dict:
             {
                 "Ke": jnp.asarray(tb.Ke, dtype),
                 "diag_Ke": jnp.asarray(tb.diag_Ke, dtype),
+                "Se": jnp.asarray(tb.Se, dtype) if tb.Se is not None else None,
                 "dof": jnp.asarray(tb.dof, jnp.int32),
                 "sign": jnp.asarray(tb.sign),
+                "node": jnp.asarray(tb.node, jnp.int32),
                 "ck": jnp.asarray(tb.ck, dtype),
+                "ce": jnp.asarray(tb.ce, dtype),
             }
             for tb in pm.type_blocks
         ],
@@ -56,7 +59,10 @@ def device_data(pm: PartitionedModel, dtype=jnp.float64) -> dict:
         "scat_ids": jnp.asarray(pm.scat_ids, jnp.int32),
         "iface_local": jnp.asarray(pm.iface_local, jnp.int32),
         "iface_slot": jnp.asarray(pm.iface_slot, jnp.int32),
+        "niface_local": jnp.asarray(pm.niface_local, jnp.int32),
+        "niface_slot": jnp.asarray(pm.niface_slot, jnp.int32),
         "weight": jnp.asarray(pm.weight, dtype),
+        "node_weight": jnp.asarray(pm.node_weight, dtype),
         "eff": jnp.asarray(pm.eff, dtype),
         "F": jnp.asarray(pm.F, dtype),
         "Ud": jnp.asarray(pm.Ud, dtype),
@@ -74,6 +80,8 @@ class Ops:
 
     n_loc: int
     n_iface: int
+    n_node_loc: int = 0
+    n_node_iface: int = 0
     dot_dtype: jnp.dtype = jnp.float64
     axis_name: Optional[str] = None
     # MXU precision for the element matmuls.  TPU 'default' runs f32 inputs
@@ -85,8 +93,9 @@ class Ops:
     @classmethod
     def from_model(cls, pm: PartitionedModel, dot_dtype=jnp.float64, axis_name=None,
                    precision=jax.lax.Precision.HIGHEST):
-        return cls(n_loc=pm.n_loc, n_iface=pm.n_iface, dot_dtype=dot_dtype,
-                   axis_name=axis_name, precision=precision)
+        return cls(n_loc=pm.n_loc, n_iface=pm.n_iface,
+                   n_node_loc=pm.n_node_loc, n_node_iface=pm.n_node_iface,
+                   dot_dtype=dot_dtype, axis_name=axis_name, precision=precision)
 
     # -- collectives ----------------------------------------------------
     def _psum(self, x):
@@ -95,22 +104,33 @@ class Ops:
         return jax.lax.psum(x, self.axis_name)
 
     # -- interface assembly --------------------------------------------
-    def iface_assemble(self, data: dict, y: jnp.ndarray) -> jnp.ndarray:
-        """Sum shared-dof partial values across all parts.
+    def _assemble_shared(self, y, local, slot, n_glob):
+        """Sum partial values of ids shared by several parts: scatter into a
+        global shared-id vector, ONE psum, gather back.  y: (P, n)."""
+        vals = jnp.take_along_axis(y, local, axis=1, mode="fill", fill_value=0)
+        glob = jnp.zeros((n_glob,), y.dtype)
+        glob = glob.at[slot.reshape(-1)].add(vals.reshape(-1), mode="drop")
+        glob = self._psum(glob)
+        new = glob.at[slot].get(mode="fill", fill_value=0)
+        return jax.vmap(lambda yp, loc, nv: yp.at[loc].set(nv, mode="drop"))(
+            y, local, new)
 
-        y: (P, n_loc) partial sums -> (P, n_loc) fully assembled.
-        """
+    def iface_assemble(self, data: dict, y: jnp.ndarray) -> jnp.ndarray:
+        """Dof-space assembly: (P, n_loc) partial sums -> fully assembled."""
         if self.n_iface == 0:
             return y
-        vals = jnp.take_along_axis(y, data["iface_local"], axis=1,
-                                   mode="fill", fill_value=0)
-        glob = jnp.zeros((self.n_iface,), y.dtype)
-        glob = glob.at[data["iface_slot"].reshape(-1)].add(
-            vals.reshape(-1), mode="drop")
-        glob = self._psum(glob)
-        new = glob.at[data["iface_slot"]].get(mode="fill", fill_value=0)
-        return jax.vmap(lambda yp, loc, nv: yp.at[loc].set(nv, mode="drop"))(
-            y, data["iface_local"], new)
+        return self._assemble_shared(y, data["iface_local"],
+                                     data["iface_slot"], self.n_iface)
+
+    def niface_assemble(self, data: dict, y: jnp.ndarray) -> jnp.ndarray:
+        """Node-space assembly for (P, k, n_node_loc) stacked channels
+        (reference exchanges nodal sums+counts over neighbors,
+        pcg_solver.py:689-723)."""
+        if self.n_node_iface == 0:
+            return y
+        f = lambda yk: self._assemble_shared(
+            yk, data["niface_local"], data["niface_slot"], self.n_node_iface)
+        return jax.vmap(f, in_axes=1, out_axes=1)(y)
 
     # -- the matvec -----------------------------------------------------
     def matvec_local(self, data: dict, x: jnp.ndarray) -> jnp.ndarray:
@@ -150,6 +170,60 @@ class Ops:
 
     def diag(self, data: dict) -> jnp.ndarray:
         return self.iface_assemble(data, self.diag_local(data))
+
+    # -- element strain + nodal averaging (export path) -----------------
+    def elem_strain(self, data: dict, x: jnp.ndarray):
+        """Per-block center-point strain eps = Se @ (ce * S.u_e), in each
+        pattern's local frame (reference updateElemStrain,
+        pcg_solver.py:601-618).  Returns list of (P, 6, N)."""
+        out = []
+        for blk in data["blocks"]:
+            u = jnp.take_along_axis(x[:, None, :], blk["dof"], axis=2,
+                                    mode="fill", fill_value=0)
+            u = jnp.where(blk["sign"], -u, u)
+            eps = jnp.einsum("sd,pdn->psn", blk["Se"],
+                             blk["ce"][:, None, :] * u, precision=self.precision)
+            out.append(eps)
+        return out
+
+    def elem_scale(self, data: dict):
+        """Per-block elastic modulus E = ck*ce (since ck=E*h, ce=1/h)."""
+        return [blk["ck"] * blk["ce"] for blk in data["blocks"]]
+
+    def nodal_average(self, data: dict, vals_list) -> jnp.ndarray:
+        """Element values -> averaged nodal field.
+
+        vals_list: per block (P, k, N) element-constant values.  Scatter
+        sums + counts to element nodes, assemble shared nodes across parts,
+        divide (reference getNodalScalarVar/getNodalPS,
+        pcg_solver.py:655-814, incl. the +1e-15 guard :724)."""
+        k = vals_list[0].shape[1]
+        Pl = vals_list[0].shape[0]
+        dt = vals_list[0].dtype
+        sums = jnp.zeros((Pl, k, self.n_node_loc), dt)
+        counts = jnp.zeros((Pl, 1, self.n_node_loc), dt)
+
+        def scat(s, ids, c):
+            return s.at[:, ids].add(c, mode="drop")
+
+        for blk, vals in zip(data["blocks"], vals_list):
+            node = blk["node"]                        # (P, nn, N)
+            nn = node.shape[1]
+            ids = node.reshape(Pl, -1)
+            contrib = jnp.broadcast_to(vals[:, :, None, :],
+                                       (Pl, k, nn, vals.shape[2])
+                                       ).reshape(Pl, k, -1)
+            # Every real element counts once per node (reference
+            # pcg_solver.py:685-686); padded slots drop via their
+            # out-of-bounds node ids, so no extra masking — identical
+            # semantics on both backends.
+            ones = jnp.ones((Pl, 1, nn * vals.shape[2]), dt)
+            sums = jax.vmap(scat)(sums, ids, contrib)
+            counts = jax.vmap(scat)(counts, ids, ones)
+
+        both = jnp.concatenate([sums, counts], axis=1)
+        both = self.niface_assemble(data, both)
+        return both[:, :k] / (both[:, k:] + 1e-15)
 
     # -- reductions -----------------------------------------------------
     def _local_dot(self, w, a, b):

@@ -1,0 +1,115 @@
+"""Stress/strain export fields: principal values + nodal field assembly.
+
+Completes a capability the reference left latent: its strain-mode matrices
+(``Se.mat``) are commented out of the partitioner (partition_mesh.py:545,580),
+so the documented 'ES'/'PS'/'PE' export variables would KeyError at
+pcg_solver.py:875-889.  Here the strain modes are generated with the element
+library (models/element.py:hex_strain_mode) and the full chain works:
+
+    u -> eps = Se.(ce*S.u)  per element       (updateElemStrain :601-618)
+      -> sigma = (1-omega)*E*D(nu).eps        (getNodalPS :755)
+      -> principal values (trig invariant method, descending)
+                                              (file_operations.py:251-301)
+      -> node-averaged fields with halo-assembled sums/counts
+                                              (getNodalScalarVar :655-727)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pcg_mpi_solver_tpu.models.element import elasticity_matrix
+
+
+def principal_values(voigt: jnp.ndarray, axis: int = 1) -> jnp.ndarray:
+    """Principal values of symmetric 3x3 tensors in Voigt form
+    (XX,YY,ZZ,YZ,XZ,XY) along ``axis``; returns 3 values, descending.
+
+    Closed-form trigonometric (Cardano) method — branch-free and batched,
+    the same algorithm as the reference (file_operations.py:274-301)."""
+    v = jnp.moveaxis(voigt, axis, 0)
+    s11, s22, s33, s23, s13, s12 = v[0], v[1], v[2], v[3], v[4], v[5]
+    I1 = s11 + s22 + s33
+    I2 = s11 * s22 + s22 * s33 + s33 * s11 - s12**2 - s23**2 - s13**2
+    I3 = (s11 * s22 * s33 - s11 * s23**2 - s22 * s13**2 - s33 * s12**2
+          + 2 * s12 * s23 * s13)
+    scale = jnp.max(jnp.abs(v), axis=0)
+    J2 = I1 * I1 - 3 * I2 + 1e-24 * scale  # guard (reference :283)
+    J2 = jnp.maximum(J2, 0.0)
+    # Clamp AFTER the 1.5-power with a dtype-aware tiny: J2**1.5 underflows
+    # to 0 for near-degenerate tensors and 0/0 would NaN-poison the all-equal
+    # eigenvalue case (e.g. the exactly-zero state of the always-exported
+    # initial frame).  With denom clamped, phi_arg -> 0 and f -> 0, giving
+    # the correct p_i = I1/3.
+    tiny = np.finfo(np.dtype(v.dtype)).tiny
+    denom = jnp.maximum(J2**1.5, tiny)
+    phi_arg = jnp.clip(0.5 * (2 * I1**3 - 9 * I1 * I2 + 27 * I3) / denom,
+                       -1.0, 1.0)
+    phi = jnp.arccos(phi_arg) / 3.0
+    f = (2.0 / 3.0) * jnp.sqrt(J2)
+    p0 = I1 / 3.0 + f * jnp.cos(phi)
+    p1 = I1 / 3.0 + f * jnp.cos(phi + 2.0 * jnp.pi / 3.0)
+    p2 = I1 / 3.0 + f * jnp.cos(phi + 4.0 * jnp.pi / 3.0)
+    stacked = jnp.stack([p0, p1, p2])
+    pmax = jnp.max(stacked, axis=0)
+    pmin = jnp.min(stacked, axis=0)
+    pmid = I1 - pmax - pmin
+    return jnp.moveaxis(jnp.stack([pmax, pmid, pmin]), 0, axis)
+
+
+def eqv_strain(eps: jnp.ndarray, axis: int = 1) -> jnp.ndarray:
+    """Von Mises equivalent strain from a Voigt strain vector (engineering
+    shear).  The reference's 'ES' comes from its damage model (vestigial
+    here); von Mises is the standard scalar equivalent."""
+    e = jnp.moveaxis(eps, axis, 0)
+    e11, e22, e33, g23, g13, g12 = e[0], e[1], e[2], e[3], e[4], e[5]
+    dev = ((e11 - e22)**2 + (e22 - e33)**2 + (e33 - e11)**2) / 2.0
+    shear = 3.0 / 4.0 * (g23**2 + g13**2 + g12**2)
+    return (2.0 / 3.0) * jnp.sqrt(dev + shear)
+
+
+def nodal_export_fields(ops, data: dict, un: jnp.ndarray, export_vars, nu: float,
+                        omega_list=None) -> dict:
+    """Compute every requested nodal export field from the solution.
+
+    Returns {var: (P, n_node_loc)} for var in D, ES, PS1-3, PE1-3
+    (reference exportContourData, pcg_solver.py:861-889)."""
+    want_pe = any(v.startswith("PE") for v in export_vars)
+    want_ps = any(v.startswith("PS") for v in export_vars)
+    want_es = "ES" in export_vars
+    want_d = "D" in export_vars
+    out = {}
+
+    eps_list = None
+    if want_pe or want_ps or want_es:
+        eps_list = ops.elem_strain(data, un)
+
+    requests = []   # (name, per-block list of (P, k, N))
+    if want_d:
+        if omega_list is None:
+            # damage scaffold: Omega = 0 (reference config_TypeGroupList
+            # initializes it so, partition_mesh.py:482)
+            omega_list = [jnp.zeros_like(c)[:, None, :]
+                          for c in ops.elem_scale(data)]
+        requests.append(("D", omega_list))
+    if want_es:
+        requests.append(("ES", [eqv_strain(e)[:, None] for e in eps_list]))
+    if want_pe:
+        requests.append(("PE", [principal_values(e) for e in eps_list]))
+    if want_ps:
+        D = jnp.asarray(elasticity_matrix(1.0, nu), eps_list[0].dtype)
+        emods = ops.elem_scale(data)
+        sig_list = [E[:, None] * jnp.einsum("st,ptn->psn", D, e)
+                    for E, e in zip(emods, eps_list)]
+        requests.append(("PS", [principal_values(s) for s in sig_list]))
+
+    for name, vals in requests:
+        avg = ops.nodal_average(data, vals)     # (P, k, n_node_loc)
+        if name in ("D", "ES"):
+            out[name] = avg[:, 0]
+        else:
+            for i in range(3):
+                out[f"{name}{i+1}"] = avg[:, i]
+    return out

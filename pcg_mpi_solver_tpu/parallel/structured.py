@@ -43,6 +43,7 @@ class StructuredPartition:
     n_parts: int
     n_loc: int                  # 3 * nxn_loc * nny * nnz
     n_iface: int                # unused (halo via ppermute); kept for protocol
+    n_node_loc: int             # nxn_loc * nny * nnz
     glob_n_dof: int
     glob_n_dof_eff: int
     glob_n_node: int
@@ -51,13 +52,17 @@ class StructuredPartition:
     nz: int
 
     ck: np.ndarray              # (P, nxc, ny, nz) cell stiffness scale
+    ce: np.ndarray              # (P, nxc, ny, nz) cell strain scale (1/h)
     Ke: np.ndarray              # (24, 24)
     diag_Ke: np.ndarray         # (24,)
+    Se: np.ndarray              # (6, 24)
     weight: np.ndarray          # (P, n_loc)
+    node_weight: np.ndarray     # (P, n_node_loc)
     eff: np.ndarray             # (P, n_loc)
     F: np.ndarray               # (P, n_loc)
     Ud: np.ndarray              # (P, n_loc)
     dof_gid: np.ndarray         # (P, n_loc) int64
+    node_gid: np.ndarray        # (P, n_node_loc) int64
     ndof_p: np.ndarray          # (P,)
 
 
@@ -83,6 +88,8 @@ def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
     # cell ck grid: global element id = ex + nx*(ey + ny*ez)  (x fastest)
     ck_glob = np.asarray(model.ck).reshape(nz, ny, nx).transpose(2, 1, 0)  # (nx,ny,nz)
     ck = np.stack([ck_glob[p * nxc:(p + 1) * nxc] for p in range(P)])
+    ce_glob = np.asarray(model.ce).reshape(nz, ny, nx).transpose(2, 1, 0)
+    ce = np.stack([ce_glob[p * nxc:(p + 1) * nxc] for p in range(P)])
 
     # local node (ix,iy,iz) [x-major local layout] -> global dof ids
     nnx = nx + 1
@@ -95,12 +102,15 @@ def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
     eff_mask_glob = np.zeros(model.n_dof, dtype=bool)
     eff_mask_glob[model.dof_eff] = True
 
+    n_node_loc = nxn * nny * nnz
+    node_gid = np.zeros((P, n_node_loc), dtype=np.int64)
     ix = np.arange(nxn)
     iy = np.arange(nny)
     iz = np.arange(nnz)
     IX, IY, IZ = np.meshgrid(ix, iy, iz, indexing="ij")
     for p in range(P):
         gnode = (IX + p * nxc) + nnx * (IY + nny * IZ)          # (nxn,nny,nnz)
+        node_gid[p] = gnode.reshape(-1)
         gdof = (3 * gnode[..., None] + np.arange(3)).transpose(3, 0, 1, 2)
         # local flat layout: (c, ix, iy, iz) row-major
         g = gdof.reshape(-1)
@@ -115,23 +125,31 @@ def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
     weight = np.ones((P, 3, nxn, nny, nnz))
     weight[1:, :, 0] = 0.0
     weight = weight.reshape(P, n_loc)
+    node_weight = np.ones((P, nxn, nny, nnz))
+    node_weight[1:, 0] = 0.0
+    node_weight = node_weight.reshape(P, n_node_loc)
 
     return StructuredPartition(
         n_parts=P,
         n_loc=n_loc,
         n_iface=0,
+        n_node_loc=n_node_loc,
         glob_n_dof=model.n_dof,
         glob_n_dof_eff=len(model.dof_eff),
         glob_n_node=model.n_node,
         nxc=nxc, ny=ny, nz=nz,
         ck=ck,
+        ce=ce,
         Ke=np.asarray(lib["Ke"], np.float64),
         diag_Ke=np.asarray(lib["diagKe"], np.float64),
+        Se=np.asarray(lib["Se"], np.float64),
         weight=weight,
+        node_weight=node_weight,
         eff=eff,
         F=F,
         Ud=Ud,
         dof_gid=dof_gid,
+        node_gid=node_gid,
         ndof_p=np.full(P, n_loc),
     )
 
@@ -141,9 +159,12 @@ def device_data_structured(sp: StructuredPartition, dtype=jnp.float64) -> dict:
         "blocks": [{
             "Ke": jnp.asarray(sp.Ke, dtype),
             "diag_Ke": jnp.asarray(sp.diag_Ke, dtype),
+            "Se": jnp.asarray(sp.Se, dtype),
             "ck": jnp.asarray(sp.ck, dtype),
+            "ce": jnp.asarray(sp.ce, dtype),
         }],
         "weight": jnp.asarray(sp.weight, dtype),
+        "node_weight": jnp.asarray(sp.node_weight, dtype),
         "eff": jnp.asarray(sp.eff, dtype),
         "F": jnp.asarray(sp.F, dtype),
         "Ud": jnp.asarray(sp.Ud, dtype),
@@ -166,7 +187,9 @@ class StructuredOps(Ops):
     @classmethod
     def from_partition(cls, sp: StructuredPartition, dot_dtype=jnp.float64,
                        axis_name=None, precision=jax.lax.Precision.HIGHEST):
-        return cls(n_loc=sp.n_loc, n_iface=0, dot_dtype=dot_dtype,
+        return cls(n_loc=sp.n_loc, n_iface=0,
+                   n_node_loc=sp.n_node_loc, n_node_iface=0,
+                   dot_dtype=dot_dtype,
                    axis_name=axis_name, precision=precision,
                    nxc=sp.nxc, ny=sp.ny, nz=sp.nz, n_parts=sp.n_parts)
 
@@ -251,3 +274,38 @@ class StructuredOps(Ops):
 
     def iface_assemble(self, data, y):
         return self._halo(self._grid(y)).reshape(y.shape)
+
+    # -- export path ----------------------------------------------------
+    def _node_grid(self, y):
+        Pl = y.shape[0]
+        return y.reshape(Pl, -1, self.nxc + 1, self.ny + 1, self.nz + 1)
+
+    def elem_strain(self, data, x):
+        blk = data["blocks"][0]
+        u = self._gather_cells(self._grid(x))                  # (P,24,cx,cy,cz)
+        eps = jnp.einsum("sd,pdxyz->psxyz", blk["Se"],
+                         blk["ce"][:, None] * u, precision=self.precision)
+        Pl = eps.shape[0]
+        return [eps.reshape(Pl, 6, -1)]
+
+    def elem_scale(self, data):
+        blk = data["blocks"][0]
+        Pl = blk["ck"].shape[0]
+        return [(blk["ck"] * blk["ce"]).reshape(Pl, -1)]
+
+    def nodal_average(self, data, vals_list):
+        """Cell values -> averaged nodal grid via 8 shifted slice-adds of
+        sums and counts, halo'd as extra channels."""
+        vals = vals_list[0]
+        Pl, k = vals.shape[0], vals.shape[1]
+        nxc, ny, nz = self.nxc, self.ny, self.nz
+        vg = vals.reshape(Pl, k, nxc, ny, nz)
+        cg = jnp.ones((Pl, 1, nxc, ny, nz), vals.dtype)
+        both = jnp.concatenate([vg, cg], axis=1)               # (P, k+1, cells)
+        y = jnp.zeros((Pl, k + 1, nxc + 1, ny + 1, nz + 1), vals.dtype)
+        for a in range(8):
+            dx, dy, dz = _CORNERS[a]
+            y = y.at[:, :, dx:dx + nxc, dy:dy + ny, dz:dz + nz].add(both)
+        y = self._halo(y)
+        avg = y[:, :k] / (y[:, k:] + 1e-15)
+        return avg.reshape(Pl, k, -1)

@@ -175,6 +175,9 @@ class Solver:
             jax.NamedSharding(self.mesh, self._part_spec),
         )
 
+        self._export_fn = None
+        self._nu = float(model.mat_prop[0]["Pos"]) if model.mat_prop else 0.2
+
         # History records (reference TimeList_*, pcg_solver.py:163-165)
         self.flags: List[int] = []
         self.relres: List[float] = []
@@ -222,6 +225,8 @@ class Solver:
         if do_export:
             store.prepare()
             store.write_map("Dof", self.export_dof_map())
+            if self._nodal_vars():
+                store.write_map("NodeId", self.export_node_map())
             self._export_count = 0
             self._export_times = []
             self._maybe_export(store, 0)
@@ -258,12 +263,42 @@ class Solver:
         if not due:
             return
         k = self._export_count
-        export_vars = th.export_vars.split() if " " in th.export_vars else [
-            v for v in ("U", "D", "ES", "PS", "PE") if v in th.export_vars]
-        if "U" in export_vars:
+        if "U" in self._export_vars():
             store.write_frame("U", k, self.displacement_owned())
+        nodal = self._nodal_vars()
+        if nodal:
+            fields = self._nodal_fields()
+            mask = self.node_owner_mask()
+            for var, arr in fields.items():
+                store.write_frame(var, k, np.asarray(arr)[mask])
         self._export_times.append(t * th.dt)
         self._export_count = k + 1
+
+    def _export_vars(self):
+        ev = self.config.time_history.export_vars
+        return ev.split() if " " in ev else [
+            v for v in ("U", "D", "ES", "PS", "PE") if v in ev]
+
+    def _nodal_vars(self):
+        return [v for v in self._export_vars() if v != "U"]
+
+    def _nodal_fields(self) -> dict:
+        """Jitted nodal export fields of the current solution
+        ({var: (P, n_node_loc)} split to PS1..3/PE1..3)."""
+        if self._export_fn is None:
+            from pcg_mpi_solver_tpu.ops.stress import nodal_export_fields
+
+            nodal = tuple(self._nodal_vars())
+
+            def _fields(data, un):
+                data64 = data["f64"] if self.mixed else data
+                return nodal_export_fields(self.ops, data64, un, nodal, self._nu)
+
+            self._export_fn = jax.jit(jax.shard_map(
+                _fields, mesh=self.mesh,
+                in_specs=(self._specs, self._part_spec),
+                out_specs=self._part_spec, check_vma=False))
+        return self._export_fn(self.data, self.un)
 
     def time_data(self, t_prep: float = 0.0) -> dict:
         """Solve metadata in the reference's TimeData schema
@@ -288,6 +323,15 @@ class Solver:
         """(P, n_loc) bool — dofs this part owns (reference
         DofWeightVector_Export, pcg_solver.py:198)."""
         return (self.pm.weight > 0) & (self.pm.dof_gid >= 0)
+
+    def node_owner_mask(self) -> np.ndarray:
+        """(P, n_node_loc) bool — nodes this part owns."""
+        return (self.pm.node_weight > 0) & (self.pm.node_gid >= 0)
+
+    def export_node_map(self) -> np.ndarray:
+        """Global node ids in export order (reference 'NodeId' map,
+        pcg_solver.py:202)."""
+        return self.pm.node_gid[self.node_owner_mask()]
 
     def export_dof_map(self) -> np.ndarray:
         """Global dof ids in export order (reference writes this once as the
