@@ -1,0 +1,105 @@
+"""Benchmark harness: TPU SPMD solve vs the single-process numpy reference.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: sustained PCG iteration throughput (dof-iterations / second) of the
+full jitted solve on the available accelerator, measured on a converged
+quasi-static step (compile excluded).  ``vs_baseline`` compares against an
+idealized 8-rank run of the reference implementation: the numpy backend's
+measured per-iteration time divided by 8 (perfect scaling — conservative,
+the real mpi4py reference scales sublinearly; its 8-rank demo spent 1.0 of
+12.6 s in comm-wait, BASELINE.md).
+
+Env knobs: BENCH_NX/NY/NZ (mesh size), BENCH_TOL, BENCH_PARTS, BENCH_DTYPE.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
+    from pcg_mpi_solver_tpu.models import make_cube_model
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+    from pcg_mpi_solver_tpu.solver import Solver
+    from pcg_mpi_solver_tpu.solver.numpy_ref import NumpyRefSolver
+
+    nx = int(os.environ.get("BENCH_NX", 48))
+    ny = int(os.environ.get("BENCH_NY", 32))
+    nz = int(os.environ.get("BENCH_NZ", 32))
+    tol = float(os.environ.get("BENCH_TOL", 1e-7))
+    mode = os.environ.get("BENCH_MODE", "mixed")   # mixed | direct
+    dtype = os.environ.get("BENCH_DTYPE", "float32")
+    n_dev = len(jax.devices())
+    n_parts = int(os.environ.get("BENCH_PARTS", n_dev))
+
+    model = make_cube_model(nx, ny, nz, E=30e9, nu=0.2, load="traction",
+                            load_value=1e6, heterogeneous=True)
+    print(f"# model: {model.n_elem} elems / {model.n_dof} dofs; "
+          f"devices={n_dev} parts={n_parts} dtype={dtype}", file=sys.stderr)
+
+    cfg = RunConfig(
+        solver=SolverConfig(tol=tol, max_iter=20000, dtype=dtype,
+                            dot_dtype="float64", precision_mode=mode),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    t_part0 = time.perf_counter()
+    s = Solver(model, cfg, mesh=make_mesh(), n_parts=n_parts)
+    t_part = time.perf_counter() - t_part0
+
+    # Warm-up: compile + first solve.
+    r0 = s.step(1.0)
+    print(f"# warm solve: flag={r0.flag} iters={r0.iters} "
+          f"relres={r0.relres:.3e} wall={r0.wall_s:.2f}s (incl. compile); "
+          f"partition {t_part:.2f}s", file=sys.stderr)
+
+    # Measured solve from scratch state (compile cached).
+    s.reset_state()
+    r1 = s.step(1.0)
+    iters = max(r1.iters, 1)
+    tpu_per_iter = r1.wall_s / iters
+    print(f"# timed solve: flag={r1.flag} iters={iters} "
+          f"relres={r1.relres:.3e} wall={r1.wall_s:.3f}s "
+          f"-> {tpu_per_iter*1e3:.3f} ms/iter", file=sys.stderr)
+
+    # Baseline: numpy reference per-iteration cost on this host.
+    ref = NumpyRefSolver(model)
+    ref_per_iter = ref.time_per_iter(n_iters=int(os.environ.get("BENCH_REF_ITERS", 20)))
+    print(f"# numpy ref: {ref_per_iter*1e3:.3f} ms/iter "
+          f"(x{ref_per_iter/tpu_per_iter:.1f} slower than accelerator)",
+          file=sys.stderr)
+
+    dof_iters_per_sec = model.n_dof * iters / r1.wall_s
+    # idealized 8-rank reference: perfect 8x scaling of the numpy backend
+    baseline_dof_iters_per_sec = model.n_dof / (ref_per_iter / 8.0)
+    vs_baseline = dof_iters_per_sec / baseline_dof_iters_per_sec
+
+    print(json.dumps({
+        "metric": "pcg_dof_iterations_per_second",
+        "value": round(dof_iters_per_sec, 1),
+        "unit": "dof*iter/s",
+        "vs_baseline": round(vs_baseline, 3),
+        "detail": {
+            "n_dof": model.n_dof,
+            "iters": int(iters),
+            "flag": int(r1.flag),
+            "relres": float(r1.relres),
+            "solve_wall_s": round(r1.wall_s, 4),
+            "tpu_ms_per_iter": round(tpu_per_iter * 1e3, 4),
+            "numpy_ref_ms_per_iter": round(ref_per_iter * 1e3, 4),
+            "baseline_model": "numpy backend / 8 (ideal 8-rank mpi4py stand-in)",
+            "dtype": dtype,
+            "n_parts": n_parts,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,213 @@
+"""Command-line interface: the reference's five entry-point programs
+(read_input_model / run_metis / partition_mesh / pcg_solver / export_vtk,
+orchestrated by examples/run_basic_script.bash) as one typed CLI.
+
+    pcg-tpu ingest    <archive.zip> <scratch>          # unpack MDF bundle
+    pcg-tpu partition <scratch> <n_parts>              # element->part map
+    pcg-tpu solve     <scratch> <run_id> [options]     # SPMD PCG solve
+    pcg-tpu export    <scratch> <run_id> <vars> <mode> # frames -> .vtu
+    pcg-tpu demo      [--nx ...]                       # synthetic end-to-end
+    pcg-tpu bench                                      # benchmark harness
+
+Settings come from ``--settings settings.json`` (same shape as the
+reference's GlobSettings: TimeHistoryParam/SolverParam,
+run_basic_script.bash:30-49) or per-flag overrides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+
+def _load_settings(path, args) -> "RunConfig":
+    from pcg_mpi_solver_tpu.config import RunConfig, SolverConfig, TimeHistoryConfig
+
+    th, sp = {}, {}
+    if path and os.path.exists(path):
+        with open(path) as f:
+            raw = json.load(f)
+        th = raw.get("TimeHistoryParam", {})
+        sp = raw.get("SolverParam", {})
+    # default precision is "direct" (f64, reference parity) — a reference-
+    # shaped settings file without PrecisionMode must not change numerics;
+    # pass --precision mixed (or PrecisionMode) for the TPU performance path.
+    solver = SolverConfig(
+        tol=float(getattr(args, "tol", None) or sp.get("Tol", 1e-7)),
+        max_iter=int(getattr(args, "max_iter", None) or sp.get("MaxIter", 10000)),
+        precision_mode=getattr(args, "precision", None) or sp.get("PrecisionMode", "direct"),
+    )
+    time_history = TimeHistoryConfig(
+        time_step_delta=th.get("TimeStepDelta", [0.0, 1.0]),
+        export_flag=bool(th.get("ExportFlag", True)),
+        export_frame_rate=int(th.get("ExportFrmRate", 1)),
+        export_frames=th.get("ExportFrms", []),
+        plot_flag=bool(th.get("PlotFlag", False)),
+        export_vars=th.get("ExportVars", "U"),
+    )
+    return RunConfig(solver=solver, time_history=time_history)
+
+
+def cmd_ingest(args):
+    from pcg_mpi_solver_tpu.models.mdf import ingest_archive, read_mdf
+
+    mdf = ingest_archive(args.archive, args.scratch)
+    model = read_mdf(mdf)
+    print(f">extracted to {mdf}")
+    print(f">elements:  {model.n_elem}")
+    print(f">nodes:     {model.n_node}")
+    print(f">dofs:      {model.n_dof}")
+
+
+def cmd_partition(args):
+    from pcg_mpi_solver_tpu.models.mdf import read_mdf
+    from pcg_mpi_solver_tpu.parallel.partition import rcb_partition
+
+    model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    print(f">partitioning {model.n_elem} elements into {args.n_parts} parts..")
+    part = rcb_partition(model.sctrs, args.n_parts)
+    out = os.path.join(args.scratch, "ModelData", f"MeshPart_{args.n_parts}.npy")
+    np.save(out, part)
+    print(f">saved {out}")
+
+
+def cmd_solve(args):
+    import jax
+
+    from pcg_mpi_solver_tpu.models.mdf import read_mdf
+    from pcg_mpi_solver_tpu.solver.driver import Solver
+    from pcg_mpi_solver_tpu.utils.io import RunStore
+
+    cfg = _load_settings(args.settings, args)
+    cfg.scratch_path = args.scratch
+    cfg.run_id = args.run_id
+    cfg.speed_test = bool(args.speed_test)
+    model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    cfg.time_history.dt = model.dt   # frame timestamps follow the model's dt
+    n_dev = len(jax.devices())
+    n_parts = args.n_parts or n_dev
+
+    part_file = os.path.join(args.scratch, "ModelData", f"MeshPart_{n_parts}.npy")
+    elem_part = np.load(part_file) if os.path.exists(part_file) else None
+
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+
+    # use as many devices as divide n_parts
+    n_dev_used = n_dev if n_parts % n_dev == 0 else max(
+        d for d in range(1, min(n_dev, n_parts) + 1) if n_parts % d == 0)
+    print(f">solving on {n_dev_used}/{n_dev} device(s), {n_parts} parts "
+          f"({cfg.solver.precision_mode} precision)..")
+    s = Solver(model, cfg, mesh=make_mesh(n_dev_used), n_parts=n_parts,
+               elem_part=elem_part)
+    store = RunStore(cfg.result_path, cfg.model_name)
+    res = s.solve(store=None if cfg.speed_test else store)
+    for t, r in enumerate(res, 1):
+        print(f">step {t}: flag={r.flag} iters={r.iters} relres={r.relres:.3e} "
+              f"wall={r.wall_s:.2f}s")
+    td = s.time_data()
+    print(f">calculation time: {td['Mean_CalcTime']:.2f} sec")
+    print(">success!")
+
+
+def cmd_export(args):
+    from pcg_mpi_solver_tpu.models.mdf import read_mdf
+    from pcg_mpi_solver_tpu.utils.io import RunStore
+    from pcg_mpi_solver_tpu.vtk.export import export_vtk
+
+    from pcg_mpi_solver_tpu.config import RunConfig
+
+    model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    cfg = RunConfig(scratch_path=args.scratch, run_id=args.run_id)
+    store = RunStore(cfg.result_path, "model")
+    files = export_vtk(model, store, args.vars.split(), args.mode)
+    print(f">wrote {len(files)} vtu files to {store.vtk_path}")
+
+
+def cmd_demo(args):
+    from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+    from pcg_mpi_solver_tpu.solver.driver import Solver
+    from pcg_mpi_solver_tpu.utils.io import RunStore
+    from pcg_mpi_solver_tpu.vtk.export import export_vtk
+
+    cfg = _load_settings(args.settings, args)
+    cfg.scratch_path = args.scratch
+    cfg.model_name = "demo_cube"
+    cfg.time_history.export_vars = "U D ES PS PE"
+    model = make_cube_model(args.nx, args.ny or 0, args.nz or 0,
+                            E=30e9, nu=0.2, load="traction", load_value=1e6,
+                            heterogeneous=True)
+    print(f">demo model: {model.n_elem} elems / {model.n_dof} dofs")
+    s = Solver(model, cfg)
+    store = RunStore(cfg.result_path, cfg.model_name)
+    res = s.solve(store=store)
+    for t, r in enumerate(res, 1):
+        print(f">step {t}: flag={r.flag} iters={r.iters} relres={r.relres:.3e} "
+              f"wall={r.wall_s:.2f}s  [{s.backend} backend]")
+    files = export_vtk(model, store, ["U", "PS1", "PS3", "ES"], "Full")
+    print(f">wrote {len(files)} vtu files to {store.vtk_path}")
+    print(">success!")
+
+
+def cmd_bench(args):
+    from pcg_mpi_solver_tpu.bench import main as bench_main
+
+    bench_main()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="pcg-tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("ingest", help="unpack a reference MDF model archive")
+    p.add_argument("archive")
+    p.add_argument("scratch")
+    p.set_defaults(fn=cmd_ingest)
+
+    p = sub.add_parser("partition", help="compute element->part map")
+    p.add_argument("scratch")
+    p.add_argument("n_parts", type=int)
+    p.set_defaults(fn=cmd_partition)
+
+    p = sub.add_parser("solve", help="run the SPMD PCG solve")
+    p.add_argument("scratch")
+    p.add_argument("run_id")
+    p.add_argument("--settings", default=None)
+    p.add_argument("--n-parts", type=int, default=None)
+    p.add_argument("--tol", type=float, default=None)
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--precision", choices=["direct", "mixed"], default=None)
+    p.add_argument("--speed-test", action="store_true",
+                   help="disable all exports for clean timing "
+                        "(reference SpeedTestFlag)")
+    p.set_defaults(fn=cmd_solve)
+
+    p = sub.add_parser("export", help="export result frames to VTK")
+    p.add_argument("scratch")
+    p.add_argument("run_id")
+    p.add_argument("vars", help='e.g. "U PS1 ES"')
+    p.add_argument("mode", choices=["Full", "Boundary", "MidSlices", "Delaunay"])
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("demo", help="synthetic end-to-end demo")
+    p.add_argument("--nx", type=int, default=16)
+    p.add_argument("--ny", type=int, default=0)
+    p.add_argument("--nz", type=int, default=0)
+    p.add_argument("--scratch", default="./scratch")
+    p.add_argument("--settings", default=None)
+    p.add_argument("--tol", type=float, default=None)
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--precision", choices=["direct", "mixed"], default="mixed")
+    p.set_defaults(fn=cmd_demo)
+
+    p = sub.add_parser("bench", help="benchmark harness (prints one JSON line)")
+    p.set_defaults(fn=cmd_bench)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,239 @@
+"""Reader/writer for the reference's Model Definition Files (MDF) bundle.
+
+A user of the reference brings models as a zip of binary arrays + .mat files
+(produced by its offline MATLAB meshing pipeline).  Schema, with reference
+citations:
+
+- ``GlobN.mat`` Data[0..8] = [NElem, NDof, NDofGlbFlat, NNodeGlbFlat,
+  NDofEff, NFacesFlat, NFaces, NPolysFlat, NFixedDof] (run_metis.py:19-34)
+- per-element CSR-ish arrays with INCLUSIVE [start, end] offset pairs
+  (partition_mesh.py:172-175, slices ``flat[o[i,0]:o[i,1]+1]`` :246-254):
+  ``NodeGlbFlat.bin`` int32 + ``NodeGlbOffset.bin`` int64 (N,2) F-order;
+  ``DofGlbFlat``/``DofGlbOffset``; ``SignFlat`` int8 + ``SignOffset``;
+  ``Type`` int32, ``Level/Ck/Cm/Ce`` f64, ``PolyMat`` int32,
+  ``sctrs`` f64 (N,3) F-order, ``StrsGlb``/``StrsSign`` int8 (N,6)
+- nodal arrays (partition_mesh.py:324-330): ``DiagM/F/Ud/Vd/NodeCoordVec``
+  f64 (NDof,) — NodeCoordVec holds each dof's node coordinate for that
+  dof's axis (x for dof 3n, y for 3n+1, z for 3n+2; interleaved ravel of
+  node coords, see identify_PotentialNeighbours partition_mesh.py:688-690);
+  ``DofEff``/``FixedDof`` int32 id lists
+- element library ``Ke.mat``/``Me.mat`` Data = per-type dense matrices
+  (partition_mesh.py:543-547); ``MatProp.mat`` struct array E/Pos/Rho
+  (partition_mesh.py:503-512); ``dt.mat`` scalar
+- visualization topology: ``nodes.bin`` f64 (NNode,3), ``FacesFlat.bin``
+  int32 + ``FacesOffset.bin`` int64 (NFaces,2), ``PolysFlat.bin`` int32
+  (export_vtk.py:55-70,108-112)
+
+The writer emits the same schema from a ModelData (round-trip tested), so
+synthetic models can feed the reference and vice versa.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+import numpy as np
+import scipy.io
+
+from pcg_mpi_solver_tpu.models.element import unit_element_library
+from pcg_mpi_solver_tpu.models.model_data import ModelData
+
+
+def _offsets_to_csr(flat, offset2):
+    """Inclusive [start,end] pairs -> (contiguous flat, n+1 exclusive offsets)."""
+    starts = offset2[:, 0]
+    ends = offset2[:, 1] + 1
+    lens = ends - starts
+    csr_offset = np.concatenate([[0], np.cumsum(lens)])
+    # re-pack (slices may in principle be non-contiguous in the source)
+    if np.array_equal(starts, csr_offset[:-1]):
+        packed = flat[: csr_offset[-1]]
+    else:
+        packed = np.concatenate([flat[s:e] for s, e in zip(starts, ends)])
+    return packed, csr_offset
+
+
+def _csr_to_offsets(offset):
+    """n+1 exclusive offsets -> inclusive [start, end] int64 pairs."""
+    return np.stack([offset[:-1], offset[1:] - 1], axis=1).astype(np.int64)
+
+
+def read_mdf(mdf_path: str) -> ModelData:
+    p = lambda name: os.path.join(mdf_path, name)
+    glob_n = scipy.io.loadmat(p("GlobN.mat"))["Data"][0]
+    n_elem = int(glob_n[0])
+    n_dof = int(glob_n[1])
+    n_node = n_dof // 3
+    n_dof_flat = int(glob_n[2])
+    n_node_flat = int(glob_n[3])
+    n_dof_eff = int(glob_n[4])
+    n_fixed = int(glob_n[8])
+
+    def bin_(name, dtype, shape=None, order="C"):
+        a = np.fromfile(p(name + ".bin"), dtype=dtype)
+        if shape is not None:
+            a = a.reshape(shape, order=order)
+        return a
+
+    node_flat = bin_("NodeGlbFlat", np.int32)[:n_node_flat].astype(np.int64)
+    node_off2 = bin_("NodeGlbOffset", np.int64, (n_elem, 2), "F")
+    dof_flat = bin_("DofGlbFlat", np.int32)[:n_dof_flat].astype(np.int64)
+    dof_off2 = bin_("DofGlbOffset", np.int64, (n_elem, 2), "F")
+    sign_flat = bin_("SignFlat", np.int8)[:n_dof_flat].astype(bool)
+    sign_off2 = bin_("SignOffset", np.int64, (n_elem, 2), "F")
+
+    nodes_flat, nodes_offset = _offsets_to_csr(node_flat, node_off2)
+    dofs_flat, dofs_offset = _offsets_to_csr(dof_flat, dof_off2)
+    signs_flat, signs_offset = _offsets_to_csr(sign_flat, sign_off2)
+    if not np.array_equal(signs_offset, dofs_offset):
+        raise ValueError("SignOffset inconsistent with DofGlbOffset")
+
+    elem_type = bin_("Type", np.int32)[:n_elem]
+    level = bin_("Level", np.float64)[:n_elem]
+    ck = bin_("Ck", np.float64)[:n_elem]
+    cm = bin_("Cm", np.float64)[:n_elem]
+    ce = bin_("Ce", np.float64)[:n_elem]
+    poly_mat = bin_("PolyMat", np.int32)[:n_elem]
+    sctrs = bin_("sctrs", np.float64, (n_elem, 3), "F")
+
+    diag_m = bin_("DiagM", np.float64)[:n_dof]
+    F = bin_("F", np.float64)[:n_dof]
+    Ud = bin_("Ud", np.float64)[:n_dof]
+    Vd = bin_("Vd", np.float64)[:n_dof]
+    dof_eff = bin_("DofEff", np.int32)[:n_dof_eff].astype(np.int64)
+    fixed_dof = bin_("FixedDof", np.int32)[:n_fixed].astype(np.int64)
+
+    if os.path.exists(p("nodes.bin")):
+        node_coords = bin_("nodes", np.float64).reshape(n_node, 3)
+    else:
+        node_coords = bin_("NodeCoordVec", np.float64)[:n_dof].reshape(n_node, 3)
+
+    # element library
+    Ke = scipy.io.loadmat(p("Ke.mat"))["Data"][0]
+    Me = scipy.io.loadmat(p("Me.mat"))["Data"][0] if os.path.exists(p("Me.mat")) else None
+    Se = scipy.io.loadmat(p("Se.mat"))["Data"][0] if os.path.exists(p("Se.mat")) else None
+    elem_lib = {}
+    for t in range(len(Ke)):
+        Ket = np.asarray(Ke[t], float)
+        elem_lib[t] = {
+            "Ke": Ket,
+            "diagKe": np.diag(Ket).copy(),
+            "Me": np.asarray(Me[t], float) if Me is not None else None,
+            "Se": np.asarray(Se[t], float) if Se is not None else None,
+            "n_nodes": Ket.shape[0] // 3,
+        }
+
+    mat_raw = scipy.io.loadmat(p("MatProp.mat"), struct_as_record=False)["Data"][0]
+    mat_prop = []
+    for m in mat_raw:
+        d = m.__dict__
+        mat_prop.append({"E": float(d["E"][0][0]), "Pos": float(d["Pos"][0][0]),
+                         "Rho": float(d["Rho"][0][0])})
+
+    dt = float(scipy.io.loadmat(p("dt.mat"))["Data"][0][0]) \
+        if os.path.exists(p("dt.mat")) else 1.0
+
+    faces_flat = faces_offset = None
+    if os.path.exists(p("FacesFlat.bin")):
+        n_faces = int(glob_n[6])
+        ff = bin_("FacesFlat", np.int32)[: int(glob_n[5])].astype(np.int64)
+        fo2 = bin_("FacesOffset", np.int64, (n_faces, 2), "F")
+        faces_flat, faces_offset = _offsets_to_csr(ff, fo2)
+
+    return ModelData(
+        n_elem=n_elem, n_node=n_node, n_dof=n_dof,
+        node_coords=node_coords, F=F, Ud=Ud, Vd=Vd, diag_M=diag_m,
+        fixed_dof=fixed_dof, dof_eff=dof_eff,
+        elem_type=elem_type,
+        elem_nodes_flat=nodes_flat, elem_nodes_offset=nodes_offset,
+        elem_dofs_flat=dofs_flat, elem_dofs_offset=dofs_offset,
+        elem_sign_flat=signs_flat,
+        ck=ck, cm=cm, ce=ce, level=level, poly_mat=poly_mat, sctrs=sctrs,
+        elem_lib=elem_lib, mat_prop=mat_prop, dt=dt,
+        faces_flat=faces_flat, faces_offset=faces_offset,
+    )
+
+
+def write_mdf(model: ModelData, mdf_path: str) -> str:
+    """Write a ModelData in the reference's MDF schema."""
+    os.makedirs(mdf_path, exist_ok=True)
+    p = lambda name: os.path.join(mdf_path, name)
+
+    n_faces = 0 if model.faces_offset is None else len(model.faces_offset) - 1
+    n_faces_flat = 0 if model.faces_flat is None else len(model.faces_flat)
+    glob_n = np.array([
+        model.n_elem, model.n_dof, len(model.elem_dofs_flat),
+        len(model.elem_nodes_flat), len(model.dof_eff), n_faces_flat,
+        n_faces, n_faces, len(model.fixed_dof),
+    ], dtype=np.float64)
+    scipy.io.savemat(p("GlobN.mat"), {"Data": glob_n})
+    scipy.io.savemat(p("dt.mat"), {"Data": np.array([model.dt])})
+
+    model.elem_nodes_flat.astype(np.int32).tofile(p("NodeGlbFlat.bin"))
+    _csr_to_offsets(model.elem_nodes_offset).ravel(order="F").tofile(p("NodeGlbOffset.bin"))
+    model.elem_dofs_flat.astype(np.int32).tofile(p("DofGlbFlat.bin"))
+    _csr_to_offsets(model.elem_dofs_offset).ravel(order="F").tofile(p("DofGlbOffset.bin"))
+    model.elem_sign_flat.astype(np.int8).tofile(p("SignFlat.bin"))
+    _csr_to_offsets(model.elem_dofs_offset).ravel(order="F").tofile(p("SignOffset.bin"))
+
+    model.elem_type.astype(np.int32).tofile(p("Type.bin"))
+    model.level.astype(np.float64).tofile(p("Level.bin"))
+    model.ck.astype(np.float64).tofile(p("Ck.bin"))
+    model.cm.astype(np.float64).tofile(p("Cm.bin"))
+    model.ce.astype(np.float64).tofile(p("Ce.bin"))
+    model.poly_mat.astype(np.int32).tofile(p("PolyMat.bin"))
+    np.asfortranarray(model.sctrs).ravel(order="F").tofile(p("sctrs.bin"))
+    np.zeros((model.n_elem, 6), np.int8).ravel(order="F").tofile(p("StrsGlb.bin"))
+    np.zeros((model.n_elem, 6), np.int8).ravel(order="F").tofile(p("StrsSign.bin"))
+
+    model.diag_M.astype(np.float64).tofile(p("DiagM.bin"))
+    model.F.astype(np.float64).tofile(p("F.bin"))
+    model.Ud.astype(np.float64).tofile(p("Ud.bin"))
+    model.Vd.astype(np.float64).tofile(p("Vd.bin"))
+    model.node_coords.astype(np.float64).ravel().tofile(p("NodeCoordVec.bin"))
+    model.dof_eff.astype(np.int32).tofile(p("DofEff.bin"))
+    model.fixed_dof.astype(np.int32).tofile(p("FixedDof.bin"))
+    model.node_coords.astype(np.float64).tofile(p("nodes.bin"))
+
+    type_ids = sorted(model.elem_lib.keys())
+    ke_arr = np.empty(len(type_ids), dtype=object)
+    me_arr = np.empty(len(type_ids), dtype=object)
+    se_arr = np.empty(len(type_ids), dtype=object)
+    for i, t in enumerate(type_ids):
+        lib = model.elem_lib[t]
+        ke_arr[i] = np.asarray(lib["Ke"], float)
+        me_arr[i] = np.asarray(lib["Me"] if lib.get("Me") is not None
+                               else np.zeros_like(lib["Ke"]), float)
+        se_arr[i] = np.asarray(lib["Se"] if lib.get("Se") is not None
+                               else np.zeros((6, lib["Ke"].shape[0])), float)
+    scipy.io.savemat(p("Ke.mat"), {"Data": ke_arr.reshape(1, -1)})
+    scipy.io.savemat(p("Me.mat"), {"Data": me_arr.reshape(1, -1)})
+    scipy.io.savemat(p("Se.mat"), {"Data": se_arr.reshape(1, -1)})
+
+    dtype = [("E", object), ("Pos", object), ("Rho", object)]
+    rec = np.zeros((1, len(model.mat_prop)), dtype=dtype)
+    for i, m in enumerate(model.mat_prop):
+        rec[0, i] = (np.array([[m["E"]]]), np.array([[m["Pos"]]]),
+                     np.array([[m["Rho"]]]))
+    scipy.io.savemat(p("MatProp.mat"), {"Data": rec})
+
+    if model.faces_flat is not None:
+        model.faces_flat.astype(np.int32).tofile(p("FacesFlat.bin"))
+        _csr_to_offsets(model.faces_offset).ravel(order="F").tofile(p("FacesOffset.bin"))
+        # PolysFlat holds per-cell face-id incidence; faces occurring once are
+        # boundary (reference export_vtk.py:112 bincounts |ids| 0-based).  Our
+        # stored faces are all boundary, so each id appears exactly once.
+        np.arange(n_faces, dtype=np.int32).tofile(p("PolysFlat.bin"))
+    return mdf_path
+
+
+def ingest_archive(archive_path: str, scratch_path: str,
+                   model_name: Optional[str] = None) -> str:
+    """Unpack a model archive into <scratch>/ModelData/MDF (reference
+    read_input_model.py:23-39) and return the MDF path."""
+    mdf_path = os.path.join(scratch_path, "ModelData", "MDF")
+    os.makedirs(mdf_path, exist_ok=True)
+    shutil.unpack_archive(archive_path, mdf_path)
+    return mdf_path

@@ -1,0 +1,58 @@
+"""CLI end-to-end: ingest -> partition -> solve -> export on a synthetic
+model written in the reference's MDF format."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.cli import main
+from pcg_mpi_solver_tpu.models.mdf import write_mdf
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+
+
+def test_cli_full_pipeline(tmp_path, capsys):
+    model = make_cube_model(4, 4, 4, load="traction", heterogeneous=True)
+    src = tmp_path / "src"
+    write_mdf(model, str(src))
+    archive = shutil.make_archive(str(tmp_path / "cube"), "zip", src)
+    scratch = str(tmp_path / "scratch")
+
+    main(["ingest", archive, scratch])
+    out = capsys.readouterr().out
+    assert f">dofs:      {model.n_dof}" in out
+
+    main(["partition", scratch, "2"])
+    assert os.path.exists(f"{scratch}/ModelData/MeshPart_2.npy")
+
+    main(["solve", scratch, "1", "--n-parts", "2", "--tol", "1e-8",
+          "--precision", "direct"])
+    out = capsys.readouterr().out
+    assert "flag=0" in out and ">success!" in out
+    assert os.path.exists(f"{scratch}/Results_Run1/ResVecData/U_1.npy")
+
+    main(["export", scratch, "1", "U", "Full"])
+    out = capsys.readouterr().out
+    assert "vtu files" in out
+    assert os.path.exists(f"{scratch}/Results_Run1/VTKs/VTKInfo.txt")
+
+
+def test_cli_demo(tmp_path, capsys):
+    main(["demo", "--nx", "4", "--scratch", str(tmp_path / "s"),
+          "--tol", "1e-7", "--precision", "direct"])
+    out = capsys.readouterr().out
+    assert ">success!" in out and "flag=0" in out
+
+
+def test_cli_speed_test_no_exports(tmp_path, capsys):
+    model = make_cube_model(4, 4, 4)
+    src = tmp_path / "src"
+    write_mdf(model, str(src))
+    archive = shutil.make_archive(str(tmp_path / "cube"), "zip", src)
+    scratch = str(tmp_path / "scratch")
+    main(["ingest", archive, scratch])
+    main(["solve", scratch, "2", "--n-parts", "1", "--speed-test",
+          "--precision", "direct"])
+    capsys.readouterr()
+    assert not os.path.exists(f"{scratch}/Results_Run2_SpeedTest/ResVecData/U_1.npy")
