@@ -23,6 +23,10 @@ citations:
 - visualization topology: ``nodes.bin`` f64 (NNode,3), ``FacesFlat.bin``
   int32 + ``FacesOffset.bin`` int64 (NFaces,2), ``PolysFlat.bin`` int32
   (export_vtk.py:55-70,108-112)
+- ``Intfc.npz`` (OUR schema extension, absent from the reference): cohesive
+  interface elements — the reference keeps these only inside its partition
+  pickles (partition_mesh.py:603-650), so they have no MDF representation
+  to mirror
 
 The writer emits the same schema from a ModelData (round-trip tested), so
 synthetic models can feed the reference and vice versa.
@@ -129,8 +133,17 @@ def read_mdf(mdf_path: str) -> ModelData:
     mat_prop = []
     for m in mat_raw:
         d = m.__dict__
-        mat_prop.append({"E": float(d["E"][0][0]), "Pos": float(d["Pos"][0][0]),
-                         "Rho": float(d["Rho"][0][0])})
+        entry = {"E": float(d["E"][0][0]), "Pos": float(d["Pos"][0][0]),
+                 "Rho": float(d["Rho"][0][0])}
+        if "NonLocStressParam" in d:
+            # alternating [key, value, ...] cell array, exactly the layout the
+            # reference parses (partition_mesh.py:515-520)
+            raw = d["NonLocStressParam"][0]
+            nl = {str(raw[2 * i][0]): float(raw[2 * i + 1][0][0])
+                  for i in range(len(raw) // 2)}
+            if nl:
+                entry["NonLocStressParam"] = nl
+        mat_prop.append(entry)
 
     dt = float(scipy.io.loadmat(p("dt.mat"))["Data"][0][0]) \
         if os.path.exists(p("dt.mat")) else 1.0
@@ -141,6 +154,19 @@ def read_mdf(mdf_path: str) -> ModelData:
         ff = bin_("FacesFlat", np.int32)[: int(glob_n[5])].astype(np.int64)
         fo2 = bin_("FacesOffset", np.int64, (n_faces, 2), "F")
         faces_flat, faces_offset = _offsets_to_csr(ff, fo2)
+
+    intfc_elems = None
+    if os.path.exists(p("Intfc.npz")):
+        with np.load(p("Intfc.npz")) as z:
+            # bind each member once: NpzFile re-reads the whole array per access
+            nid, adj = z["node_id_list"], z["adj_elem"]
+            kn, kt, area, nax = z["kn"], z["kt"], z["area"], z["normal_axis"]
+        intfc_elems = [
+            {"NodeIdList": nid[i], "adj_elem": int(adj[i]),
+             "kn": float(kn[i]), "kt": float(kt[i]),
+             "area": float(area[i]), "normal_axis": int(nax[i])}
+            for i in range(len(adj))
+        ]
 
     return ModelData(
         n_elem=n_elem, n_node=n_node, n_dof=n_dof,
@@ -153,6 +179,7 @@ def read_mdf(mdf_path: str) -> ModelData:
         ck=ck, cm=cm, ce=ce, level=level, poly_mat=poly_mat, sctrs=sctrs,
         elem_lib=elem_lib, mat_prop=mat_prop, dt=dt,
         faces_flat=faces_flat, faces_offset=faces_offset,
+        intfc_elems=intfc_elems,
     )
 
 
@@ -212,11 +239,17 @@ def write_mdf(model: ModelData, mdf_path: str) -> str:
     scipy.io.savemat(p("Me.mat"), {"Data": me_arr.reshape(1, -1)})
     scipy.io.savemat(p("Se.mat"), {"Data": se_arr.reshape(1, -1)})
 
-    dtype = [("E", object), ("Pos", object), ("Rho", object)]
+    dtype = [("E", object), ("Pos", object), ("Rho", object),
+             ("NonLocStressParam", object)]
     rec = np.zeros((1, len(model.mat_prop)), dtype=dtype)
     for i, m in enumerate(model.mat_prop):
+        nl = m.get("NonLocStressParam", {})
+        nl_arr = np.empty((1, 2 * len(nl)), dtype=object)
+        for j, (key, val) in enumerate(nl.items()):
+            nl_arr[0, 2 * j] = np.array([key])
+            nl_arr[0, 2 * j + 1] = np.array([[val]])
         rec[0, i] = (np.array([[m["E"]]]), np.array([[m["Pos"]]]),
-                     np.array([[m["Rho"]]]))
+                     np.array([[m["Rho"]]]), nl_arr)
     scipy.io.savemat(p("MatProp.mat"), {"Data": rec})
 
     if model.faces_flat is not None:
@@ -226,6 +259,20 @@ def write_mdf(model: ModelData, mdf_path: str) -> str:
         # boundary (reference export_vtk.py:112 bincounts |ids| 0-based).  Our
         # stored faces are all boundary, so each id appears exactly once.
         np.arange(n_faces, dtype=np.int32).tofile(p("PolysFlat.bin"))
+
+    if not model.intfc_elems and os.path.exists(p("Intfc.npz")):
+        os.remove(p("Intfc.npz"))   # never leave stale interfaces behind
+    if model.intfc_elems:
+        ie = model.intfc_elems
+        np.savez(
+            p("Intfc.npz"),
+            node_id_list=np.stack([np.asarray(e["NodeIdList"]) for e in ie]),
+            adj_elem=np.array([e["adj_elem"] for e in ie], dtype=np.int64),
+            kn=np.array([e["kn"] for e in ie]),
+            kt=np.array([e["kt"] for e in ie]),
+            area=np.array([e["area"] for e in ie]),
+            normal_axis=np.array([e["normal_axis"] for e in ie], dtype=np.int32),
+        )
     return mdf_path
 
 

@@ -69,6 +69,19 @@ class ModelData:
     # (parallel/structured.py); None for general octree/unstructured models.
     grid: Optional[tuple] = None
 
+    # Cohesive interface elements (reference type -1/-2 scaffolding,
+    # partition_mesh.py:603-650 — built there but never solved with; here the
+    # capability is live).  Each entry is a zero-thickness 4+4-node quad:
+    #   {'NodeIdList': (2, 4) int  — [side-a nodes, side-b nodes], pairwise
+    #                   coincident,
+    #    'adj_elem':   int         — a volume element adjacent to side a
+    #                                (anchors partitioning),
+    #    'kn': float, 'kt': float  — normal/tangential penalty stiffness per
+    #                                unit area,
+    #    'area': float,            — interface element area
+    #    'normal_axis': int}       — 0/1/2 (octree interfaces are axis-aligned)
+    intfc_elems: Optional[List[dict]] = None
+
     def elem_nodes(self, e: int) -> np.ndarray:
         return self.elem_nodes_flat[self.elem_nodes_offset[e]:self.elem_nodes_offset[e + 1]]
 
@@ -77,6 +90,32 @@ class ModelData:
 
     def elem_signs(self, e: int) -> np.ndarray:
         return self.elem_sign_flat[self.elem_dofs_offset[e]:self.elem_dofs_offset[e + 1]]
+
+    # ------------------------------------------------------------------
+    # Interface springs: flattened node-pair penalty form
+    # ------------------------------------------------------------------
+    def interface_springs(self):
+        """Flatten interface elements to per-dof penalty springs.
+
+        Each coincident node pair contributes, per component c, a spring of
+        stiffness k_c = area/4 * (kn if c == normal_axis else kt) acting on
+        the jump u_a - u_b.  Returns (dof_a, dof_b, k, adj_elem) flat arrays
+        (empty if the model has no interface elements)."""
+        if not self.intfc_elems:
+            z = np.zeros(0, dtype=np.int64)
+            return z, z, np.zeros(0), z
+        dof_a, dof_b, k, adj = [], [], [], []
+        for ie in self.intfc_elems:
+            nodes = np.asarray(ie["NodeIdList"])
+            per_pair = ie["area"] / nodes.shape[1]
+            for c in range(3):
+                kc = per_pair * (ie["kn"] if c == ie["normal_axis"] else ie["kt"])
+                dof_a.append(3 * nodes[0] + c)
+                dof_b.append(3 * nodes[1] + c)
+                k.append(np.full(nodes.shape[1], kc))
+                adj.append(np.full(nodes.shape[1], ie["adj_elem"], dtype=np.int64))
+        return (np.concatenate(dof_a), np.concatenate(dof_b),
+                np.concatenate(k), np.concatenate(adj))
 
     # ------------------------------------------------------------------
     # Validation helpers (test oracle): dense/sparse global assembly.
@@ -100,6 +139,11 @@ class ModelData:
             rows.append(np.repeat(dofs, d))
             cols.append(np.tile(dofs, d))
             vals.append(Ke_e.ravel())
+        sa, sb, sk, _ = self.interface_springs()
+        if len(sa):
+            rows.append(np.concatenate([sa, sb, sa, sb]))
+            cols.append(np.concatenate([sa, sb, sb, sa]))
+            vals.append(np.concatenate([sk, sk, -sk, -sk]))
         K = coo_matrix(
             (np.concatenate(vals), (np.concatenate(rows), np.concatenate(cols))),
             shape=(self.n_dof, self.n_dof),
@@ -113,4 +157,8 @@ class ModelData:
             dofs = self.elem_dofs(e)
             dK = self.elem_lib[int(self.elem_type[e])]["diagKe"]
             np.add.at(diag, dofs, self.ck[e] * dK)
+        sa, sb, sk, _ = self.interface_springs()
+        if len(sa):
+            np.add.at(diag, sa, sk)
+            np.add.at(diag, sb, sk)
         return diag

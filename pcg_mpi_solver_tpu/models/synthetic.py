@@ -91,14 +91,16 @@ def make_cube_model(
         for c, r in zip(c_incl, r_incl):
             E_elem[np.linalg.norm(centers - c, axis=1) < r] = 10.0 * E
         mat = np.where(E_elem > E, 1, 0).astype(np.int32)
+        # NonLocStressParam mirrors the reference MatProp schema
+        # (partition_mesh.py:515-520); Lc is the nonlocal length scale.
         mat_prop = [
-            {"E": E, "Pos": nu, "Rho": rho},
-            {"E": 10.0 * E, "Pos": nu, "Rho": rho},
+            {"E": E, "Pos": nu, "Rho": rho, "NonLocStressParam": {"Lc": 2.0 * h}},
+            {"E": 10.0 * E, "Pos": nu, "Rho": rho, "NonLocStressParam": {"Lc": 2.0 * h}},
         ]
     else:
         E_elem = np.full(n_elem, E)
         mat = np.zeros(n_elem, dtype=np.int32)
-        mat_prop = [{"E": E, "Pos": nu, "Rho": rho}]
+        mat_prop = [{"E": E, "Pos": nu, "Rho": rho, "NonLocStressParam": {"Lc": 2.0 * h}}]
 
     lib0 = unit_element_library(nu)
     elem_lib = {t: lib0 for t in range(n_types)}
@@ -162,6 +164,116 @@ def make_cube_model(
         faces_flat=faces.ravel(),
         faces_offset=np.arange(len(faces) + 1) * 4,
         grid=(nx, ny, nz, h) if n_types == 1 else None,
+    )
+
+
+def make_glued_blocks_model(
+    nx_a: int,
+    nx_b: int,
+    ny: int,
+    nz: int,
+    h: float = 1.0,
+    E: float = 1.0,
+    nu: float = 0.2,
+    rho: float = 1.0,
+    load_value: float = 1.0,
+    penalty: float = 1e3,
+    kt_factor: float = 1.0,
+) -> ModelData:
+    """Two elastic blocks stacked along x, joined by zero-thickness cohesive
+    interface elements (reference type -1/-2 scaffolding,
+    partition_mesh.py:603-650) at the shared plane.
+
+    The interface plane nodes are DUPLICATED (one set per block); each
+    interface element carries the 4+4 coincident nodes, penalty stiffnesses
+    kn = penalty*E/h (normal) and kt = kt_factor*kn (tangential) per unit
+    area, and is anchored to the adjacent block-a element for partitioning.
+    Clamped at x=0, +x traction on the far face of block b.
+    """
+    a = make_cube_model(nx_a, ny, nz, h=h, E=E, nu=nu, rho=rho,
+                        load="traction", load_value=0.0)
+    b = make_cube_model(nx_b, ny, nz, h=h, E=E, nu=nu, rho=rho,
+                        load="traction", load_value=0.0)
+    nn_a, nd_a, ne_a = a.n_node, a.n_dof, a.n_elem
+
+    coords_b = b.node_coords + np.array([nx_a * h, 0.0, 0.0])
+    n_node = nn_a + b.n_node
+    n_dof = 3 * n_node
+    n_elem = ne_a + b.n_elem
+
+    # merged element arrays (block b ids offset)
+    conn = np.concatenate([a.elem_nodes_flat, b.elem_nodes_flat + nn_a])
+    dofs = np.concatenate([a.elem_dofs_flat, b.elem_dofs_flat + nd_a])
+
+    F = np.zeros(n_dof)
+    nnx_b, nny_b = nx_b + 1, ny + 1
+    nid_b = np.arange(b.n_node)
+    far = nid_b[(nid_b % nnx_b) == nx_b]          # block-b x = L face
+    F[3 * (far + nn_a)] = load_value
+
+    fixed = a.fixed_dof                           # block-a x = 0 clamp
+    dof_eff = np.setdiff1d(np.arange(n_dof), fixed, assume_unique=True)
+
+    # interface elements on the shared plane
+    nnx_a, nny_a = nx_a + 1, ny + 1
+
+    def gid_a(i, j, k):
+        return i + nnx_a * (j + nny_a * k)
+
+    def gid_b(i, j, k):
+        return i + nnx_b * (j + nny_b * k)
+
+    kn = penalty * E / h
+    intfc = []
+    for k in range(nz):
+        for j in range(ny):
+            quad_a = np.array([gid_a(nx_a, j, k), gid_a(nx_a, j + 1, k),
+                               gid_a(nx_a, j + 1, k + 1), gid_a(nx_a, j, k + 1)])
+            quad_b = np.array([gid_b(0, j, k), gid_b(0, j + 1, k),
+                               gid_b(0, j + 1, k + 1), gid_b(0, j, k + 1)]) + nn_a
+            adj = (nx_a - 1) + nx_a * (j + ny * k)   # block-a element at the plane
+            intfc.append({
+                "NodeIdList": np.stack([quad_a, quad_b]),
+                "adj_elem": adj,
+                "kn": kn,
+                "kt": kt_factor * kn,
+                "area": h * h,
+                "normal_axis": 0,
+            })
+
+    diag_M = np.concatenate([a.diag_M, b.diag_M])
+    faces = np.concatenate([a.faces_flat, b.faces_flat + nn_a])
+
+    return ModelData(
+        n_elem=n_elem,
+        n_node=n_node,
+        n_dof=n_dof,
+        node_coords=np.concatenate([a.node_coords, coords_b]),
+        F=F,
+        Ud=np.zeros(n_dof),
+        Vd=np.zeros(n_dof),
+        diag_M=diag_M,
+        fixed_dof=fixed,
+        dof_eff=dof_eff,
+        elem_type=np.concatenate([a.elem_type, b.elem_type]),
+        elem_nodes_flat=conn,
+        elem_nodes_offset=np.arange(n_elem + 1) * 8,
+        elem_dofs_flat=dofs,
+        elem_dofs_offset=np.arange(n_elem + 1) * 24,
+        elem_sign_flat=np.zeros(n_elem * 24, dtype=bool),
+        ck=np.concatenate([a.ck, b.ck]),
+        cm=np.concatenate([a.cm, b.cm]),
+        ce=np.concatenate([a.ce, b.ce]),
+        level=np.concatenate([a.level, b.level]),
+        poly_mat=np.concatenate([a.poly_mat, b.poly_mat]),
+        sctrs=np.concatenate([a.sctrs, b.sctrs + np.array([nx_a * h, 0.0, 0.0])]),
+        elem_lib=a.elem_lib,
+        mat_prop=a.mat_prop,
+        dt=1.0,
+        faces_flat=faces,
+        faces_offset=np.arange(len(a.faces_offset) - 1 + len(b.faces_offset) - 1 + 1) * 4,
+        grid=None,
+        intfc_elems=intfc,
     )
 
 

@@ -67,6 +67,11 @@ def device_data(pm: PartitionedModel, dtype=jnp.float64) -> dict:
         "F": jnp.asarray(pm.F, dtype),
         "Ud": jnp.asarray(pm.Ud, dtype),
     }
+    if pm.spr_a is not None:
+        # cohesive interface springs (PartitionedModel spr_*)
+        d["spr_a"] = jnp.asarray(pm.spr_a, jnp.int32)
+        d["spr_b"] = jnp.asarray(pm.spr_b, jnp.int32)
+        d["spr_k"] = jnp.asarray(pm.spr_k, dtype)
     return d
 
 
@@ -144,7 +149,22 @@ class Ops:
                            precision=self.precision)
             v = jnp.where(blk["sign"], -v, v)
             flat_vals.append(v.reshape(v.shape[0], -1))
-        return self._scatter(data, jnp.concatenate(flat_vals, axis=1))
+        y = self._scatter(data, jnp.concatenate(flat_vals, axis=1))
+        if "spr_a" in data:
+            # cohesive interface springs: f_a += k*(x_a - x_b), f_b -= same
+            # (a live capability where the reference has only scaffolding,
+            # partition_mesh.py:603-650); padded entries have k = 0 and
+            # out-of-bounds ids, so they gather 0 and drop on scatter.
+            xa = jnp.take_along_axis(x, data["spr_a"], axis=1,
+                                     mode="fill", fill_value=0)
+            xb = jnp.take_along_axis(x, data["spr_b"], axis=1,
+                                     mode="fill", fill_value=0)
+            f = data["spr_k"] * (xa - xb)
+            y = jax.vmap(
+                lambda yp, ia, ib, fp: yp.at[ia].add(fp, mode="drop")
+                                         .at[ib].add(-fp, mode="drop")
+            )(y, data["spr_a"], data["spr_b"], f)
+        return y
 
     def diag_local(self, data: dict) -> jnp.ndarray:
         """Part-local diag(K) via the same scatter path
@@ -153,7 +173,13 @@ class Ops:
         for blk in data["blocks"]:
             v = blk["diag_Ke"][None, :, None] * blk["ck"][:, None, :]
             flat_vals.append(v.reshape(v.shape[0], -1))
-        return self._scatter(data, jnp.concatenate(flat_vals, axis=1))
+        y = self._scatter(data, jnp.concatenate(flat_vals, axis=1))
+        if "spr_a" in data:
+            y = jax.vmap(
+                lambda yp, ia, ib, kp: yp.at[ia].add(kp, mode="drop")
+                                         .at[ib].add(kp, mode="drop")
+            )(y, data["spr_a"], data["spr_b"], data["spr_k"])
+        return y
 
     def _scatter(self, data: dict, flat: jnp.ndarray) -> jnp.ndarray:
         """(P, NC) element-dof values -> (P, n_loc) via sorted segment_sum."""

@@ -145,6 +145,13 @@ class PartitionedModel:
 
     elem_part: np.ndarray        # (n_elem,) the element->part map used
 
+    # Cohesive interface springs (model.interface_springs), padded per part:
+    # local dof ids (n_loc padding) + stiffness (0 padding); None if the
+    # model has no interface elements.
+    spr_a: Optional[np.ndarray] = None   # (P, NS) int32
+    spr_b: Optional[np.ndarray] = None   # (P, NS) int32
+    spr_k: Optional[np.ndarray] = None   # (P, NS) float
+
 
 def _pad_to(x: np.ndarray, n: int, fill) -> np.ndarray:
     out = np.full((n,) + x.shape[1:], fill, dtype=x.dtype)
@@ -171,6 +178,11 @@ def partition_model(
     # Per-part element id lists
     part_elems = [np.where(elem_part == p)[0] for p in range(P)]
 
+    # ---- interface springs: assigned to the part of their anchor element --
+    spr_ga, spr_gb, spr_gk, spr_adj = model.interface_springs()
+    have_springs = len(spr_ga) > 0
+    spr_part = elem_part[spr_adj] if have_springs else None
+
     # ---- local dof/node renumbering per part ------------------------------
     dof_gids: List[np.ndarray] = []
     node_gids: List[np.ndarray] = []
@@ -180,6 +192,12 @@ def partition_model(
         # ragged CSR slices via offsets.
         dof_idx = _csr_take(model.elem_dofs_flat, model.elem_dofs_offset, e)
         node_idx = _csr_take(model.elem_nodes_flat, model.elem_nodes_offset, e)
+        if have_springs:
+            # both sides of a part's springs must be locally addressable;
+            # any cross-part sharing this creates is resolved by the normal
+            # interface-dof assembly (a dof in >= 2 parts is psum-combined)
+            m = spr_part == p
+            dof_idx = np.concatenate([dof_idx, spr_ga[m], spr_gb[m]])
         dof_gids.append(np.unique(dof_idx))
         node_gids.append(np.unique(node_idx))
 
@@ -322,6 +340,24 @@ def partition_model(
         scat_perm[p] = perm
         scat_ids[p] = flat[perm]
 
+    # ---- padded interface-spring arrays -----------------------------------
+    spr_a = spr_b = spr_k = None
+    if have_springs:
+        per_part = [np.where(spr_part == p)[0] for p in range(P)]
+        NS = int(max((len(s) for s in per_part), default=0))
+        NS = max(int(-(-NS // pad_multiple) * pad_multiple), 1)
+        spr_a = np.full((P, NS), n_loc, dtype=np.int32)
+        spr_b = np.full((P, NS), n_loc, dtype=np.int32)
+        spr_k = np.zeros((P, NS))
+        for p in range(P):
+            s = per_part[p]
+            ns = len(s)
+            if ns == 0:
+                continue
+            spr_a[p, :ns] = np.searchsorted(dof_gids[p], spr_ga[s])
+            spr_b[p, :ns] = np.searchsorted(dof_gids[p], spr_gb[s])
+            spr_k[p, :ns] = spr_gk[s]
+
     return PartitionedModel(
         n_parts=P,
         n_loc=n_loc,
@@ -349,6 +385,9 @@ def partition_model(
         ndof_p=ndof_p,
         nnode_p=nnode_p,
         elem_part=elem_part,
+        spr_a=spr_a,
+        spr_b=spr_b,
+        spr_k=spr_k,
     )
 
 

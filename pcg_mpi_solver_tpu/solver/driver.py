@@ -75,6 +75,7 @@ class Solver:
         can_structured = (
             model.grid is not None
             and not model.elem_sign_flat.any()
+            and not model.intfc_elems
             and n_parts == n_dev
             and model.grid[0] % n_parts == 0
         )
@@ -177,6 +178,8 @@ class Solver:
 
         self._export_fn = None
         self._nu = float(model.mat_prop[0]["Pos"]) if model.mat_prop else 0.2
+        self._model = model          # kept for host-side export paths (NS)
+        self._nonlocal = None        # lazily built nonlocal weight operator
 
         # History records (reference TimeList_*, pcg_solver.py:163-165)
         self.flags: List[int] = []
@@ -265,22 +268,41 @@ class Solver:
         k = self._export_count
         if "U" in self._export_vars():
             store.write_frame("U", k, self.displacement_owned())
-        nodal = self._nodal_vars()
+        nodal = [v for v in self._nodal_vars() if v != "NS"]
         if nodal:
             fields = self._nodal_fields()
             mask = self.node_owner_mask()
             for var, arr in fields.items():
                 store.write_frame(var, k, np.asarray(arr)[mask])
+        if "NS" in self._export_vars():
+            ns = self._nonlocal_field()
+            store.write_frame("NS", k, ns[self.export_node_map()])
         self._export_times.append(t * th.dt)
         self._export_count = k + 1
 
     def _export_vars(self):
         ev = self.config.time_history.export_vars
         return ev.split() if " " in ev else [
-            v for v in ("U", "D", "ES", "PS", "PE") if v in ev]
+            v for v in ("U", "D", "ES", "PS", "PE", "NS") if v in ev]
 
     def _nodal_vars(self):
         return [v for v in self._export_vars() if v != "U"]
+
+    def _nonlocal_field(self) -> np.ndarray:
+        """Nonlocal von-Mises stress, node-averaged, as a global (n_node,)
+        field.  Element stresses are smoothed with the Gaussian neighborhood
+        operator (reference config_NonlocalNeighbours, partition_mesh.py:
+        1000-1299 — built there, never consumed; wired end-to-end here).
+        Host-side: it is an export-path op, partition-layout agnostic."""
+        from pcg_mpi_solver_tpu.ops.nonlocal_stress import (
+            build_nonlocal_weights, elem_stress_host, nodal_average_host,
+            von_mises_stress)
+
+        if self._nonlocal is None:
+            self._nonlocal = build_nonlocal_weights(self._model)
+        sig = elem_stress_host(self._model, self.displacement_global())
+        ns = self._nonlocal.apply(von_mises_stress(sig, axis=1))
+        return nodal_average_host(self._model, ns)
 
     def _nodal_fields(self) -> dict:
         """Jitted nodal export fields of the current solution
@@ -288,7 +310,7 @@ class Solver:
         if self._export_fn is None:
             from pcg_mpi_solver_tpu.ops.stress import nodal_export_fields
 
-            nodal = tuple(self._nodal_vars())
+            nodal = tuple(v for v in self._nodal_vars() if v != "NS")
 
             def _fields(data, un):
                 data64 = data["f64"] if self.mixed else data

@@ -60,6 +60,7 @@ class NumpyRefSolver:
             })
         self.n_dof = m.n_dof
         self.eff = m.dof_eff
+        self.springs = m.interface_springs()[:3]   # (dof_a, dof_b, k)
 
     def matvec(self, x: np.ndarray) -> np.ndarray:
         y = np.zeros(self.n_dof)
@@ -69,6 +70,11 @@ class NumpyRefSolver:
             v = g["Ke"] @ (g["ck"] * u)
             v[g["signs"]] *= -1.0
             y += np.bincount(g["dofs_flat"], weights=v.ravel(), minlength=self.n_dof)
+        sa, sb, sk = self.springs
+        if len(sa):
+            f = sk * (x[sa] - x[sb])
+            np.add.at(y, sa, f)
+            np.add.at(y, sb, -f)
         return y
 
     def diag(self) -> np.ndarray:
@@ -76,6 +82,10 @@ class NumpyRefSolver:
         for g in self.groups:
             v = g["diagKe"][:, None] * g["ck"][None, :]
             y += np.bincount(g["dofs_flat"], weights=v.ravel(), minlength=self.n_dof)
+        sa, sb, sk = self.springs
+        if len(sa):
+            np.add.at(y, sa, sk)
+            np.add.at(y, sb, sk)
         return y
 
     def solve(self, delta: float = 1.0, tol: float = 1e-7, max_iter: int = 10000,

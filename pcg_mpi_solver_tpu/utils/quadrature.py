@@ -1,0 +1,47 @@
+"""Numerical integration tables (reference GaussIntegrationTable /
+GaussLobattoIntegrationTable, file_operations.py:177-247).
+
+The reference hardcodes closed-form Gauss-Legendre nodes for 1-4 points and
+Gauss-Lobatto for 2-5; here arbitrary orders come from
+``numpy.polynomial.legendre`` with the same (nodes, weights) convention on
+[-1, 1], plus a tensor-product helper for hexahedral elements.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def gauss_table(n_points: int):
+    """Gauss-Legendre nodes/weights on [-1, 1]; exact for degree 2n-1."""
+    if n_points < 1:
+        raise ValueError("n_points must be >= 1")
+    ni, wi = np.polynomial.legendre.leggauss(n_points)
+    return ni, wi
+
+
+def gauss_lobatto_table(n_points: int):
+    """Gauss-Lobatto nodes/weights on [-1, 1] (endpoints included); exact for
+    degree 2n-3.  Nodes are the roots of P'_{n-1} plus the endpoints;
+    weights w_i = 2 / (n(n-1) P_{n-1}(x_i)^2)."""
+    if n_points < 2:
+        raise ValueError("n_points must be >= 2")
+    n = n_points
+    Pn1 = np.polynomial.legendre.Legendre.basis(n - 1)
+    interior = Pn1.deriv().roots()
+    ni = np.concatenate([[-1.0], np.sort(np.real(interior)), [1.0]])
+    wi = 2.0 / (n * (n - 1) * Pn1(ni) ** 2)
+    return ni, wi
+
+
+def gauss_points_3d(n_points: int):
+    """Tensor-product Gauss rule on the reference cube [-1,1]^3.
+
+    Returns (points (n^3, 3), weights (n^3,)) — the integration layout for
+    hexahedral pattern elements."""
+    ni, wi = gauss_table(n_points)
+    X, Y, Z = np.meshgrid(ni, ni, ni, indexing="ij")
+    WX, WY, WZ = np.meshgrid(wi, wi, wi, indexing="ij")
+    pts = np.stack([X.ravel(), Y.ravel(), Z.ravel()], axis=1)
+    w = (WX * WY * WZ).ravel()
+    return pts, w

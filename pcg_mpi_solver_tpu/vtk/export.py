@@ -30,7 +30,7 @@ from pcg_mpi_solver_tpu.vtk.writer import (
     write_vtu,
 )
 
-SCALAR_VARS = ("D", "ES", "PS1", "PS2", "PS3", "PE1", "PE2", "PE3")
+SCALAR_VARS = ("D", "ES", "NS", "PS1", "PS2", "PS3", "PE1", "PE2", "PE3")
 
 
 def _faces_of(model: ModelData, mode: str):
@@ -101,21 +101,21 @@ def export_vtk(
               np.ascontiguousarray(model.node_coords[:, 1]),
               np.ascontiguousarray(model.node_coords[:, 2]))
 
+    from pcg_mpi_solver_tpu.utils.postproc import (
+        global_dof_frame, global_nodal_frame)
+
     written = []
     for i in frames:
         point_data = {}
         for var in export_vars:
-            data = store.read_frame(var, i)
             if var == "U":
-                a = np.zeros(model.n_dof, data.dtype)
-                a[dof_map] = data
+                a = global_dof_frame(store, model, i, dof_map)
                 point_data["U"] = (np.ascontiguousarray(a[0::3]),
                                    np.ascontiguousarray(a[1::3]),
                                    np.ascontiguousarray(a[2::3]))
             elif var in SCALAR_VARS:
-                a = np.zeros(model.n_node, data.dtype)
-                a[node_map] = data
-                point_data[var] = a
+                point_data[var] = global_nodal_frame(store, model, var, i,
+                                                     node_map)
             else:
                 raise ValueError(f"unknown export var {var!r}")
         path = f"{store.vtk_path}/{store.model_name}_{i}"

@@ -1,0 +1,151 @@
+"""Quadrature tables + crack-tip / time-history post-processing tests
+(reference file_operations.py:177-247, 542-787)."""
+
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+from pcg_mpi_solver_tpu.utils.io import RunStore
+from pcg_mpi_solver_tpu.utils.postproc import (
+    calc_crack_tip_velocity,
+    crack_length_and_velocity,
+    crack_tip_history,
+    find_nodes_at,
+    get_time_history_data,
+    smooth_moving_average,
+)
+from pcg_mpi_solver_tpu.utils.quadrature import (
+    gauss_lobatto_table,
+    gauss_points_3d,
+    gauss_table,
+)
+
+
+# ---------------------------------------------------------------- quadrature
+def test_gauss_tables_match_reference_closed_forms():
+    """file_operations.py:179-211 hardcodes these values for 1-4 points."""
+    cases = {
+        1: ([0.0], [2.0]),
+        2: ([-1 / 3**0.5, 1 / 3**0.5], [1.0, 1.0]),
+        3: ([-(3 / 5)**0.5, 0.0, (3 / 5)**0.5], [5 / 9, 8 / 9, 5 / 9]),
+        4: ([-(3/7 + (2/7) * (6/5)**0.5)**0.5, -(3/7 - (2/7) * (6/5)**0.5)**0.5,
+             (3/7 - (2/7) * (6/5)**0.5)**0.5, (3/7 + (2/7) * (6/5)**0.5)**0.5],
+            [(18 - 30**0.5) / 36, (18 + 30**0.5) / 36,
+             (18 + 30**0.5) / 36, (18 - 30**0.5) / 36]),
+    }
+    for n, (ni_ref, wi_ref) in cases.items():
+        ni, wi = gauss_table(n)
+        np.testing.assert_allclose(ni, np.sort(ni_ref), rtol=1e-14, atol=1e-14)
+        order = np.argsort(ni_ref)
+        np.testing.assert_allclose(wi, np.asarray(wi_ref)[order], rtol=1e-14)
+
+
+def test_gauss_lobatto_matches_reference_closed_forms():
+    """file_operations.py:222-241."""
+    cases = {
+        2: ([-1.0, 1.0], [1.0, 1.0]),
+        3: ([-1.0, 0.0, 1.0], [1 / 3, 4 / 3, 1 / 3]),
+        4: ([-1.0, -1 / 5**0.5, 1 / 5**0.5, 1.0], [1 / 6, 5 / 6, 5 / 6, 1 / 6]),
+        5: ([-1.0, -(3 / 7)**0.5, 0.0, (3 / 7)**0.5, 1.0],
+            [1 / 10, 49 / 90, 32 / 45, 49 / 90, 1 / 10]),
+    }
+    for n, (ni_ref, wi_ref) in cases.items():
+        ni, wi = gauss_lobatto_table(n)
+        np.testing.assert_allclose(ni, ni_ref, rtol=1e-13, atol=1e-13)
+        np.testing.assert_allclose(wi, wi_ref, rtol=1e-13)
+
+
+def test_gauss_polynomial_exactness():
+    for n in (2, 3, 5, 8):
+        ni, wi = gauss_table(n)
+        for deg in range(2 * n):       # exact through degree 2n-1
+            exact = (1 - (-1) ** (deg + 1)) / (deg + 1)
+            np.testing.assert_allclose(np.sum(wi * ni**deg), exact,
+                                       rtol=1e-12, atol=1e-13)
+
+
+def test_gauss_points_3d_integrates_volume():
+    pts, w = gauss_points_3d(2)
+    assert pts.shape == (8, 3) and w.shape == (8,)
+    np.testing.assert_allclose(w.sum(), 8.0, rtol=1e-14)   # volume of [-1,1]^3
+    # exact for x^2 y^2 z^2: (2/3)^3
+    np.testing.assert_allclose(np.sum(w * np.prod(pts**2, axis=1)),
+                               (2 / 3) ** 3, rtol=1e-12)
+
+
+# ------------------------------------------------------------- postprocessing
+def test_smooth_moving_average_reference_semantics():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=40)
+    so = 3
+    # reference oracle: two explicit passes, zero edges
+    # (file_operations.py:581-590)
+    a = x.copy()
+    for _ in range(2):
+        b = np.zeros_like(a)
+        for q in range(so, len(a) - so):
+            b[q] = np.mean(a[q - so:q + so + 1])
+        a = b
+    np.testing.assert_allclose(smooth_moving_average(x, so, passes=2), a,
+                               rtol=1e-13)
+    assert np.all(smooth_moving_average(x, so)[:so] == 0)
+
+
+@pytest.fixture()
+def crack_run(tmp_path):
+    """Synthetic run: a damage front advancing along +x at constant speed."""
+    model = make_cube_model(10, 3, 3, h=1.0)
+    store = RunStore(str(tmp_path / "run"), "m")
+    store.prepare()
+    node_map = np.arange(model.n_node)
+    store.write_map("NodeId", node_map)
+    store.write_map("Dof", np.arange(model.n_dof))
+    speed, dt, n_frames = 2.0, 0.25, 20
+    x = model.node_coords[:, 0]
+    for k in range(n_frames):
+        D = (x <= speed * dt * k).astype(float)
+        store.write_frame("D", k, D)
+        store.write_frame("U", k, np.full(model.n_dof, 0.1 * k))
+        store.write_frame("PS1", k, x * k)
+    store.write_time_list(dt * np.arange(n_frames))
+    return model, store, speed, dt, n_frames
+
+
+def test_crack_tip_history_and_velocity(crack_run):
+    model, store, speed, dt, n_frames = crack_run
+    tips = crack_tip_history(store, model)
+    assert tips.shape == (n_frames, 3)
+    # tip x advances at `speed` wherever the front is inside the block
+    interior = (tips[:, 0] > 0) & (tips[:, 0] < 10)
+    assert np.any(interior)
+    times = store.read_time_list()
+    crk_len, vel = crack_length_and_velocity(times, tips)
+    assert np.all(np.diff(crk_len) >= 0)
+    mid = np.where(interior)[0][1:-1]
+    np.testing.assert_allclose(vel[mid], speed, rtol=1e-10)
+
+
+def test_calc_crack_tip_velocity_saves(crack_run):
+    model, store, *_ = crack_run
+    out = calc_crack_tip_velocity(store, model, smooth_half_window=2,
+                                  drop_last=2)
+    assert set(out) == {"CTVel", "DmgNodeCoord", "CrkLen", "Time_T"}
+    import os
+
+    assert os.path.exists(f"{store.result_path}/CrackTipVelData.npy")
+
+
+def test_get_time_history_data(crack_run):
+    model, store, *_ = crack_run
+    coords = model.node_coords[[0, 5]]
+    out = get_time_history_data(store, model, coords, nodal_vars=("PS1",))
+    n_frames = len(store.read_time_list())
+    assert out["U"].shape == (n_frames, 2)
+    np.testing.assert_allclose(out["U"][:, 0], 0.1 * np.arange(n_frames))
+    np.testing.assert_allclose(out["PS1"][:, 1],
+                               model.node_coords[5, 0] * np.arange(n_frames))
+    import os
+
+    assert os.path.exists(f"{store.result_path}/TimeHistoryData.mat")
+    with pytest.raises(ValueError):
+        find_nodes_at(model, np.array([[123.4, 0, 0]]))
