@@ -61,6 +61,10 @@ class RunConfig:
     model_name: str = "model"
     run_id: str = "1"
     n_parts: int = 1
+    # Element->part assignment: "rcb" (coordinate bisection), "graph"
+    # (native multilevel dual-graph partitioner — the METIS-equivalent
+    # path, reference run_metis.py:84-88), or "auto".
+    partition_method: str = "rcb"
     speed_test: bool = False
     solver: SolverConfig = dataclasses.field(default_factory=SolverConfig)
     time_history: TimeHistoryConfig = dataclasses.field(default_factory=TimeHistoryConfig)

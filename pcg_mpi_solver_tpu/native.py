@@ -1,0 +1,234 @@
+"""ctypes binding for the native (C++) runtime library.
+
+The reference depends on external native code for its offline prep: METIS via
+mgmetis for dual-graph mesh partitioning (reference: src/solver/run_metis.py:
+84-88) and wished-for Cython element loops (partition_mesh.py:244,271,280).
+This framework ships its own native layer (``native/src/*.cpp``), built into
+``pcg_mpi_solver_tpu/_libpcgnative.so`` and loaded here lazily.  Every entry
+point has a pure-numpy fallback, so the package works without a compiler; the
+native path is used automatically when the library is present or buildable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_LIB_NAME = "_libpcgnative.so"
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_PKG_DIR, _LIB_NAME)
+_NATIVE_DIR = os.path.join(os.path.dirname(_PKG_DIR), "native")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def build(force: bool = False) -> bool:
+    """Compile the native library with make (g++).  Returns success."""
+    if os.environ.get("PCG_TPU_NO_NATIVE"):
+        return False
+    if not force and os.path.exists(_LIB_PATH):
+        return True
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    try:
+        res = subprocess.run(
+            ["make", "-s"] + (["-B"] if force else []),
+            cwd=_NATIVE_DIR, capture_output=True, text=True, timeout=300)
+        if res.returncode != 0:
+            return False
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.pcgn_part_graph.restype = ctypes.c_int
+    lib.pcgn_part_graph.argtypes = [
+        ctypes.c_int64, i64p, i64p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_uint64, i32p]
+    lib.pcgn_part_mesh_dual.restype = ctypes.c_int
+    lib.pcgn_part_mesh_dual.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, i64p, i64p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64, i32p]
+    lib.pcgn_edge_cut.restype = ctypes.c_int64
+    lib.pcgn_edge_cut.argtypes = [ctypes.c_int64, i64p, i64p, ctypes.c_void_p, i32p]
+    lib.pcgn_csr_take.restype = ctypes.c_int64
+    lib.pcgn_csr_take.argtypes = [i64p, i64p, i64p, ctypes.c_int64, i64p]
+    lib.pcgn_unique_renumber.restype = ctypes.c_int64
+    lib.pcgn_unique_renumber.argtypes = [i64p, ctypes.c_int64, i64p,
+                                         ctypes.c_void_p]  # loc nullable
+    lib.pcgn_sort_i32.restype = None
+    lib.pcgn_sort_i32.argtypes = [i32p, ctypes.c_int64, i32p, i32p]
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None:
+        return _lib
+    if _load_attempted:
+        return None
+    _load_attempted = True
+    if os.environ.get("PCG_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH) and not build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        _declare(lib)
+        _lib = lib
+    except OSError:
+        return None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Partitioning entry points
+# ---------------------------------------------------------------------------
+
+def part_mesh_dual(eptr: np.ndarray, eind: np.ndarray, n_node: int,
+                   n_parts: int, ncommon: int = 1,
+                   seed: int = 0) -> Optional[np.ndarray]:
+    """Partition a mesh by its dual graph (elements sharing >= ncommon nodes
+    are adjacent) — the call shape of the reference's METIS use
+    (run_metis.py:88).  Returns an (n_elem,) int32 part map, or None when the
+    native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    eptr = np.ascontiguousarray(eptr, dtype=np.int64)
+    eind = np.ascontiguousarray(eind, dtype=np.int64)
+    n_elem = len(eptr) - 1
+    part = np.empty(n_elem, dtype=np.int32)
+    rc = lib.pcgn_part_mesh_dual(n_elem, int(n_node), eptr, eind,
+                                 int(ncommon), int(n_parts), int(seed), part)
+    if rc != 0:
+        return None
+    return part
+
+
+def part_graph(xadj: np.ndarray, adjncy: np.ndarray, n_parts: int,
+               adjwgt: Optional[np.ndarray] = None,
+               vwgt: Optional[np.ndarray] = None,
+               seed: int = 0) -> Optional[np.ndarray]:
+    """k-way partition of a CSR graph; None when native lib unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    xadj = np.ascontiguousarray(xadj, dtype=np.int64)
+    adjncy = np.ascontiguousarray(adjncy, dtype=np.int64)
+    n = len(xadj) - 1
+    part = np.empty(n, dtype=np.int32)
+    # Keep converted arrays alive in locals for the duration of the C call
+    # (.ctypes.data of an unnamed temporary would dangle).
+    aw_arr = (np.ascontiguousarray(adjwgt, dtype=np.int64)
+              if adjwgt is not None else None)
+    vw_arr = (np.ascontiguousarray(vwgt, dtype=np.int64)
+              if vwgt is not None else None)
+    rc = lib.pcgn_part_graph(n, xadj, adjncy,
+                             aw_arr.ctypes.data if aw_arr is not None else None,
+                             vw_arr.ctypes.data if vw_arr is not None else None,
+                             int(n_parts), int(seed), part)
+    if rc != 0:
+        return None
+    return part
+
+
+def edge_cut(xadj: np.ndarray, adjncy: np.ndarray, part: np.ndarray) -> int:
+    """Edge cut of a partition (unit edge weights).  Numpy fallback."""
+    lib = load()
+    xadj = np.ascontiguousarray(xadj, dtype=np.int64)
+    adjncy = np.ascontiguousarray(adjncy, dtype=np.int64)
+    part = np.ascontiguousarray(part, dtype=np.int32)
+    if lib is not None:
+        return int(lib.pcgn_edge_cut(len(xadj) - 1, xadj, adjncy, None, part))
+    src = np.repeat(np.arange(len(xadj) - 1), np.diff(xadj))
+    return int((part[src] != part[adjncy]).sum() // 2)
+
+
+_PREP_THRESHOLD = 4096  # below this, numpy's C loops win on call overhead
+
+
+def csr_take(flat: np.ndarray, offset: np.ndarray,
+             elems: np.ndarray) -> Optional[np.ndarray]:
+    """Ragged gather flat[offset[e]:offset[e+1]] for e in elems; None when
+    the native library is unavailable (caller falls back to numpy)."""
+    lib = load()
+    if lib is None or len(elems) < _PREP_THRESHOLD:
+        return None
+    flat = np.ascontiguousarray(flat, dtype=np.int64)
+    offset = np.ascontiguousarray(offset, dtype=np.int64)
+    elems = np.ascontiguousarray(elems, dtype=np.int64)
+    total = int((offset[elems + 1] - offset[elems]).sum())
+    out = np.empty(total, dtype=np.int64)
+    lib.pcgn_csr_take(flat, offset, elems, len(elems), out)
+    return out
+
+
+def unique_renumber(ids: np.ndarray, renumber: bool = True):
+    """(sorted unique ids, int32 local index of each input id); None when
+    the native library is unavailable.  With ``renumber=False`` the second
+    element is None and the renumbering pass is skipped."""
+    lib = load()
+    if lib is None or len(ids) < _PREP_THRESHOLD:
+        return None
+    ids = np.ascontiguousarray(ids, dtype=np.int64)
+    uniq = np.empty(len(ids), dtype=np.int64)
+    loc = np.empty(len(ids), dtype=np.int32) if renumber else None
+    nu = lib.pcgn_unique_renumber(
+        ids, len(ids), uniq, loc.ctypes.data if loc is not None else None)
+    return uniq[:nu].copy(), loc
+
+
+def sort_i32(keys: np.ndarray):
+    """(stable argsort perm, sorted keys) of int32 keys; None when the
+    native library is unavailable."""
+    lib = load()
+    if lib is None or len(keys) < _PREP_THRESHOLD:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.int32)
+    perm = np.empty(len(keys), dtype=np.int32)
+    skeys = np.empty(len(keys), dtype=np.int32)
+    lib.pcgn_sort_i32(keys, len(keys), perm, skeys)
+    return perm, skeys
+
+
+def build_dual_graph_np(eptr: np.ndarray, eind: np.ndarray, n_node: int,
+                        ncommon: int = 1):
+    """Pure-numpy dual-graph builder (fallback + test oracle): returns
+    (xadj, adjncy) CSR of element adjacency."""
+    n_elem = len(eptr) - 1
+    src = np.repeat(np.arange(n_elem, dtype=np.int64), np.diff(eptr))
+    order = np.argsort(eind, kind="stable")
+    by_node_elem = src[order]
+    node_cnt = np.bincount(eind, minlength=n_node)
+    node_off = np.concatenate([[0], np.cumsum(node_cnt)])
+    pairs = []
+    for nd in range(n_node):
+        es = by_node_elem[node_off[nd]:node_off[nd + 1]]
+        if len(es) > 1:
+            a, b = np.meshgrid(es, es, indexing="ij")
+            m = a != b
+            pairs.append(np.stack([a[m], b[m]], axis=1))
+    if not pairs:
+        return np.zeros(n_elem + 1, dtype=np.int64), np.zeros(0, dtype=np.int64)
+    pr = np.concatenate(pairs)
+    key = pr[:, 0] * n_elem + pr[:, 1]
+    uniq, counts = np.unique(key, return_counts=True)
+    keep = counts >= ncommon
+    uniq = uniq[keep]
+    a = uniq // n_elem
+    b = uniq % n_elem
+    xadj = np.concatenate([[0], np.cumsum(np.bincount(a, minlength=n_elem))]).astype(np.int64)
+    return xadj, b.astype(np.int64)

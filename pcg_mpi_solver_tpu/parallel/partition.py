@@ -27,16 +27,61 @@ Key re-designs vs the reference:
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from pcg_mpi_solver_tpu.models.model_data import ModelData
+from pcg_mpi_solver_tpu import native
 
 
 # ----------------------------------------------------------------------
 # Element -> part assignment
 # ----------------------------------------------------------------------
+
+def graph_partition(model: ModelData, n_parts: int, ncommon: int = 1,
+                    seed: int = 0, strict: bool = True) -> np.ndarray:
+    """Dual-graph element partition via the native multilevel partitioner —
+    the METIS-equivalent path (reference run_metis.py:84-88 calls
+    ``metis.part_mesh_dual``).  With ``strict`` (the default) an unavailable
+    native library raises; with ``strict=False`` it falls back to RCB."""
+    part = native.part_mesh_dual(
+        np.asarray(model.elem_nodes_offset, dtype=np.int64),
+        np.asarray(model.elem_nodes_flat, dtype=np.int64),
+        model.n_node, n_parts, ncommon=ncommon, seed=seed)
+    if part is None:
+        if strict:
+            raise RuntimeError(
+                "partition method 'graph' requires the native library "
+                "(native/src/partition.cpp); build failed or g++ missing — "
+                "use method='auto' or 'rcb' for the numpy fallback")
+        return rcb_partition(model.sctrs, n_parts)
+    if len(np.unique(part)) != n_parts:
+        # The solver needs every part non-empty.
+        warnings.warn(
+            f"graph partition produced an empty part (n_parts={n_parts}); "
+            "falling back to RCB")
+        return rcb_partition(model.sctrs, n_parts)
+    return part
+
+
+def make_elem_part(model: ModelData, n_parts: int, method: str = "rcb",
+                   seed: int = 0) -> np.ndarray:
+    """Element->part map by method: 'rcb' (coordinate bisection), 'graph'
+    (native dual-graph, raises if the native lib is missing), or 'auto'
+    (graph when the native lib is present, else RCB)."""
+    if n_parts <= 1:
+        return np.zeros(model.n_elem, dtype=np.int32)
+    if method == "rcb":
+        return rcb_partition(model.sctrs, n_parts)
+    if method == "graph":
+        return graph_partition(model, n_parts, seed=seed, strict=True)
+    if method == "auto":
+        if native.available():
+            return graph_partition(model, n_parts, seed=seed, strict=False)
+        return rcb_partition(model.sctrs, n_parts)
+    raise ValueError(f"unknown partition method {method!r}")
 
 def rcb_partition(centroids: np.ndarray, n_parts: int) -> np.ndarray:
     """Recursive coordinate bisection on element centroids.
@@ -164,14 +209,11 @@ def partition_model(
     n_parts: int,
     elem_part: Optional[np.ndarray] = None,
     pad_multiple: int = 8,
+    method: str = "rcb",
 ) -> PartitionedModel:
     """Partition ``model`` into ``n_parts`` padded shards."""
     if elem_part is None:
-        elem_part = (
-            rcb_partition(model.sctrs, n_parts)
-            if n_parts > 1
-            else np.zeros(model.n_elem, dtype=np.int32)
-        )
+        elem_part = make_elem_part(model, n_parts, method=method)
 
     P = n_parts
     type_ids = sorted(model.elem_lib.keys())
@@ -198,8 +240,8 @@ def partition_model(
             # interface-dof assembly (a dof in >= 2 parts is psum-combined)
             m = spr_part == p
             dof_idx = np.concatenate([dof_idx, spr_ga[m], spr_gb[m]])
-        dof_gids.append(np.unique(dof_idx))
-        node_gids.append(np.unique(node_idx))
+        dof_gids.append(_unique(dof_idx))
+        node_gids.append(_unique(node_idx))
 
     ndof_p = np.array([len(g) for g in dof_gids])
     nnode_p = np.array([len(g) for g in node_gids])
@@ -336,9 +378,13 @@ def partition_model(
     scat_ids = np.zeros((P, NC), dtype=np.int32)
     for p in range(P):
         flat = np.concatenate([tb.dof[p].ravel() for tb in type_blocks])
-        perm = np.argsort(flat, kind="stable")
-        scat_perm[p] = perm
-        scat_ids[p] = flat[perm]
+        nat = native.sort_i32(flat.astype(np.int32))
+        if nat is not None:
+            scat_perm[p], scat_ids[p] = nat
+        else:
+            perm = np.argsort(flat, kind="stable")
+            scat_perm[p] = perm
+            scat_ids[p] = flat[perm]
 
     # ---- padded interface-spring arrays -----------------------------------
     spr_a = spr_b = spr_k = None
@@ -391,10 +437,24 @@ def partition_model(
     )
 
 
+def _unique(ids: np.ndarray) -> np.ndarray:
+    """Sorted unique, using the native prep kernel when available
+    (the np.unique half of config_ElemVectors, partition_mesh.py:272-286)."""
+    nat = native.unique_renumber(ids, renumber=False)
+    if nat is not None:
+        return nat[0]
+    return np.unique(ids)
+
+
 def _csr_take(flat: np.ndarray, offset: np.ndarray, elems: np.ndarray) -> np.ndarray:
-    """Concatenate flat[offset[e]:offset[e+1]] for e in elems (vectorized)."""
+    """Concatenate flat[offset[e]:offset[e+1]] for e in elems (vectorized;
+    native kernel when available — the loop the reference marked
+    TODO-Cython, partition_mesh.py:244-255)."""
     if len(elems) == 0:
         return flat[:0]
+    nat = native.csr_take(flat, offset, elems)
+    if nat is not None:
+        return nat
     starts = offset[elems]
     ends = offset[elems + 1]
     lens = ends - starts

@@ -77,6 +77,9 @@ class Solver:
             and not model.elem_sign_flat.any()
             and not model.intfc_elems
             and n_parts == n_dev
+            # An explicitly requested non-default partitioner must not be
+            # silently replaced by the structured slab partition.
+            and self.config.partition_method in ("rcb", "auto")
             and model.grid[0] % n_parts == 0
         )
         if backend == "structured" and not can_structured:
@@ -96,7 +99,8 @@ class Solver:
             ops32_factory = lambda: StructuredOps.from_partition(
                 self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS)
         else:
-            self.pm = partition_model(model, n_parts, elem_part=elem_part)
+            self.pm = partition_model(model, n_parts, elem_part=elem_part,
+                                      method=self.config.partition_method)
             self.ops = Ops.from_model(self.pm, dot_dtype=dot_dtype,
                                       axis_name=PARTS_AXIS)
             data = device_data(self.pm, dtype)
