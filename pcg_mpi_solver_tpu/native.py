@@ -28,13 +28,16 @@ _load_attempted = False
 
 
 def build(force: bool = False) -> bool:
-    """Compile the native library with make (g++).  Returns success."""
+    """Compile the native library with make (g++).  Returns success.
+
+    Always runs make when the source tree is present — make's own
+    dependency tracking makes this a cheap no-op when the .so is current,
+    and it keeps edited native/src/*.cpp from being silently ignored.
+    """
     if os.environ.get("PCG_TPU_NO_NATIVE"):
         return False
-    if not force and os.path.exists(_LIB_PATH):
-        return True
     if not os.path.isdir(_NATIVE_DIR):
-        return False
+        return os.path.exists(_LIB_PATH)
     try:
         res = subprocess.run(
             ["make", "-s"] + (["-B"] if force else []),
@@ -78,7 +81,7 @@ def load() -> Optional[ctypes.CDLL]:
     _load_attempted = True
     if os.environ.get("PCG_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_LIB_PATH) and not build():
+    if not build():
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)

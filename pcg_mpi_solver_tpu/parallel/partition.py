@@ -165,6 +165,16 @@ class PartitionedModel:
     scat_perm: np.ndarray        # (P, NC) int32
     scat_ids: np.ndarray         # (P, NC) int32 sorted local dof ids (n_loc for padding)
 
+    # Node-ELL scatter map (the TPU fast path): every local node receives
+    # <= K element-node contributions, each a contiguous 3-vector.  ``ell``
+    # indexes rows of the flattened (NC/3, 3) element-node value array
+    # (slot = block_base + node_slot*N_blk + elem), NC/3 = out-of-range fill.
+    # TPU gathers rows of 3 ~an order of magnitude faster than scalars, so
+    # scatter-add becomes row-gather + row-sum.  None when the model is not
+    # 3-dof-per-node (then the sorted segment_sum path is used).
+    ell: Optional[np.ndarray]    # (P, n_node_loc, K) int32
+    node_layout: bool            # dof_gid == 3*node_gid+c everywhere
+
     # Interface assembly maps (dof space)
     iface_local: np.ndarray      # (P, NI) int32 local dof id, n_loc padded
     iface_slot: np.ndarray       # (P, NI) int32 slot in global iface vector, n_iface padded
@@ -245,8 +255,30 @@ def partition_model(
 
     ndof_p = np.array([len(g) for g in dof_gids])
     nnode_p = np.array([len(g) for g in node_gids])
-    n_loc = int(-(-int(ndof_p.max()) // pad_multiple) * pad_multiple)
     n_node_loc = int(-(-int(nnode_p.max()) // pad_multiple) * pad_multiple)
+    # Keep n_loc = 3*n_node_loc so the dof vector reshapes to (n_node, 3)
+    # rows for the node-wise gather/scatter fast path.  The ELL path assumes
+    # node-interleaved dofs at BOTH levels: per element
+    # (elem_dofs[e][3a+c] == 3*elem_nodes[e][a]+c, which Ke4/sign_nc rely
+    # on) and per part (dof_gid == 3*node_gid+c, which the x3 reshape
+    # relies on — springs can break it by pulling in node-less dofs).
+    node_layout = (
+        len(model.elem_dofs_flat) == 3 * len(model.elem_nodes_flat)
+        and np.array_equal(np.asarray(model.elem_dofs_offset),
+                           3 * np.asarray(model.elem_nodes_offset))
+        and np.array_equal(
+            np.asarray(model.elem_dofs_flat),
+            (3 * np.asarray(model.elem_nodes_flat)[:, None]
+             + np.arange(3)).ravel())
+        and all(
+            len(dg) == 3 * len(ng)
+            and np.array_equal(dg, (3 * ng[:, None] + np.arange(3)).ravel())
+            for dg, ng in zip(dof_gids, node_gids))
+    )
+    if node_layout:
+        n_loc = 3 * n_node_loc
+    else:
+        n_loc = int(-(-int(ndof_p.max()) // pad_multiple) * pad_multiple)
 
     # ---- interface dofs/nodes (shared by >= 2 parts) ----------------------
     iface_gid, iface_owner = _shared_ids(dof_gids, model.n_dof)
@@ -386,6 +418,33 @@ def partition_model(
             scat_perm[p] = perm
             scat_ids[p] = flat[perm]
 
+    # ---- node-ELL scatter map (TPU fast path) -----------------------------
+    ell = None
+    if node_layout and type_blocks:
+        n_slots = sum(tb.n_nodes * tb.node.shape[2] for tb in type_blocks)
+        per_part_ell = []
+        seg_data = []
+        K = 1
+        for p in range(P):
+            # slot id = block_base + node_slot*N_blk + elem  (ravel of (nn, N))
+            ids_n = np.concatenate([tb.node[p].reshape(-1) for tb in type_blocks])
+            valid = ids_n < n_node_loc        # padded slots point out of range
+            slots = np.where(valid)[0].astype(np.int64)
+            ids_v = ids_n[valid].astype(np.int64)
+            order = np.argsort(ids_v, kind="stable")
+            ids_s, slots_s = ids_v[order], slots[order]
+            counts = np.bincount(ids_s, minlength=n_node_loc)
+            K = max(K, int(counts.max()) if len(counts) else 0)
+            seg_data.append((ids_s, slots_s, counts))
+        for p in range(P):
+            ids_s, slots_s, counts = seg_data[p]
+            ell_p = np.full((n_node_loc, K), n_slots, dtype=np.int32)
+            off = np.concatenate([[0], np.cumsum(counts)])
+            rank = np.arange(len(ids_s)) - off[ids_s]
+            ell_p[ids_s, rank] = slots_s
+            per_part_ell.append(ell_p)
+        ell = np.stack(per_part_ell)
+
     # ---- padded interface-spring arrays -----------------------------------
     spr_a = spr_b = spr_k = None
     if have_springs:
@@ -416,6 +475,8 @@ def partition_model(
         type_blocks=type_blocks,
         scat_perm=scat_perm,
         scat_ids=scat_ids,
+        ell=ell,
+        node_layout=node_layout,
         iface_local=iface_local,
         iface_slot=iface_slot,
         niface_local=niface_local,

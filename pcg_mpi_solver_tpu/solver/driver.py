@@ -77,9 +77,11 @@ class Solver:
             and not model.elem_sign_flat.any()
             and not model.intfc_elems
             and n_parts == n_dev
-            # An explicitly requested non-default partitioner must not be
-            # silently replaced by the structured slab partition.
+            # An explicitly requested non-default partitioner (method or an
+            # elem_part array) must not be silently replaced by the
+            # structured slab partition.
             and self.config.partition_method in ("rcb", "auto")
+            and elem_part is None
             and model.grid[0] % n_parts == 0
         )
         if backend == "structured" and not can_structured:
@@ -378,7 +380,7 @@ class Solver:
         return out
 
 
-_REPLICATED_KEYS = frozenset({"Ke", "diag_Ke", "Me", "Se"})
+_REPLICATED_KEYS = frozenset({"Ke", "diag_Ke", "Me", "Se", "Ke4", "diag_Ke4"})
 
 
 def _data_specs(data):
