@@ -64,11 +64,12 @@ def cmd_ingest(args):
 
 def cmd_partition(args):
     from pcg_mpi_solver_tpu.models.mdf import read_mdf
-    from pcg_mpi_solver_tpu.parallel.partition import rcb_partition
+    from pcg_mpi_solver_tpu.parallel.partition import make_elem_part
 
     model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
-    print(f">partitioning {model.n_elem} elements into {args.n_parts} parts..")
-    part = rcb_partition(model.sctrs, args.n_parts)
+    print(f">partitioning {model.n_elem} elements into {args.n_parts} parts "
+          f"({args.method})..")
+    part = make_elem_part(model, args.n_parts, method=args.method)
     out = os.path.join(args.scratch, "ModelData", f"MeshPart_{args.n_parts}.npy")
     np.save(out, part)
     print(f">saved {out}")
@@ -85,6 +86,8 @@ def cmd_solve(args):
     cfg.scratch_path = args.scratch
     cfg.run_id = args.run_id
     cfg.speed_test = bool(args.speed_test)
+    cfg.checkpoint_every = int(args.checkpoint_every or 0)
+    cfg.profile_dir = args.profile_dir or ""
     model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
     cfg.time_history.dt = model.dt   # frame timestamps follow the model's dt
     n_dev = len(jax.devices())
@@ -103,8 +106,11 @@ def cmd_solve(args):
     s = Solver(model, cfg, mesh=make_mesh(n_dev_used), n_parts=n_parts,
                elem_part=elem_part)
     store = RunStore(cfg.result_path, cfg.model_name)
-    res = s.solve(store=None if cfg.speed_test else store)
-    for t, r in enumerate(res, 1):
+    res = s.solve(store=None if cfg.speed_test else store,
+                  resume=bool(args.resume))
+    # With --resume, earlier steps were restored: label only the ones run.
+    t_first = len(s.flags) - len(res) + 1
+    for t, r in enumerate(res, t_first):
         print(f">step {t}: flag={r.flag} iters={r.iters} relres={r.relres:.3e} "
               f"wall={r.wall_s:.2f}s")
     td = s.time_data()
@@ -169,6 +175,9 @@ def main(argv=None):
     p = sub.add_parser("partition", help="compute element->part map")
     p.add_argument("scratch")
     p.add_argument("n_parts", type=int)
+    p.add_argument("--method", choices=["rcb", "graph", "auto"], default="auto",
+                   help="rcb = coordinate bisection; graph = native "
+                        "multilevel dual-graph partitioner (METIS-equivalent)")
     p.set_defaults(fn=cmd_partition)
 
     p = sub.add_parser("solve", help="run the SPMD PCG solve")
@@ -182,6 +191,14 @@ def main(argv=None):
     p.add_argument("--speed-test", action="store_true",
                    help="disable all exports for clean timing "
                         "(reference SpeedTestFlag)")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="write a solver checkpoint every N time steps")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the latest checkpoint of this run")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of the solve here "
+                        "(open with TensorBoard; shows the per-op "
+                        "compute/collective split)")
     p.set_defaults(fn=cmd_solve)
 
     p = sub.add_parser("export", help="export result frames to VTK")

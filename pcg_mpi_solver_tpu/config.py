@@ -66,6 +66,16 @@ class RunConfig:
     # path, reference run_metis.py:84-88), or "auto".
     partition_method: str = "rcb"
     speed_test: bool = False
+    # In-solve checkpointing: write solver state every N completed time
+    # steps (0 = off).  The reference is resumable only at pipeline-stage
+    # granularity (SURVEY.md §5); this adds step granularity.
+    checkpoint_every: int = 0
+    # When set, the solve loop runs under a jax.profiler trace written here
+    # (open with TensorBoard/XProf).  This is the TPU-native replacement for
+    # the reference's hand-rolled calc vs comm-wait bracketing
+    # (pcg_solver.py:631-641): collective time shows up as its own ops in
+    # the trace instead of host-side timer brackets.
+    profile_dir: str = ""
     solver: SolverConfig = dataclasses.field(default_factory=SolverConfig)
     time_history: TimeHistoryConfig = dataclasses.field(default_factory=TimeHistoryConfig)
 
@@ -81,3 +91,7 @@ class RunConfig:
     @property
     def plot_path(self) -> str:
         return f"{self.result_path}/PlotData"
+
+    @property
+    def checkpoint_path(self) -> str:
+        return f"{self.result_path}/Checkpoints"

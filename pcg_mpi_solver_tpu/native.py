@@ -170,13 +170,15 @@ def csr_take(flat: np.ndarray, offset: np.ndarray,
     lib = load()
     if lib is None or len(elems) < _PREP_THRESHOLD:
         return None
+    orig_dtype = np.asarray(flat).dtype
     flat = np.ascontiguousarray(flat, dtype=np.int64)
     offset = np.ascontiguousarray(offset, dtype=np.int64)
     elems = np.ascontiguousarray(elems, dtype=np.int64)
     total = int((offset[elems + 1] - offset[elems]).sum())
     out = np.empty(total, dtype=np.int64)
     lib.pcgn_csr_take(flat, offset, elems, len(elems), out)
-    return out
+    # Preserve the caller's dtype — a bool mask must stay a bool mask.
+    return out if orig_dtype == np.int64 else out.astype(orig_dtype)
 
 
 def unique_renumber(ids: np.ndarray, renumber: bool = True):

@@ -192,6 +192,11 @@ class Solver:
         self.relres: List[float] = []
         self.iters: List[int] = []
         self.step_times: List[float] = []
+        self._probe_u: List[np.ndarray] = []
+        self._export_wall: float = 0.0
+        # Steps timed in THIS process (not checkpoint-restored): the compile
+        # estimate must compare a first step that actually paid the compile.
+        self._proc_step_times: List[float] = []
 
     # ------------------------------------------------------------------
     def reset_state(self):
@@ -217,21 +222,40 @@ class Solver:
         self.relres.append(res.relres)
         self.iters.append(res.iters)
         self.step_times.append(wall)
+        self._proc_step_times.append(wall)
         return res
 
     def solve(self, on_step: Optional[Callable[[int, StepResult], None]] = None,
-              store=None):
+              store=None, resume: bool = False):
         """Run the full quasi-static schedule (skips step 0, like the
         reference's ``range(1, RefMaxTimeStepCount)``, pcg_solver.py:1002),
         exporting contour frames / history / timing into ``store`` when
-        exports are enabled."""
+        exports are enabled.
+
+        With ``resume=True``, restores the latest checkpoint under
+        ``config.checkpoint_path`` (if any) and continues from the step
+        after it; with ``config.checkpoint_every > 0``, writes a checkpoint
+        every N completed steps and after the final one."""
         th = self.config.time_history
         deltas = th.time_step_delta
         do_export = store is not None and th.export_flag and not self.config.speed_test
         do_plot = store is not None and th.plot_flag and not self.config.speed_test
 
+        ckpt_mgr = None
+        t_start = 1
+        if self.config.checkpoint_every > 0 or resume:
+            from pcg_mpi_solver_tpu.utils.checkpoint import CheckpointManager
+
+            ckpt_mgr = CheckpointManager(self.config.checkpoint_path)
+        if resume and ckpt_mgr is not None:
+            t_done = ckpt_mgr.restore(self)
+            if t_done is not None:
+                t_start = t_done + 1
+
         t_prep = time.perf_counter() - self._t_init0
-        if do_export:
+        if do_export and t_start == 1:
+            # On resume the run dir (maps + already-exported frames) must
+            # survive; prepare() would rotate it away.
             store.prepare()
             store.write_map("Dof", self.export_dof_map())
             if self._nodal_vars():
@@ -239,19 +263,33 @@ class Solver:
             self._export_count = 0
             self._export_times = []
             self._maybe_export(store, 0)
-        probe_u = []
+        if t_start == 1:
+            self._probe_u = []
+        probe_u = self._probe_u
+
+        profiling = bool(self.config.profile_dir) and not self.config.speed_test
+        if profiling:
+            jax.profiler.start_trace(self.config.profile_dir)
 
         results = []
-        for t in range(1, len(deltas)):
-            res = self.step(deltas[t])
-            results.append(res)
-            if do_export:
-                self._maybe_export(store, t)
-            if do_plot and len(th.probe_dofs) > 0:
-                u = self.displacement_global()
-                probe_u.append(u[np.asarray(th.probe_dofs)])
-            if on_step is not None:
-                on_step(t, res)
+        try:
+            for t in range(t_start, len(deltas)):
+                res = self.step(deltas[t])
+                results.append(res)
+                if do_export:
+                    self._maybe_export(store, t)
+                if do_plot and len(th.probe_dofs) > 0:
+                    u = self.displacement_global()
+                    probe_u.append(u[np.asarray(th.probe_dofs)])
+                every = self.config.checkpoint_every
+                if ckpt_mgr is not None and every > 0 and (
+                        t % every == 0 or t == len(deltas) - 1):
+                    ckpt_mgr.save(self, t)
+                if on_step is not None:
+                    on_step(t, res)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
 
         if do_export:
             store.write_time_list(self._export_times)
@@ -271,6 +309,7 @@ class Solver:
             due = True
         if not due:
             return
+        t0 = time.perf_counter()
         k = self._export_count
         if "U" in self._export_vars():
             store.write_frame("U", k, self.displacement_owned())
@@ -285,6 +324,7 @@ class Solver:
             store.write_frame("NS", k, ns[self.export_node_map()])
         self._export_times.append(t * th.dt)
         self._export_count = k + 1
+        self._export_wall += time.perf_counter() - t0
 
     def _export_vars(self):
         ev = self.config.time_history.export_vars
@@ -330,16 +370,46 @@ class Solver:
 
     def time_data(self, t_prep: float = 0.0) -> dict:
         """Solve metadata in the reference's TimeData schema
-        (file_operations.py:72-172, pcg_solver.py:943-961)."""
+        (file_operations.py:72-172, pcg_solver.py:943-961), extended with a
+        compile-time estimate, export-time bucket and per-part load-unbalance
+        stats (reference LoadUnbalanceData, file_operations.py:118-128).
+
+        The reference's calc vs comm-wait split brackets every MPI call with
+        host timers; under XLA the collectives compile into the program, so
+        the per-op split lives in the profiler trace (config.profile_dir),
+        not in host-side buckets."""
+        steps = np.asarray(self.step_times)
+        # First step run IN THIS PROCESS pays the XLA compile; checkpoint-
+        # restored step times never include this process's compile.
+        proc = np.asarray(self._proc_step_times)
+        compile_est = float(proc[0] - np.median(proc[1:])) if len(proc) > 1 else 0.0
+        type_blocks = getattr(self.pm, "type_blocks", None)
+        if type_blocks:
+            elems_pp = np.sum([tb.n_elem for tb in type_blocks], axis=0)
+        else:   # structured slab partition: identical cell count per part
+            elems_pp = np.full(self.pm.n_parts,
+                               self.pm.nxc * self.pm.ny * self.pm.nz)
+        dofs_pp = np.asarray(self.pm.ndof_p)
+        unbalance = {
+            "ElemsPerPart": elems_pp,
+            "DofsPerPart": dofs_pp,
+            "MaxByMeanElems": float(elems_pp.max() / max(elems_pp.mean(), 1))
+            if elems_pp.size else 1.0,
+            "MaxByMeanDofs": float(dofs_pp.max() / max(dofs_pp.mean(), 1)),
+            "IfaceDofFrac": float(self.pm.n_iface / max(self.pm.glob_n_dof, 1)),
+        }
         return {
             "Mean_FileReadTime": t_prep,
             "Mean_CalcTime": float(np.sum(self.step_times)),
-            "Mean_CommWaitTime": 0.0,  # collectives live inside the jitted
-                                       # program; split requires profiler traces
+            "Mean_CommWaitTime": 0.0,  # see docstring: use profile_dir
+            "Compile_Time_Est": max(compile_est, 0.0),
+            "Export_Time": float(self._export_wall),
             "TotalTime": t_prep + float(np.sum(self.step_times)),
             "Flag": np.asarray(self.flags),
             "Iter": np.asarray(self.iters),
             "RelRes": np.asarray(self.relres),
+            "StepTimes": steps,
+            "LoadUnbalanceData": unbalance,
             "MP_NDOF": self.pm.n_loc,
             "N_Parts": self.pm.n_parts,
         }

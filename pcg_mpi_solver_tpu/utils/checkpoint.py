@@ -1,0 +1,139 @@
+"""In-solve checkpoint/resume.
+
+The reference has NO in-solve checkpointing: its pipeline is resumable only
+at stage granularity because every stage persists its output to disk, and
+per-frame exports double as (unexploited) restart data (reference:
+SURVEY.md §5; pcg_solver.py:891-894 persists per-frame ResVecData).  This
+module closes that gap for the multi-step quasi-static schedule: after any
+completed time step the full solver state (solution vector, convergence
+histories, export counters) can be written and a later run continues from
+the next step, producing byte-identical histories and export frames.
+
+Format: one ``ckpt_{t:06d}.npz`` per checkpointed step plus a ``latest``
+pointer file written atomically (tmp + rename).  A fingerprint of the model
+and solver configuration guards against resuming with mismatched state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _fingerprint(solver) -> dict:
+    """Everything that must not drift between checkpoint and resume: the
+    numerics (precision/tol), the schedule values, and the export/plot
+    config (counters in the state refer to them)."""
+    cfg = solver.config
+    th = cfg.time_history
+    return {
+        "glob_n_dof": int(solver.pm.glob_n_dof),
+        "n_parts": int(solver.pm.n_parts),
+        "n_loc": int(solver.pm.n_loc),
+        "dtype": str(np.dtype(solver.dtype)),
+        "precision_mode": cfg.solver.precision_mode,
+        "tol": float(cfg.solver.tol),
+        "max_iter": int(cfg.solver.max_iter),
+        "deltas": [float(d) for d in th.time_step_delta],
+        "export": [bool(th.export_flag), int(th.export_frame_rate),
+                   [int(f) for f in th.export_frames], th.export_vars],
+        "plot": [bool(th.plot_flag), [int(d) for d in th.probe_dofs]],
+        "backend": solver.backend,
+    }
+
+
+def state_dict(solver) -> dict:
+    """Everything needed to continue ``solve()`` after step ``t``."""
+    return {
+        "un": np.asarray(solver.un),
+        "flags": np.asarray(solver.flags, dtype=np.int64),
+        "relres": np.asarray(solver.relres, dtype=np.float64),
+        "iters": np.asarray(solver.iters, dtype=np.int64),
+        "step_times": np.asarray(solver.step_times, dtype=np.float64),
+        "export_count": np.int64(getattr(solver, "_export_count", 0)),
+        "export_times": np.asarray(getattr(solver, "_export_times", []),
+                                   dtype=np.float64),
+        "export_wall": np.float64(solver._export_wall),
+        "probe_u": (np.stack(solver._probe_u)
+                    if getattr(solver, "_probe_u", [])
+                    else np.zeros((0, 0))),
+    }
+
+
+def load_state_dict(solver, state: dict) -> None:
+    import jax
+
+    solver.un = jax.device_put(
+        np.asarray(state["un"], dtype=solver.dtype),
+        jax.NamedSharding(solver.mesh, solver._part_spec))
+    solver.flags = [int(v) for v in state["flags"]]
+    solver.relres = [float(v) for v in state["relres"]]
+    solver.iters = [int(v) for v in state["iters"]]
+    solver.step_times = [float(v) for v in state["step_times"]]
+    solver._export_count = int(state["export_count"])
+    solver._export_times = [float(v) for v in state["export_times"]]
+    solver._export_wall = float(state.get("export_wall", 0.0))
+    probe = np.asarray(state["probe_u"])
+    solver._probe_u = [] if probe.size == 0 else [row for row in probe]
+
+
+class CheckpointManager:
+    """Writes/reads per-step solver checkpoints under one directory."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _ckpt_file(self, t: int) -> str:
+        return os.path.join(self.path, f"ckpt_{t:06d}.npz")
+
+    def save(self, solver, t: int) -> str:
+        """Checkpoint solver state after completed step ``t``."""
+        os.makedirs(self.path, exist_ok=True)
+        out = self._ckpt_file(t)
+        tmp = out + ".tmp"
+        payload = dict(state_dict(solver))
+        payload["t"] = np.int64(t)
+        payload["fingerprint"] = np.frombuffer(
+            json.dumps(_fingerprint(solver), sort_keys=True).encode(),
+            dtype=np.uint8).copy()
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **payload)
+        os.replace(tmp, out)
+        ptr = os.path.join(self.path, "latest")
+        with open(ptr + ".tmp", "w") as f:
+            f.write(os.path.basename(out))
+        os.replace(ptr + ".tmp", ptr)
+        return out
+
+    def latest_step(self) -> Optional[int]:
+        ptr = os.path.join(self.path, "latest")
+        if not os.path.exists(ptr):
+            return None
+        with open(ptr) as f:
+            name = f.read().strip()
+        if not os.path.exists(os.path.join(self.path, name)):
+            return None
+        return int(name[len("ckpt_"):-len(".npz")])
+
+    def restore(self, solver, t: Optional[int] = None) -> Optional[int]:
+        """Load the checkpoint for step ``t`` (default: latest) into
+        ``solver``.  Returns the restored step index, or None when no
+        checkpoint exists.  Raises on fingerprint mismatch."""
+        if t is None:
+            t = self.latest_step()
+            if t is None:
+                return None
+        with np.load(self._ckpt_file(t)) as z:
+            saved = json.loads(bytes(z["fingerprint"]).decode())
+            want = _fingerprint(solver)
+            if saved != want:
+                diffs = {k: (saved.get(k), want[k]) for k in want
+                         if saved.get(k) != want[k]}
+                raise ValueError(
+                    f"checkpoint/solver mismatch (saved, current): {diffs}")
+            load_state_dict(solver, {k: z[k] for k in z.files
+                                     if k not in ("t", "fingerprint")})
+        return t

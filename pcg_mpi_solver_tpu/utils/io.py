@@ -80,13 +80,34 @@ class RunStore:
 
     # -- history / timing ----------------------------------------------
     def write_plot_data(self, plot_t, plot_u, plot_dofs) -> None:
-        """Probe-dof displacement history
-        (reference exportHistoryPlotData, pcg_solver.py:899-940)."""
+        """Probe-dof displacement history: .npz + .mat + rendered PNG
+        (reference exportHistoryPlotData + TestPlot PNG,
+        pcg_solver.py:817-838, 899-940)."""
         data = {"Plot_T": np.asarray(plot_t), "Plot_U": np.asarray(plot_u),
                 "Plot_Dof": np.asarray(plot_dofs) + 1}
         np.savez_compressed(f"{self.plot_path}/{self.model_name}_PlotData",
                             PlotData=np.array(data, dtype=object))
         _savemat(f"{self.plot_path}/{self.model_name}_PlotData.mat", data)
+        self._plot_png(data)
+
+    def _plot_png(self, data) -> None:
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:                        # matplotlib is optional
+            return
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        t, u = data["Plot_T"], np.atleast_2d(data["Plot_U"])
+        for i, dof in enumerate(np.atleast_1d(data["Plot_Dof"])):
+            ax.plot(t, u[i], label=f"dof {int(dof)}")
+        ax.set_xlabel("time")
+        ax.set_ylabel("displacement")
+        ax.legend(loc="best", fontsize=8)
+        ax.grid(True, alpha=0.3)
+        fig.tight_layout()
+        fig.savefig(f"{self.plot_path}/{self.model_name}_PlotData.png", dpi=110)
+        plt.close(fig)
 
     def write_time_data(self, n_parts: int, time_data: Dict) -> None:
         """Solve metadata: per-step Flag/RelRes/Iter + timing buckets
@@ -103,4 +124,5 @@ class RunStore:
 def _savemat(path: str, data: Dict) -> None:
     import scipy.io
 
-    scipy.io.savemat(path, {k: np.asarray(v) for k, v in data.items()})
+    scipy.io.savemat(path, {k: (v if isinstance(v, dict) else np.asarray(v))
+                            for k, v in data.items()})

@@ -1,0 +1,133 @@
+"""Checkpoint/resume: a multi-step solve interrupted after step k and
+resumed must reproduce the uninterrupted run exactly (histories, solution,
+export frames).  The reference has no in-solve checkpointing (SURVEY.md §5)
+— this is a capability the TPU framework adds."""
+
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
+from pcg_mpi_solver_tpu.models import make_cube_model
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+from pcg_mpi_solver_tpu.solver import Solver
+from pcg_mpi_solver_tpu.utils.checkpoint import CheckpointManager
+from pcg_mpi_solver_tpu.utils.io import RunStore
+
+
+def _cfg(tmp_path, run_id="1", every=1, plot=False):
+    return RunConfig(
+        scratch_path=str(tmp_path),
+        run_id=run_id,
+        checkpoint_every=every,
+        solver=SolverConfig(tol=1e-8, max_iter=500),
+        time_history=TimeHistoryConfig(
+            time_step_delta=[0.0, 0.25, 0.5, 1.0],
+            export_frame_rate=1,
+            plot_flag=plot,
+            probe_dofs=(3, 10) if plot else (),
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def model():
+    return make_cube_model(5, 4, 4, heterogeneous=True)
+
+
+def test_resume_matches_uninterrupted(tmp_path, model):
+    # Full uninterrupted run.
+    cfg_a = _cfg(tmp_path, run_id="a", every=0)
+    sa = Solver(model, cfg_a, mesh=make_mesh(4), n_parts=4)
+    store_a = RunStore(cfg_a.result_path)
+    sa.solve(store=store_a)
+
+    # Interrupted run: stop after step 2 (simulated by a truncated schedule
+    # sharing the same checkpoint dir), then resume with the full schedule.
+    cfg_b = _cfg(tmp_path, run_id="b", every=1)
+    sb1 = Solver(model, cfg_b, mesh=make_mesh(4), n_parts=4)
+    store_b = RunStore(cfg_b.result_path)
+    steps_run = []
+
+    def interrupt_after_2(t, r):
+        steps_run.append(t)
+        if t == 2:
+            raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        sb1.solve(store=store_b, on_step=interrupt_after_2)
+    assert max(steps_run) == 2
+
+    sb2 = Solver(model, cfg_b, mesh=make_mesh(4), n_parts=4)
+    resumed = []
+    sb2.solve(store=store_b, resume=True, on_step=lambda t, r: resumed.append(t))
+    assert resumed == [3]
+
+    # Histories identical to the uninterrupted run.
+    assert sb2.iters == sa.iters
+    assert sb2.flags == sa.flags
+    np.testing.assert_allclose(sb2.relres, sa.relres, rtol=1e-12)
+    np.testing.assert_allclose(sb2.displacement_global(),
+                               sa.displacement_global(), rtol=1e-12, atol=0)
+
+    # Export frames identical (frame 0 + 3 steps).
+    assert store_b.n_frames("U") == store_a.n_frames("U") == 4
+    for k in range(4):
+        np.testing.assert_allclose(store_b.read_frame("U", k),
+                                   store_a.read_frame("U", k),
+                                   rtol=1e-12, atol=0)
+
+
+def test_fingerprint_mismatch_raises(tmp_path, model):
+    cfg = _cfg(tmp_path, run_id="c", every=1)
+    s = Solver(model, cfg, mesh=make_mesh(4), n_parts=4)
+    s.solve()
+
+    cfg2 = _cfg(tmp_path, run_id="c", every=1)
+    cfg2.solver = SolverConfig(tol=1e-4, max_iter=500)   # different tol
+    s2 = Solver(model, cfg2, mesh=make_mesh(4), n_parts=4)
+    mgr = CheckpointManager(cfg2.checkpoint_path)
+    with pytest.raises(ValueError, match="mismatch"):
+        mgr.restore(s2)
+
+
+def test_resume_without_checkpoint_is_fresh(tmp_path, model):
+    cfg = _cfg(tmp_path, run_id="d", every=0)
+    s = Solver(model, cfg, mesh=make_mesh(4), n_parts=4)
+    res = s.solve(resume=True)       # no checkpoint dir -> full run
+    assert len(res) == 3
+
+
+def test_checkpoint_files_and_latest(tmp_path, model):
+    cfg = _cfg(tmp_path, run_id="e", every=2)
+    s = Solver(model, cfg, mesh=make_mesh(4), n_parts=4)
+    s.solve()
+    mgr = CheckpointManager(cfg.checkpoint_path)
+    # steps 2 (every=2) and 3 (final) are checkpointed
+    assert mgr.latest_step() == 3
+    assert mgr.restore(Solver(model, cfg, mesh=make_mesh(4), n_parts=4)) == 3
+
+
+def test_probe_history_survives_resume(tmp_path, model):
+    cfg_a = _cfg(tmp_path, run_id="f", every=0, plot=True)
+    sa = Solver(model, cfg_a, mesh=make_mesh(4), n_parts=4)
+    store_a = RunStore(cfg_a.result_path)
+    sa.solve(store=store_a)
+
+    cfg_b = _cfg(tmp_path, run_id="g", every=1, plot=True)
+    sb = Solver(model, cfg_b, mesh=make_mesh(4), n_parts=4)
+    store_b = RunStore(cfg_b.result_path)
+    try:
+        sb.solve(store=store_b,
+                 on_step=lambda t, r: (_ for _ in ()).throw(KeyboardInterrupt)
+                 if t == 2 else None)
+    except KeyboardInterrupt:
+        pass
+    sb2 = Solver(model, cfg_b, mesh=make_mesh(4), n_parts=4)
+    sb2.solve(store=store_b, resume=True)
+
+    def plot_u(path):
+        z = np.load(f"{path}/model_PlotData.npz", allow_pickle=True)
+        return z["PlotData"].item()["Plot_U"]
+
+    np.testing.assert_allclose(plot_u(cfg_b.plot_path),
+                               plot_u(cfg_a.plot_path), rtol=1e-12)
