@@ -198,7 +198,7 @@ def main(argv=None):
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of the solve here "
                         "(open with TensorBoard; shows the per-op "
-                        "compute/collective split)")
+                        "compute/collective split; ignored with --speed-test)")
     p.set_defaults(fn=cmd_solve)
 
     p = sub.add_parser("export", help="export result frames to VTK")

@@ -1,0 +1,131 @@
+"""Multi-host (DCN) support.
+
+The reference scales across nodes with mpi4py over OpenMPI — tagged p2p
+halo messages plus allreduces (reference: SURVEY.md §2d; pcg_solver.py:
+317-334, 622-628).  The TPU-native equivalent has no user-level messaging:
+``jax.distributed`` forms one multi-controller program, the device mesh
+spans all hosts (ICI within a slice, DCN across), and the SAME compiled
+solve program runs everywhere — XLA routes the psum/collectives.
+
+What this module provides:
+
+- :func:`init_distributed` — process bootstrap (coordinator discovery from
+  standard env vars, explicit args, or single-process no-op).
+- :func:`make_global_mesh` — 1-D parts mesh over every device of every host.
+- :func:`put_sharded` / :func:`put_tree` — build sharded global device
+  arrays from host numpy data; on multi-host each process materializes only
+  its addressable shards (the analogue of the reference's per-rank partition
+  pickles + shared-memory staging, file_operations.py:306-339).
+
+Single-process semantics are identical to plain ``device_put``, so every
+code path here is exercised by the single-host test suite; multi-host adds
+only the bootstrap.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Initialize jax.distributed for a multi-host run; returns process id.
+
+    Resolution order: explicit args > env vars (``PCG_TPU_COORDINATOR`` /
+    ``PCG_TPU_NUM_PROCS`` / ``PCG_TPU_PROC_ID``, mirroring the standard JAX
+    ones) > single-process no-op.  Safe to call repeatedly.
+    """
+    coordinator_address = coordinator_address or os.environ.get("PCG_TPU_COORDINATOR")
+    if num_processes is None and os.environ.get("PCG_TPU_NUM_PROCS"):
+        num_processes = int(os.environ["PCG_TPU_NUM_PROCS"])
+    if process_id is None and os.environ.get("PCG_TPU_PROC_ID"):
+        process_id = int(os.environ["PCG_TPU_PROC_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        return jax.process_index()          # single process / TPU pod auto-init
+    global _initialized
+    if not _initialized:
+        # NOTE: must run before anything touches the XLA backend — do not
+        # query jax.process_count() here.
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+    return jax.process_index()
+
+
+_initialized = False
+
+
+def make_global_mesh(n_devices: Optional[int] = None) -> jax.sharding.Mesh:
+    """1-D ``(parts,)`` mesh over all devices of all processes (DCN-aware:
+    jax.devices() enumerates host-local devices first, so contiguous part
+    blocks land host-local and halo traffic prefers ICI)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return jax.sharding.Mesh(np.asarray(devices), (PARTS_AXIS,))
+
+
+def put_sharded(x: np.ndarray, mesh: jax.sharding.Mesh,
+                spec: jax.sharding.PartitionSpec) -> jax.Array:
+    """Host numpy -> sharded global device array.
+
+    Single-process: plain device_put.  Multi-process: each process builds
+    only its addressable shards via make_array_from_callback (every process
+    must hold the rows its devices own; the part-major layout makes that a
+    contiguous block of the leading axis)."""
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_callback(
+        np.shape(x), sharding, lambda idx: np.asarray(x[idx]))
+
+
+def fetch_global(x, mesh: Optional[jax.sharding.Mesh] = None) -> np.ndarray:
+    """Fetch a (possibly multi-host sharded) jax.Array as full host numpy.
+
+    Single-process (or fully addressable) arrays are a plain device_get; a
+    multi-host sharded array is first resharded to fully-replicated (an XLA
+    all-gather over DCN) so every process can read the whole value — the
+    analogue of the reference's Comm.gather-to-rank-0 exports
+    (pcg_solver.py:910-911)."""
+    if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        return np.asarray(x)
+    if mesh is None:
+        mesh = jax.sharding.Mesh(
+            np.asarray(x.sharding.mesh.devices), x.sharding.mesh.axis_names)
+    rep = jax.jit(lambda a: a,
+                  out_shardings=jax.sharding.NamedSharding(
+                      mesh, jax.sharding.PartitionSpec()))(x)
+    return np.asarray(rep)
+
+
+def put_tree(tree, mesh: jax.sharding.Mesh, specs):
+    """put_sharded over a pytree of arrays with a matching pytree of specs
+    (None leaves pass through, as with device_put)."""
+    if jax.process_count() == 1:
+        shardings = jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+        return jax.device_put(tree, shardings)
+
+    def rec(t, s):
+        if t is None:
+            return None
+        if isinstance(t, dict):
+            return {k: rec(v, s[k]) for k, v in t.items()}
+        if isinstance(t, (list, tuple)):
+            return type(t)(rec(v, s[i]) for i, v in enumerate(t))
+        return put_sharded(np.asarray(t), mesh, s)
+
+    return rec(tree, specs)

@@ -120,10 +120,11 @@ class Solver:
             }
             self.ops32 = ops32_factory()
         self._specs = _data_specs(data)
-        self.data = jax.device_put(
-            data, jax.tree.map(lambda s: jax.NamedSharding(self.mesh, s), self._specs,
-                               is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
-        )
+        # Multi-host aware upload: each process materializes only its
+        # addressable shards (parallel/distributed.py).
+        from pcg_mpi_solver_tpu.parallel.distributed import put_tree
+
+        self.data = put_tree(data, self.mesh, self._specs)
 
         self._part_spec = jax.sharding.PartitionSpec(PARTS_AXIS)
         self._rep_spec = jax.sharding.PartitionSpec()
@@ -177,10 +178,11 @@ class Solver:
         # Initial state: deterministic zeros (the reference seeds Un with
         # unseeded 1e-200*rand, pcg_solver.py:996 — an intentional
         # nondeterminism we do not reproduce).
-        self.un = jax.device_put(
-            jnp.zeros((self.pm.n_parts, self.pm.n_loc), dtype),
-            jax.NamedSharding(self.mesh, self._part_spec),
-        )
+        from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
+
+        self.un = put_sharded(
+            np.zeros((self.pm.n_parts, self.pm.n_loc), dtype),
+            self.mesh, self._part_spec)
 
         self._export_fn = None
         self._nu = float(model.mat_prop[0]["Pos"]) if model.mat_prop else 0.2
@@ -202,10 +204,11 @@ class Solver:
     def reset_state(self):
         """Zero the solution, preserving its device sharding (avoids a
         silent retrace on the next step)."""
-        self.un = jax.device_put(
-            jnp.zeros((self.pm.n_parts, self.pm.n_loc), self.dtype),
-            jax.NamedSharding(self.mesh, self._part_spec),
-        )
+        from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
+
+        self.un = put_sharded(
+            np.zeros((self.pm.n_parts, self.pm.n_loc), self.dtype),
+            self.mesh, self._part_spec)
 
     def step(self, delta: float) -> StepResult:
         t0 = time.perf_counter()
@@ -268,6 +271,11 @@ class Solver:
         probe_u = self._probe_u
 
         profiling = bool(self.config.profile_dir) and not self.config.speed_test
+        if self.config.profile_dir and self.config.speed_test:
+            import warnings
+
+            warnings.warn("profile_dir is ignored in speed-test mode "
+                          "(speed_test disables all I/O)")
         if profiling:
             jax.profiler.start_trace(self.config.profile_dir)
 
@@ -440,7 +448,9 @@ class Solver:
     def displacement_owned(self) -> np.ndarray:
         """Owner-masked local solution values, concatenated in part order
         (the per-frame 'U_i' payload, pcg_solver.py:869)."""
-        un = np.asarray(jax.device_get(self.un))
+        from pcg_mpi_solver_tpu.parallel.distributed import fetch_global
+
+        un = fetch_global(self.un, self.mesh)
         return un[self.owner_mask()]
 
     def displacement_global(self) -> np.ndarray:

@@ -47,8 +47,10 @@ def _fingerprint(solver) -> dict:
 
 def state_dict(solver) -> dict:
     """Everything needed to continue ``solve()`` after step ``t``."""
+    from pcg_mpi_solver_tpu.parallel.distributed import fetch_global
+
     return {
-        "un": np.asarray(solver.un),
+        "un": fetch_global(solver.un, solver.mesh),
         "flags": np.asarray(solver.flags, dtype=np.int64),
         "relres": np.asarray(solver.relres, dtype=np.float64),
         "iters": np.asarray(solver.iters, dtype=np.int64),
@@ -64,11 +66,11 @@ def state_dict(solver) -> dict:
 
 
 def load_state_dict(solver, state: dict) -> None:
-    import jax
+    from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
 
-    solver.un = jax.device_put(
+    solver.un = put_sharded(
         np.asarray(state["un"], dtype=solver.dtype),
-        jax.NamedSharding(solver.mesh, solver._part_spec))
+        solver.mesh, solver._part_spec)
     solver.flags = [int(v) for v in state["flags"]]
     solver.relres = [float(v) for v in state["relres"]]
     solver.iters = [int(v) for v in state["iters"]]

@@ -1,0 +1,130 @@
+"""Multi-host (DCN) path: helpers single-process, plus a REAL two-process
+jax.distributed run of the full solver over a split CPU mesh — the
+framework's analogue of the reference's multi-node mpiexec runs (which the
+reference itself never tests without a cluster; SURVEY.md §4.5)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.parallel import make_mesh
+from pcg_mpi_solver_tpu.parallel.distributed import (
+    init_distributed, make_global_mesh, put_sharded, put_tree)
+from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS
+
+
+def test_single_process_no_op_init():
+    assert init_distributed() == 0
+    assert jax.process_count() == 1
+
+
+def test_make_global_mesh():
+    mesh = make_global_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == (PARTS_AXIS,)
+    assert make_global_mesh(4).devices.size == 4
+
+
+def test_put_sharded_matches_device_put():
+    mesh = make_mesh(8)
+    spec = jax.sharding.PartitionSpec(PARTS_AXIS)
+    x = np.arange(8 * 6, dtype=np.float64).reshape(8, 6)
+    a = put_sharded(x, mesh, spec)
+    np.testing.assert_array_equal(np.asarray(a), x)
+    assert a.sharding.spec == spec
+
+
+def test_put_tree_handles_nested_and_none():
+    mesh = make_mesh(8)
+    P = jax.sharding.PartitionSpec
+    tree = {"a": np.ones((8, 4)), "b": [np.zeros((8, 2)), None],
+            "c": np.ones((3, 3))}
+    specs = {"a": P(PARTS_AXIS), "b": [P(PARTS_AXIS), P(PARTS_AXIS)],
+             "c": P()}
+    out = put_tree(tree, mesh, specs)
+    np.testing.assert_array_equal(np.asarray(out["a"]), tree["a"])
+    assert out["b"][1] is None
+    assert out["c"].sharding.spec == P()
+
+
+_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from pcg_mpi_solver_tpu.parallel.distributed import (
+    init_distributed, make_global_mesh)
+
+pid = init_distributed(coordinator_address=sys.argv[1],
+                       num_processes=2, process_id=int(sys.argv[2]))
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
+from pcg_mpi_solver_tpu.models import make_cube_model
+from pcg_mpi_solver_tpu.solver import Solver
+
+model = make_cube_model(6, 4, 4, heterogeneous=True)
+cfg = RunConfig(solver=SolverConfig(tol=1e-8, max_iter=500),
+                time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0],
+                                               export_flag=False))
+s = Solver(model, cfg, mesh=make_global_mesh(), n_parts=8, backend="general")
+res = s.solve()[0]
+print(f"RESULT {pid} flag={res.flag} iters={res.iters} relres={res.relres:.6e}",
+      flush=True)
+assert res.flag == 0
+"""
+
+
+@pytest.mark.skipif(os.environ.get("PCG_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multi-process test disabled")
+def test_two_process_solve(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    procs = [subprocess.Popen([sys.executable, str(script), coord, str(i)],
+                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                              text=True, env=env)
+             for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    results = [l for out in outs for l in out.splitlines()
+               if l.startswith("RESULT")]
+    assert len(results) == 2
+    # both controllers observed the identical converged state
+    assert results[0].split(" ", 2)[2] == results[1].split(" ", 2)[2]
+
+    # and it matches a single-process 8-part solve
+    from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
+    from pcg_mpi_solver_tpu.models import make_cube_model
+    from pcg_mpi_solver_tpu.solver import Solver
+
+    model = make_cube_model(6, 4, 4, heterogeneous=True)
+    cfg = RunConfig(solver=SolverConfig(tol=1e-8, max_iter=500),
+                    time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0],
+                                                   export_flag=False))
+    s1 = Solver(model, cfg, mesh=make_mesh(8), n_parts=8, backend="general")
+    r1 = s1.solve()[0]
+    iters_multi = int(results[0].split("iters=")[1].split()[0])
+    assert abs(r1.iters - iters_multi) <= 1
