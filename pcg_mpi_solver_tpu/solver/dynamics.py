@@ -1,0 +1,203 @@
+"""Explicit elasto-dynamics: central-difference time integration.
+
+The reference is quasi-static, but its data model and utilities are from an
+explicit-dynamics/damage era it kept vestigially: lumped mass ``DiagM`` and
+prescribed-velocity ``Vd`` arrays (partition_mesh.py:324-330), per-element
+mass scale ``Cm`` (:172-175), ``Me.mat`` element mass library (:538-599),
+``dt`` (run_metis.py:19-43), and offline crack-tip velocity post-processing
+(file_operations.py:542-726).  This module makes that capability live,
+TPU-first:
+
+    a_n = M^-1 (Fext(t_n) - K u_n - c_m M v_n)        (lumped M, mass damping)
+    v_{n+1/2} = v_{n-1/2} + dt a_n
+    u_{n+1}  = u_n + dt v_{n+1/2}
+
+with Dirichlet dofs driven as u = Ud*delta(t), v = Vd*delta(t).  The whole
+step loop runs as ONE ``lax.scan`` inside a jitted shard_map program over
+the device mesh — K u_n is the same node-ELL matvec + psum interface
+assembly as the PCG path, probe sampling happens in-scan, and only chunk
+boundaries (export frames) surface to the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pcg_mpi_solver_tpu.config import RunConfig
+from pcg_mpi_solver_tpu.models.model_data import ModelData
+from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
+from pcg_mpi_solver_tpu.parallel.partition import partition_model
+from pcg_mpi_solver_tpu.solver.driver import _data_specs
+
+
+def stable_dt(model: ModelData, safety: float = 0.9) -> float:
+    """CFL estimate: h_min / c_d with c_d = sqrt(E_max/rho_min) the
+    dilatational wave speed (conservative for hex elements)."""
+    E = np.array([m["E"] for m in model.mat_prop], dtype=float)
+    rho = np.array([m.get("Rho", 1.0) for m in model.mat_prop], dtype=float)
+    c = float(np.sqrt((E / rho).max()))
+    # ck = E*h, ce = 1/h  =>  h = 1/ce
+    h_min = float((1.0 / model.ce).min())
+    return safety * h_min / c
+
+
+@dataclasses.dataclass
+class DynamicsResult:
+    u: np.ndarray                 # final global displacement (n_dof,)
+    probe_t: np.ndarray           # (n_steps,)
+    probe_u: np.ndarray           # (n_probe, n_steps)
+    frames: List[np.ndarray]      # exported global displacement frames
+    frame_times: List[float]
+
+
+class DynamicsSolver:
+    """Explicit central-difference solver on the SPMD-partitioned model."""
+
+    def __init__(
+        self,
+        model: ModelData,
+        config: Optional[RunConfig] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        n_parts: Optional[int] = None,
+        dt: Optional[float] = None,
+        damping: float = 0.0,          # c_m: mass-proportional damping
+        probe_dofs: Sequence[int] = (),
+    ):
+        self.config = config or RunConfig()
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n_dev = self.mesh.devices.size
+        n_parts = n_parts or max(self.config.n_parts, n_dev)
+        self.dt = float(dt if dt is not None else
+                        (model.dt if model.dt and model.dt > 0 else
+                         stable_dt(model)))
+        self.damping = float(damping)
+
+        dtype = jnp.dtype(self.config.solver.dtype)
+        if dtype == jnp.float64 and not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+        self.dtype = dtype
+
+        self.pm = partition_model(model, n_parts,
+                                  method=self.config.partition_method)
+        self.ops = Ops.from_model(self.pm, dot_dtype=dtype,
+                                  axis_name=PARTS_AXIS)
+        data = device_data(self.pm, dtype)
+        # Assembled lumped-mass diagonal: model.diag_M is already the global
+        # assembled diagonal, sliced per part (partition extract_NodalVectors
+        # analogue) — no cross-part assembly needed.
+        inv_m = np.where(self.pm.inv_diag_M > 0, self.pm.inv_diag_M, 0.0)
+        data["inv_M"] = jnp.asarray(inv_m, dtype)
+        # Prescribed velocity (reference Vd, partition_mesh.py:324-330),
+        # sliced per part like F/Ud.
+        gid = self.pm.dof_gid
+        data["Vd"] = jnp.asarray(
+            np.where(gid >= 0, model.Vd[np.maximum(gid, 0)], 0.0), dtype)
+
+        # Probe maps: local index of each probe dof per part + owner mask,
+        # so in-scan sampling is a tiny gather + the mesh psum (works under
+        # shard_map where each device only sees its local parts).
+        self._probe = np.asarray(probe_dofs, dtype=np.int64)
+        P_, n_loc_ = gid.shape
+        np_ = len(self._probe)
+        pidx = np.zeros((P_, max(np_, 1)), dtype=np.int32)
+        pmask = np.zeros((P_, max(np_, 1)))
+        for j, d in enumerate(self._probe):
+            hits = np.argwhere((gid == d) & (self.pm.weight > 0))
+            p, i = hits[0]
+            pidx[p, j], pmask[p, j] = i, 1.0
+        data["probe_idx"] = jnp.asarray(pidx, jnp.int32)
+        data["probe_mask"] = jnp.asarray(pmask, dtype)
+        self._specs = _data_specs(data)
+
+        from pcg_mpi_solver_tpu.parallel.distributed import put_sharded, put_tree
+
+        self.data = put_tree(data, self.mesh, self._specs)
+        self._part_spec = jax.sharding.PartitionSpec(PARTS_AXIS)
+        P, n_loc = self.pm.n_parts, self.pm.n_loc
+        self.u = put_sharded(np.zeros((P, n_loc), dtype),
+                             self.mesh, self._part_spec)
+        self.v = put_sharded(np.zeros((P, n_loc), dtype),
+                             self.mesh, self._part_spec)
+
+        ops, dt_, cm = self.ops, self.dt, self.damping
+
+        def _chunk(data, carry, deltas):
+            """Scan over a chunk of steps; deltas: (k,) load factors."""
+            eff = data["eff"]
+            fix = 1.0 - eff
+
+            def body(carry, delta):
+                u, v = carry
+                fint = ops.matvec(data, u)
+                # mass damping: C = c_m M  =>  M^-1 C v = c_m v
+                a = data["inv_M"] * (data["F"] * delta - fint) - cm * v
+                v2 = v + dt_ * a
+                u2 = u + dt_ * v2
+                # Dirichlet driving
+                u2 = eff * u2 + fix * data["Ud"] * delta
+                v2 = eff * v2 + fix * data["Vd"] * delta
+                # owner-masked probe sample, combined over the mesh
+                vals = jnp.take_along_axis(u2, data["probe_idx"], axis=1)
+                probes = ops._psum((vals * data["probe_mask"]).sum(axis=0))
+                return (u2, v2), probes
+
+            (u, v), probe = jax.lax.scan(body, carry, deltas)
+            return u, v, probe
+
+        shard_chunk = jax.shard_map(
+            _chunk, mesh=self.mesh,
+            in_specs=(self._specs, (self._part_spec, self._part_spec),
+                      jax.sharding.PartitionSpec()),
+            out_specs=(self._part_spec, self._part_spec,
+                       jax.sharding.PartitionSpec()),
+            check_vma=False,
+        )
+        self._chunk_fn = jax.jit(shard_chunk)
+
+    def run(self, n_steps: int, load_factor=None,
+            export_every: int = 0) -> DynamicsResult:
+        """Integrate n_steps.  ``load_factor``: scalar, (n_steps,) array, or
+        None (=1.0).  ``export_every``: displacement frames every k steps."""
+        if load_factor is None:
+            deltas = np.ones(n_steps)
+        else:
+            deltas = np.broadcast_to(np.asarray(load_factor, dtype=float),
+                                     (n_steps,)).copy()
+        chunk = export_every if export_every > 0 else n_steps
+        frames, frame_times, probes = [], [], []
+        done = 0
+        u, v = self.u, self.v
+        while done < n_steps:
+            k = min(chunk, n_steps - done)
+            u, v, pr = self._chunk_fn(
+                self.data, (u, v),
+                jnp.asarray(deltas[done:done + k], self.dtype))
+            probes.append(np.asarray(pr))
+            done += k
+            if export_every > 0:
+                frames.append(self._global_u(u))
+                frame_times.append(done * self.dt)
+        self.u, self.v = u, v
+        probe_u = (np.concatenate(probes, axis=0).T[: len(self._probe)]
+                   if probes and len(self._probe) else np.zeros((0, n_steps)))
+        return DynamicsResult(
+            u=self._global_u(u),
+            probe_t=(np.arange(n_steps) + 1) * self.dt,
+            probe_u=probe_u,
+            frames=frames,
+            frame_times=frame_times,
+        )
+
+    def _global_u(self, u) -> np.ndarray:
+        from pcg_mpi_solver_tpu.parallel.distributed import fetch_global
+
+        out = np.zeros(self.pm.glob_n_dof, dtype=self.dtype)
+        m = (self.pm.weight > 0) & (self.pm.dof_gid >= 0)
+        out[self.pm.dof_gid[m]] = fetch_global(u, self.mesh)[m]
+        return out
