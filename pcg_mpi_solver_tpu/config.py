@@ -30,6 +30,12 @@ class SolverConfig:
     inner_tol: float = 1e-5       # per-refinement-cycle residual reduction (mixed)
     # MATLAB-pcg compatibility knobs (pcg_solver.py:399-404)
     max_stag_steps: int = 3
+    # Split the solve into several device dispatches of at most this many
+    # Krylov iterations each (-1 = auto: engage on large problems, sized so
+    # one dispatch stays well under a minute; 0 = single dispatch).  Long
+    # single dispatches can trip execution watchdogs on remote/tunneled
+    # devices; state stays on device between dispatches.
+    iters_per_dispatch: int = -1
 
 
 @dataclasses.dataclass

@@ -87,9 +87,28 @@ def make_cube_model(
         n_incl = max(1, n_elem // 500)
         L = np.array([nx, ny, nz]) * h
         c_incl = rng.uniform(0, 1, (n_incl, 3)) * L
-        r_incl = rng.uniform(0.05, 0.15, n_incl) * L.min()
+        # Cell-scaled radii: with one inclusion per ~500 elements this gives
+        # a mesh-size-independent ~13% stiff volume fraction (domain-scaled
+        # radii saturate to 100% on fine meshes).
+        r_incl = rng.uniform(1.5, 3.5, n_incl) * h
+        # Stamp each sphere only inside its bounding box on the structured
+        # grid (element id = ex + nx*(ey + ny*ez)) — a full-mesh distance
+        # field per inclusion is O(n_incl * n_elem) and unusable at 10M dofs.
+        E3 = E_elem.reshape(nz, ny, nx)
+        ax = (np.arange(nx) + 0.5) * h
+        ay = (np.arange(ny) + 0.5) * h
+        az = (np.arange(nz) + 0.5) * h
         for c, r in zip(c_incl, r_incl):
-            E_elem[np.linalg.norm(centers - c, axis=1) < r] = 10.0 * E
+            i0, i1 = np.searchsorted(ax, [c[0] - r, c[0] + r])
+            j0, j1 = np.searchsorted(ay, [c[1] - r, c[1] + r])
+            k0, k1 = np.searchsorted(az, [c[2] - r, c[2] + r])
+            if i0 >= i1 or j0 >= j1 or k0 >= k1:
+                continue
+            d2 = ((ax[i0:i1][None, None, :] - c[0]) ** 2
+                  + (ay[j0:j1][None, :, None] - c[1]) ** 2
+                  + (az[k0:k1][:, None, None] - c[2]) ** 2)
+            E3[k0:k1, j0:j1, i0:i1][d2 < r * r] = 10.0 * E
+        E_elem = E3.reshape(-1)
         mat = np.where(E_elem > E, 1, 0).astype(np.int32)
         # NonLocStressParam mirrors the reference MatProp schema
         # (partition_mesh.py:515-520); Lc is the nonlocal length scale.
@@ -111,10 +130,11 @@ def make_cube_model(
     ce = np.full(n_elem, 1.0 / h)        # strain scale
     level = np.full(n_elem, h)
 
-    # Lumped mass diagonal.
-    diag_M = np.zeros(n_dof)
+    # Lumped mass diagonal (bincount: np.add.at is ~50x slower at 10M dofs).
     me_rowsum = lib0["Me"].sum(axis=1)
-    np.add.at(diag_M, dofs.ravel(), np.repeat(cm, 24) * np.tile(me_rowsum, n_elem))
+    diag_M = np.bincount(dofs.ravel(),
+                         weights=(cm[:, None] * me_rowsum[None, :]).ravel(),
+                         minlength=n_dof)
 
     # Boundary conditions.
     F = np.zeros(n_dof)

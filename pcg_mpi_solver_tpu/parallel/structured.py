@@ -154,7 +154,27 @@ def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
     )
 
 
+def _conv_kernels(Ke: np.ndarray):
+    """Fold the element stiffness into 3-D conv kernels.
+
+    The slab matvec y = G^T (ck * (Ke G x)) (G = 2x2x2 corner-patch gather)
+    is exactly two small convolutions with the cell-wise ck multiply in
+    between:  Wg[d, c, corner_a] = Ke[d, 3a+c]  (VALID conv, 3->24 ch) and
+    the 0/1 adjoint Ws[c, 3a+c, 1-corner_a] (full-padded conv, 24->3 ch).
+    XLA streams convs with O(1) temps — the slice-chain formulation
+    materialized multi-GB intermediates at 10M dofs.
+    """
+    Wg = np.zeros((24, 3, 2, 2, 2))
+    Ws = np.zeros((3, 24, 2, 2, 2))
+    for a, (dx, dy, dz) in enumerate(_CORNERS):
+        for c in range(3):
+            Wg[:, c, dx, dy, dz] += Ke[:, 3 * a + c]
+            Ws[c, 3 * a + c, 1 - dx, 1 - dy, 1 - dz] = 1.0
+    return Wg, Ws
+
+
 def device_data_structured(sp: StructuredPartition, dtype=jnp.float64) -> dict:
+    Wg, Ws = _conv_kernels(np.asarray(sp.Ke))
     return {
         "blocks": [{
             "Ke": jnp.asarray(sp.Ke, dtype),
@@ -162,6 +182,8 @@ def device_data_structured(sp: StructuredPartition, dtype=jnp.float64) -> dict:
             "Se": jnp.asarray(sp.Se, dtype),
             "ck": jnp.asarray(sp.ck, dtype),
             "ce": jnp.asarray(sp.ce, dtype),
+            "Wg": jnp.asarray(Wg, dtype),
+            "Ws": jnp.asarray(Ws, dtype),
         }],
         "weight": jnp.asarray(sp.weight, dtype),
         "node_weight": jnp.asarray(sp.node_weight, dtype),
@@ -183,6 +205,8 @@ class StructuredOps(Ops):
     ny: int = 0
     nz: int = 0
     n_parts: int = 1
+    # cells above which f64 matvecs run x-slab-chunked (see _chunk_planes)
+    chunk_threshold: int = 500_000
 
     @classmethod
     def from_partition(cls, sp: StructuredPartition, dot_dtype=jnp.float64,
@@ -248,14 +272,66 @@ class StructuredOps(Ops):
         return yg
 
     # -- operator protocol ---------------------------------------------
+    _DN = ("NCXYZ", "OIXYZ", "NCXYZ")
+
+    def _conv_pair(self, blk, xg, ck):
+        """y = conv_full(ck * conv_valid(x)) — the whole matvec."""
+        v = jax.lax.conv_general_dilated(
+            xg, blk["Wg"], (1, 1, 1), "VALID",
+            dimension_numbers=self._DN,
+            precision=self.precision)                  # (P, 24, cells)
+        v = v * ck[:, None]
+        return jax.lax.conv_general_dilated(
+            v, blk["Ws"], (1, 1, 1), ((1, 1), (1, 1), (1, 1)),
+            dimension_numbers=self._DN,
+            precision=self.precision)                  # (P, 3, nodes)
+
+    def _chunk_planes(self, dtype) -> int:
+        """x-slab chunk size for the sequential matvec, or 0 for one shot.
+
+        f64 convs are emulated on TPU with several f32 passes; unchunked at
+        10M dofs the (24ch, cells) intermediates need multi-GB temp buffers
+        and crash the device.  f64 matvecs are rare (Dirichlet lifting +
+        one per refinement cycle), so a fori_loop over x-slabs trades a
+        little latency for bounded memory."""
+        cells = self.nxc * self.ny * self.nz
+        if np.dtype(dtype) != np.float64 or cells < self.chunk_threshold:
+            return 0
+        target = max(1, int(self.chunk_threshold / max(self.ny * self.nz, 1)))
+        # largest divisor of nxc that is <= target
+        for c in range(min(target, self.nxc), 0, -1):
+            if self.nxc % c == 0:
+                return c if c < self.nxc else 0
+        return 0
+
     def matvec_local(self, data, x):
         blk = data["blocks"][0]
-        xg = self._grid(x)
-        u = self._gather_cells(xg)
-        v = jnp.einsum("de,pexyz->pdxyz", blk["Ke"],
-                       blk["ck"][:, None] * u, precision=self.precision)
-        yg = self._scatter_cells(v)
-        return yg.reshape(x.shape)
+        xg = self._grid(x)                             # (P, 3, nxn, nny, nnz)
+        chunk = self._chunk_planes(x.dtype)
+        if chunk == 0:
+            # slice-gather + einsum beats the conv formulation for f32 on
+            # TPU (3-channel convs waste the channel tiling)
+            u = self._gather_cells(xg)
+            v = jnp.einsum("de,pexyz->pdxyz", blk["Ke"],
+                           blk["ck"][:, None] * u, precision=self.precision)
+            return self._scatter_cells(v).reshape(x.shape)
+
+        Pl = xg.shape[0]
+        nxc, ny, nz = self.nxc, self.ny, self.nz
+        n_chunks = nxc // chunk
+
+        def body(i, y):
+            a = i * chunk
+            xs = jax.lax.dynamic_slice(
+                xg, (0, 0, a, 0, 0), (Pl, 3, chunk + 1, ny + 1, nz + 1))
+            cks = jax.lax.dynamic_slice(
+                blk["ck"], (0, a, 0, 0), (Pl, chunk, ny, nz))
+            ys = self._conv_pair(blk, xs, cks)
+            cur = jax.lax.dynamic_slice(y, (0, 0, a, 0, 0), ys.shape)
+            return jax.lax.dynamic_update_slice(y, cur + ys, (0, 0, a, 0, 0))
+
+        y = jax.lax.fori_loop(0, n_chunks, body, jnp.zeros_like(xg))
+        return y.reshape(x.shape)
 
     def matvec(self, data, x):
         yg = self._grid(self.matvec_local(data, x))

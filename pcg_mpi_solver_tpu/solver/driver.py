@@ -175,6 +175,22 @@ class Solver:
         )
         self._step_fn = jax.jit(shard_step)
 
+        # ---- dispatch-chunked solve path (large problems) -----------------
+        # A single device dispatch that runs for minutes can trip execution
+        # watchdogs on remote/tunneled TPUs; above ~4M dofs the solve is
+        # split into host-driven dispatches of at most `cap` Krylov
+        # iterations, with all state resident on device between calls.
+        cap = solver_cfg.iters_per_dispatch
+        if cap < 0:
+            n_loc_dev = self.pm.n_loc * (self.pm.n_parts // n_dev)
+            if self.pm.glob_n_dof < 4_000_000:
+                cap = 0
+            else:
+                cap = max(200, int(45.0 / (4e-9 * max(n_loc_dev, 1))))
+        self._dispatch_cap = int(cap)
+        if self._dispatch_cap > 0:
+            self._build_chunked(solver_cfg, glob_n_eff)
+
         # Initial state: deterministic zeros (the reference seeds Un with
         # unseeded 1e-200*rand, pcg_solver.py:996 — an intentional
         # nondeterminism we do not reproduce).
@@ -201,7 +217,119 @@ class Solver:
         self._proc_step_times: List[float] = []
 
     # ------------------------------------------------------------------
-    def reset_state(self):
+    def _build_chunked(self, scfg, glob_n_eff):
+        """Jitted pieces of the dispatch-chunked solve (see __init__)."""
+        cap = self._dispatch_cap
+        mixed = self.mixed
+
+        def _start(data, un_prev, delta):
+            data64 = data["f64"] if mixed else data
+            eff = data64["eff"]
+            w = data64["weight"] * eff
+            udi = data64["Ud"] * delta
+            fext = eff * (data64["F"] * delta - self.ops.matvec(data64, udi))
+            x0 = eff * un_prev
+            r0 = fext - eff * self.ops.matvec(data64, x0)
+            n2b = jnp.sqrt(self.ops.wdot(w, fext, fext))
+            normr0 = jnp.sqrt(self.ops.wdot(w, r0, r0))
+            return udi, fext, x0, r0, normr0, n2b
+
+        P, R = self._part_spec, self._rep_spec
+        self._start_fn = jax.jit(jax.shard_map(
+            _start, mesh=self.mesh,
+            in_specs=(self._specs, P, R),
+            out_specs=(P, P, P, P, R, R), check_vma=False))
+
+        if mixed:
+            def _cycle(data, fext, x, r, normr, n2b):
+                data32, data64 = data["f32"], data["f64"]
+                eff = data64["eff"]
+                w = data64["weight"] * eff
+                diag32 = self.ops32.diag(data32)
+                inv32 = jnp.where(data32["eff"] > 0, 1.0 / diag32, 0.0)
+                tolb = scfg.tol * n2b
+                tol_cycle = jnp.clip(
+                    0.5 * tolb / jnp.maximum(normr, tolb * 1e-30),
+                    scfg.inner_tol, 0.25).astype(jnp.float32)
+                rhat32 = (r / normr).astype(jnp.float32)
+                inner = pcg(
+                    self.ops32, data32, rhat32, jnp.zeros_like(rhat32),
+                    inv32, tol=tol_cycle, max_iter=cap,
+                    glob_n_dof_eff=glob_n_eff,
+                    max_stag_steps=scfg.max_stag_steps,
+                    max_iter_nominal=scfg.max_iter)
+                x2 = x + inner.x.astype(x.dtype) * normr
+                r2 = fext - eff * self.ops.matvec(data64, x2)
+                normr2 = jnp.sqrt(self.ops.wdot(w, r2, r2))
+                return x2, r2, normr2, inner.iters, inner.flag
+
+            self._cycle_fn = jax.jit(jax.shard_map(
+                _cycle, mesh=self.mesh,
+                in_specs=(self._specs, P, P, P, R, R),
+                out_specs=(P, P, R, R, R), check_vma=False))
+        else:
+            def _cycle(data, fext, x, r, normr, n2b):
+                del r, n2b
+                eff = data["eff"]
+                diag_k = self.ops.diag(data)
+                inv_diag = jnp.where(eff > 0, 1.0 / diag_k, 0.0)
+                res = pcg(
+                    self.ops, data, fext, x, inv_diag,
+                    tol=scfg.tol, max_iter=cap,
+                    glob_n_dof_eff=glob_n_eff,
+                    max_stag_steps=scfg.max_stag_steps,
+                    max_iter_nominal=scfg.max_iter)
+                w = data["weight"] * eff
+                r2 = fext - eff * self.ops.matvec(data, res.x)
+                normr2 = jnp.sqrt(self.ops.wdot(w, r2, r2))
+                return res.x, r2, normr2, res.iters, res.flag
+
+            self._cycle_fn = jax.jit(jax.shard_map(
+                _cycle, mesh=self.mesh,
+                in_specs=(self._specs, P, P, P, R, R),
+                out_specs=(P, P, R, R, R), check_vma=False))
+
+        self._finish_fn = jax.jit(lambda x, udi: x + udi)
+
+    def _step_chunked(self, delta):
+        """Host-driven solve: repeated capped-iteration dispatches.
+
+        Semantics match the one-shot path (same fext/lifting, same inner
+        PCG); chunk boundaries restart the Krylov space in direct mode
+        (slightly more iterations) and align with refinement cycles in
+        mixed mode."""
+        scfg = self.config.solver
+        udi, fext, x, r, normr, n2b = self._start_fn(
+            self.data, self.un, jnp.asarray(delta, self.dtype))
+        n2b_f = float(n2b)
+        if n2b_f == 0.0:
+            self.un = self._finish_fn(jnp.zeros_like(x), udi)
+            return 0, 0.0, 0
+        tolb = scfg.tol * n2b_f
+        total, flag = 0, 1
+        cur = float(normr)
+        if cur <= tolb:
+            flag = 0
+        stall = 0
+        while flag == 1 and total < scfg.max_iter:
+            prev = cur
+            x, r, normr, it, iflag = self._cycle_fn(
+                self.data, fext, x, r, normr, n2b)
+            total += int(it)
+            cur = float(normr)
+            if cur <= tolb:
+                flag = 0
+            elif int(iflag) == 2:
+                flag = 2
+            elif cur > 0.9 * prev:
+                # no meaningful contraction over a whole dispatch
+                stall += 1
+                if stall >= 2:
+                    flag = 3
+            else:
+                stall = 0
+        self.un = self._finish_fn(x, udi)
+        return flag, cur / n2b_f, total
         """Zero the solution, preserving its device sharding (avoids a
         silent retrace on the next step)."""
         from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
@@ -212,14 +340,17 @@ class Solver:
 
     def step(self, delta: float) -> StepResult:
         t0 = time.perf_counter()
-        un, flag, relres, iters = self._step_fn(
-            self.data, self.un, jnp.asarray(delta, self.dtype))
+        if self._dispatch_cap > 0:
+            flag, relres, iters = self._step_chunked(delta)
+        else:
+            un, flag, relres, iters = self._step_fn(
+                self.data, self.un, jnp.asarray(delta, self.dtype))
+            self.un = un
         # Force a value transfer INSIDE the timed region: on tunneled devices
         # block_until_ready can ack before execution finishes; fetching the
         # scalars can't.
         flag, relres, iters = int(flag), float(relres), int(iters)
         wall = time.perf_counter() - t0
-        self.un = un
         res = StepResult(flag, relres, iters, wall)
         self.flags.append(res.flag)
         self.relres.append(res.relres)
@@ -460,7 +591,8 @@ class Solver:
         return out
 
 
-_REPLICATED_KEYS = frozenset({"Ke", "diag_Ke", "Me", "Se", "Ke4", "diag_Ke4"})
+_REPLICATED_KEYS = frozenset(
+    {"Ke", "diag_Ke", "Me", "Se", "Ke4", "diag_Ke4", "Wg", "Ws"})
 
 
 def _data_specs(data):

@@ -272,12 +272,17 @@ def pcg_mixed(
         scale = c["normr"]
         rhat32 = (c["r"] / scale).astype(jnp.float32)
         remaining = jnp.maximum(max_iter - c["total"], 1)
+        # Adaptive inner tolerance: the final cycle only needs to contract
+        # the residual by tolb/normr — a fixed inner_tol would overshoot the
+        # outer tolerance by orders of magnitude (wasted iterations).
+        tol_cycle = jnp.clip(0.5 * tolb / jnp.maximum(scale, tolb * 1e-30),
+                             inner_tol, 0.25).astype(jnp.float32)
         inner = pcg(
             ops32, data32,
             fext=rhat32,
             x0=jnp.zeros_like(rhat32),
             inv_diag=inv_diag32,
-            tol=inner_tol,
+            tol=tol_cycle,
             max_iter=remaining,
             glob_n_dof_eff=glob_n_dof_eff,
             max_stag_steps=max_stag_steps,

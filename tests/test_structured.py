@@ -103,3 +103,23 @@ def test_auto_backend_selection():
     # nx not divisible by parts -> general
     model3 = make_cube_model(6, 4, 4)
     assert Solver(model3, RunConfig(), mesh=mesh, n_parts=4).backend == "general"
+
+def test_chunked_f64_matvec_matches_unchunked():
+    """The x-slab-chunked f64 matvec (memory-bounded path for big meshes)
+    must agree with the one-shot path exactly."""
+    import dataclasses
+
+    from pcg_mpi_solver_tpu.parallel.structured import (
+        StructuredOps, device_data_structured, partition_structured)
+
+    model = make_cube_model(12, 6, 5, heterogeneous=True)
+    sp = partition_structured(model, 2)
+    data = device_data_structured(sp, jnp.float64)
+    ops = StructuredOps.from_partition(sp)
+    ops_chunked = dataclasses.replace(ops, chunk_threshold=1)
+    assert ops_chunked._chunk_planes(jnp.float64) > 0
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(2, sp.n_loc)))
+    y0 = ops.matvec_local(data, x)
+    y1 = ops_chunked.matvec_local(data, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-13, atol=1e-13)
