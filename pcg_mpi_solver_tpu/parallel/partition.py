@@ -59,6 +59,11 @@ def graph_partition(model: ModelData, n_parts: int, ncommon: int = 1,
         return rcb_partition(model.sctrs, n_parts)
     if len(np.unique(part)) != n_parts:
         # The solver needs every part non-empty.
+        if strict:
+            raise RuntimeError(
+                f"partition method 'graph' produced an empty part "
+                f"(n_parts={n_parts}); the explicitly requested graph "
+                "partition cannot be honored — use method='auto' or 'rcb'")
         warnings.warn(
             f"graph partition produced an empty part (n_parts={n_parts}); "
             "falling back to RCB")

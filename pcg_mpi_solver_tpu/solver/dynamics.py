@@ -109,6 +109,10 @@ class DynamicsSolver:
         pmask = np.zeros((P_, max(np_, 1)))
         for j, d in enumerate(self._probe):
             hits = np.argwhere((gid == d) & (self.pm.weight > 0))
+            if len(hits) == 0:
+                raise ValueError(
+                    f"probe dof {int(d)} is not an owned dof of any part "
+                    "(out of range or Dirichlet-constrained everywhere)")
             p, i = hits[0]
             pidx[p, j], pmask[p, j] = i, 1.0
         data["probe_idx"] = jnp.asarray(pidx, jnp.int32)

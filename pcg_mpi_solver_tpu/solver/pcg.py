@@ -36,6 +36,56 @@ class PCGResult(NamedTuple):
     iters: jnp.ndarray    # () int32  (1-based, MATLAB-compatible)
 
 
+def cold_carry(x0, r0, normr0, dot_dtype) -> dict:
+    """Cold-start Krylov carry for resumable ``pcg`` calls: with p=0, rho=1
+    the resumed beta/p recurrence reduces to the standard first iteration
+    p = z.  The single schema shared by every chunked-dispatch call site."""
+    dd = dot_dtype
+    zero_i = jnp.asarray(0, jnp.int32)
+    return dict(
+        x=x0, r=r0, p=jnp.zeros_like(x0),
+        rho=jnp.asarray(1.0, dd),
+        stag=zero_i, moresteps=zero_i,
+        normrmin=jnp.asarray(normr0, dd), xmin=x0, imin=zero_i,
+        normr_act=jnp.asarray(normr0, dd), exec=zero_i)
+
+
+def carry_part_specs(part_spec, rep_spec) -> dict:
+    """shard_map PartitionSpecs for the carry dict (vectors on the parts
+    axis, bookkeeping scalars replicated)."""
+    P, R = part_spec, rep_spec
+    return dict(x=P, r=P, p=P, rho=R, stag=R, moresteps=R,
+                normrmin=R, xmin=P, imin=R, normr_act=R, exec=R)
+
+
+def refine_tol(tolb, normr, inner_tol):
+    """Adaptive inner tolerance for one mixed-precision refinement cycle:
+    the final cycle only needs to contract the residual by tolb/normr — a
+    fixed inner_tol would overshoot the outer tolerance by orders of
+    magnitude (wasted iterations)."""
+    return jnp.clip(0.5 * tolb / jnp.maximum(normr, tolb * 1e-30),
+                    inner_tol, 0.25).astype(jnp.float32)
+
+
+def select_best(ops: Ops, data: dict, fext: jnp.ndarray, carry: dict):
+    """Min-residual fallback for a terminally-failed resumable solve.
+
+    The ``return_carry`` path of ``pcg`` skips MATLAB pcg's min-residual
+    finalize (it would cost one matvec + psum per dispatch whose result the
+    resuming caller discards); the driver applies this once, at actual
+    termination.  Returns (x, relres) matching finalize_bad's semantics."""
+    eff = data["eff"]
+    w = data["weight"] * eff
+    n2b = jnp.sqrt(ops.wdot(w, fext, fext))
+    r_min = fext - eff * ops.matvec(data, carry["xmin"])
+    normr_min = jnp.sqrt(ops.wdot(w, r_min, r_min))
+    use_min = normr_min < carry["normr_act"]
+    x = jnp.where(use_min, carry["xmin"], carry["x"])
+    relres = jnp.where(use_min, normr_min, carry["normr_act"]) / jnp.maximum(
+        n2b, jnp.asarray(np.finfo(np.float32).tiny, n2b.dtype))
+    return x, relres
+
+
 def pcg(
     ops: Ops,
     data: dict,
@@ -48,7 +98,18 @@ def pcg(
     glob_n_dof_eff: int,
     max_stag_steps: int = 3,
     max_iter_nominal: Optional[int] = None,
-) -> PCGResult:
+    carry_in: Optional[dict] = None,
+    return_carry: bool = False,
+):
+    """Returns PCGResult, or (PCGResult, carry) with ``return_carry``.
+
+    ``carry_in`` resumes the Krylov iteration from a previous call's carry
+    (search direction, rho, stagnation/min-residual bookkeeping), making a
+    sequence of capped-budget calls mathematically identical to one long
+    solve — the dispatch-chunked driver path relies on this.  When given,
+    it overrides ``x0`` and the initial-residual matvec.
+    """
+    warm = carry_in is not None
     eff = data["eff"]
     w = data["weight"] * eff
     dt = fext.dtype
@@ -66,8 +127,13 @@ def pcg(
         full product then slices to LocDofEff, pcg_solver.py:482-484)."""
         return eff * ops.matvec(data, v)
 
-    r0 = fext - amul(x0)
-    normr0 = jnp.sqrt(ops.wdot(w, r0, r0))
+    if warm:
+        x0 = carry_in["x"]
+        r0 = carry_in["r"]
+        normr0 = carry_in["normr_act"].astype(ops.dot_dtype)
+    else:
+        r0 = fext - amul(x0)
+        normr0 = jnp.sqrt(ops.wdot(w, r0, r0))
 
     zero_rhs = n2b == 0
     initial_ok = normr0 <= tolb
@@ -75,19 +141,19 @@ def pcg(
     carry0 = dict(
         x=x0,
         r=r0,
-        p=jnp.zeros_like(x0),
-        rho=jnp.asarray(1.0, ops.dot_dtype),
+        p=carry_in["p"] if warm else jnp.zeros_like(x0),
+        rho=carry_in["rho"] if warm else jnp.asarray(1.0, ops.dot_dtype),
         i=jnp.asarray(0, jnp.int32),
         # zero rhs => skip the loop entirely (reference early-returns,
         # pcg_solver.py:387-395); the outputs are forced to zero below.
         flag=jnp.where(zero_rhs | initial_ok, 0, 1).astype(jnp.int32),
-        stag=jnp.asarray(0, jnp.int32),
-        moresteps=jnp.asarray(0, jnp.int32),
+        stag=carry_in["stag"] if warm else jnp.asarray(0, jnp.int32),
+        moresteps=carry_in["moresteps"] if warm else jnp.asarray(0, jnp.int32),
         iter_out=jnp.asarray(0, jnp.int32),
         normr_act=normr0.astype(ops.dot_dtype),
-        normrmin=normr0.astype(ops.dot_dtype),
-        xmin=x0,
-        imin=jnp.asarray(0, jnp.int32),
+        normrmin=carry_in["normrmin"] if warm else normr0.astype(ops.dot_dtype),
+        xmin=carry_in["xmin"] if warm else x0,
+        imin=carry_in["imin"] if warm else jnp.asarray(0, jnp.int32),
     )
 
     def cond(c):
@@ -106,8 +172,14 @@ def pcg(
         bad_rho = (rho == 0) | jnp.isinf(rho)
 
         beta = (rho / c["rho"]).astype(dt)
-        bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
-        p = jnp.where(i == 0, z, z + beta * c["p"])
+        if warm:
+            # Resumed iteration: the beta/p recurrence continues from the
+            # previous call's direction on the very first pass.
+            bad_beta = (beta == 0) | jnp.isinf(beta)
+            p = z + beta * c["p"]
+        else:
+            bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
+            p = jnp.where(i == 0, z, z + beta * c["p"])
 
         q = amul(p)
         pq = ops.wdot(w, p, q)
@@ -204,7 +276,14 @@ def pcg(
         x = jnp.where(use_min, c["xmin"], c["x"])
         return x, relres, iters
 
-    x, relres, iters = jax.lax.cond(c["flag"] == 0, finalize_ok, finalize_bad, c)
+    if return_carry:
+        # Resumable call: skip the min-residual finalize (one matvec + psum
+        # per dispatch the resuming caller would discard) — the caller runs
+        # select_best() once at actual termination.
+        x, relres, iters = finalize_ok(c)
+    else:
+        x, relres, iters = jax.lax.cond(
+            c["flag"] == 0, finalize_ok, finalize_bad, c)
 
     # all-zero rhs => all-zero solution (reference pcg_solver.py:387-395)
     x = jnp.where(zero_rhs, jnp.zeros_like(x), x)
@@ -214,7 +293,19 @@ def pcg(
     iters = jnp.where(zero_rhs | initial_ok, 0, iters + 1)
     flag = jnp.where(zero_rhs, 0, c["flag"]).astype(jnp.int32)
 
-    return PCGResult(x=x, flag=flag, relres=relres.astype(jnp.float32), iters=iters)
+    result = PCGResult(x=x, flag=flag, relres=relres.astype(jnp.float32), iters=iters)
+    if return_carry:
+        # Raw (non-finalized) continuation state: x is the LAST iterate, not
+        # the min-residual fallback — resuming must continue the recurrence.
+        carry = {k: c[k] for k in ("x", "r", "p", "rho", "stag", "moresteps",
+                                   "normrmin", "xmin", "imin", "normr_act")}
+        # Executed body-iteration count for host-side budget accounting
+        # (result.iters reports the min-residual index on failure, which
+        # would undercount).
+        carry["exec"] = jnp.where(zero_rhs | initial_ok, 0,
+                                  c["iter_out"] + 1).astype(jnp.int32)
+        return result, carry
+    return result
 
 
 def pcg_mixed(
@@ -272,11 +363,7 @@ def pcg_mixed(
         scale = c["normr"]
         rhat32 = (c["r"] / scale).astype(jnp.float32)
         remaining = jnp.maximum(max_iter - c["total"], 1)
-        # Adaptive inner tolerance: the final cycle only needs to contract
-        # the residual by tolb/normr — a fixed inner_tol would overshoot the
-        # outer tolerance by orders of magnitude (wasted iterations).
-        tol_cycle = jnp.clip(0.5 * tolb / jnp.maximum(scale, tolb * 1e-30),
-                             inner_tol, 0.25).astype(jnp.float32)
+        tol_cycle = refine_tol(tolb, scale, inner_tol)
         inner = pcg(
             ops32, data32,
             fext=rhat32,
