@@ -82,6 +82,12 @@ class RunConfig:
     # (pcg_solver.py:631-641): collective time shows up as its own ops in
     # the trace instead of host-side timer brackets.
     profile_dir: str = ""
+    # Calc vs comm-wait attribution (the reference's primary scaling
+    # diagnostic, pcg_solver.py:631-641): after a solve with exports, run
+    # this many probe iterations of the PCG body with and without
+    # collectives; the measured difference fills TimeData's
+    # Mean_CommWaitTime.  0 disables the probe (Mean_CommWaitTime = 0).
+    comm_probe_iters: int = 30
     solver: SolverConfig = dataclasses.field(default_factory=SolverConfig)
     time_history: TimeHistoryConfig = dataclasses.field(default_factory=TimeHistoryConfig)
 

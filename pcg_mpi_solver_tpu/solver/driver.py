@@ -535,7 +535,10 @@ class Solver:
             times = [i * th.dt for i in range(1, len(deltas))]
             store.write_plot_data(times, np.stack(probe_u, axis=1), th.probe_dofs)
         if store is not None and not self.config.speed_test:
-            store.write_time_data(self.pm.n_parts, self.time_data(t_prep))
+            comm = (self.measure_comm_split()
+                    if self.config.comm_probe_iters > 0 else None)
+            store.write_time_data(self.pm.n_parts,
+                                  self.time_data(t_prep, comm))
         return results
 
     def _maybe_export(self, store, t: int):
@@ -606,16 +609,89 @@ class Solver:
                 out_specs=self._part_spec, check_vma=False))
         return self._export_fn(self.data, self.un)
 
-    def time_data(self, t_prep: float = 0.0) -> dict:
+    def measure_comm_split(self, n_iters: Optional[int] = None) -> dict:
+        """Measured calc vs comm-wait attribution (the reference brackets
+        every MPI call with host timers, pcg_solver.py:631-641; under XLA
+        the collectives are compiled into the program, so we measure them
+        differentially): time ``n_iters`` of the PCG iteration body — one
+        assembled matvec + the iteration's three scalar reductions — once
+        with real collectives and once with an ``axis_name=None`` clone of
+        the ops (identical local compute, including the interface
+        scatter/gather, but no psums).  The difference is collective time.
+
+        Returns {"comm_frac", "full_s_per_iter", "calc_s_per_iter"}."""
+        if n_iters is None:
+            n_iters = max(self.config.comm_probe_iters, 1)
+        if self.mesh.devices.size == 1:
+            return {"comm_frac": 0.0, "full_s_per_iter": 0.0,
+                    "calc_s_per_iter": 0.0}
+        mixed = self.mixed
+        ops = self.ops32 if mixed else self.ops
+        P, R = self._part_spec, self._rep_spec
+        probe_dtype = jnp.float32 if mixed else self.dtype
+
+        def make(ops_):
+            def run(data, x, n):
+                d = data["f32"] if mixed else data
+                eff = d["eff"]
+                w = d["weight"] * eff
+
+                def body(i, c):
+                    x, acc = c
+                    q = eff * ops_.matvec(d, x)           # iface psum
+                    rho = ops_.wdot(w, x, q)              # psum 1
+                    pq = ops_.wdot(w, q, q)               # psum 2
+                    s3 = ops_.wdots(w, [(x, x), (q, q), (x, q)])  # psum 3
+                    x2 = (q / jnp.sqrt(jnp.maximum(pq, 1e-30))).astype(x.dtype)
+                    # acc consumes every reduction so none is dead code.
+                    return x2, acc + rho + s3.sum()
+
+                return jax.lax.fori_loop(0, n, body, (x, jnp.asarray(0.0, ops_.dot_dtype)))
+
+            return jax.jit(jax.shard_map(
+                run, mesh=self.mesh,
+                in_specs=(self._specs, P, R),
+                out_specs=(P, R), check_vma=False))
+
+        import dataclasses as _dc
+
+        from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
+
+        full_fn = make(ops)
+        local_fn = make(_dc.replace(ops, axis_name=None))
+        x0 = put_sharded(
+            np.ones((self.pm.n_parts, self.pm.n_loc), probe_dtype),
+            self.mesh, P)
+        n = jnp.asarray(n_iters, jnp.int32)
+
+        def timed(fn):
+            jax.block_until_ready(fn(self.data, x0, jnp.asarray(2, jnp.int32)))
+            t0 = time.perf_counter()
+            out = fn(self.data, x0, n)
+            # fetch a scalar: on tunneled devices block_until_ready can ack
+            # before execution finishes (same caveat as step()).
+            float(out[1])
+            return (time.perf_counter() - t0) / n_iters
+
+        full_t = timed(full_fn)
+        local_t = timed(local_fn)
+        comm = max(full_t - local_t, 0.0)
+        return {"comm_frac": comm / full_t if full_t > 0 else 0.0,
+                "full_s_per_iter": full_t,
+                "calc_s_per_iter": full_t - comm}
+
+    def time_data(self, t_prep: float = 0.0,
+                  comm_split: Optional[dict] = None) -> dict:
         """Solve metadata in the reference's TimeData schema
         (file_operations.py:72-172, pcg_solver.py:943-961), extended with a
         compile-time estimate, export-time bucket and per-part load-unbalance
         stats (reference LoadUnbalanceData, file_operations.py:118-128).
 
-        The reference's calc vs comm-wait split brackets every MPI call with
-        host timers; under XLA the collectives compile into the program, so
-        the per-op split lives in the profiler trace (config.profile_dir),
-        not in host-side buckets."""
+        ``comm_split`` (from :meth:`measure_comm_split`) apportions the
+        measured step time into the reference's two buckets
+        (Mean_CalcTime / Mean_CommWaitTime); without it the whole step time
+        is reported as calc (per-op detail lives in the profiler trace,
+        config.profile_dir)."""
         steps = np.asarray(self.step_times)
         # First step run IN THIS PROCESS pays the XLA compile; checkpoint-
         # restored step times never include this process's compile.
@@ -636,10 +712,13 @@ class Solver:
             "MaxByMeanDofs": float(dofs_pp.max() / max(dofs_pp.mean(), 1)),
             "IfaceDofFrac": float(self.pm.n_iface / max(self.pm.glob_n_dof, 1)),
         }
+        total = float(np.sum(self.step_times))
+        comm_frac = comm_split["comm_frac"] if comm_split else 0.0
         return {
             "Mean_FileReadTime": t_prep,
-            "Mean_CalcTime": float(np.sum(self.step_times)),
-            "Mean_CommWaitTime": 0.0,  # see docstring: use profile_dir
+            "Mean_CalcTime": total * (1.0 - comm_frac),
+            "Mean_CommWaitTime": total * comm_frac,
+            "CommProbe": comm_split or {},
             "Compile_Time_Est": max(compile_est, 0.0),
             "Export_Time": float(self._export_wall),
             "TotalTime": t_prep + float(np.sum(self.step_times)),
@@ -685,7 +764,7 @@ class Solver:
 
     def displacement_global(self) -> np.ndarray:
         """Full global solution vector (n_dof,), assembled on host."""
-        out = np.zeros(self.pm.glob_n_dof, dtype=np.asarray(self.un).dtype)
+        out = np.zeros(self.pm.glob_n_dof, dtype=np.dtype(self.dtype))
         out[self.export_dof_map()] = self.displacement_owned()
         return out
 

@@ -16,6 +16,7 @@ and solver configuration guards against resuming with mismatched state.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Optional, Tuple
@@ -23,13 +24,41 @@ from typing import Optional, Tuple
 import numpy as np
 
 
+def _model_hash(solver) -> str:
+    """Content hash of the model the solver was built from: resuming a
+    checkpoint against a model with identical shapes but different material
+    fields / loads / partitioning would silently produce garbage."""
+    h = hashlib.sha256()
+    m = getattr(solver, "_model", None)
+    if m is not None:
+        for arr in (m.ck, m.cm, m.ce, m.F, m.Ud, m.fixed_dof,
+                    m.elem_type, m.elem_dofs_flat, m.elem_sign_flat,
+                    m.node_coords):
+            a = np.ascontiguousarray(arr)
+            h.update(str(a.dtype).encode())
+            h.update(a.tobytes())
+        # The material law enters only via the per-type element matrices
+        # (e.g. a different Poisson ratio changes Ke but none of the arrays
+        # above) and mat_prop — hash them too.
+        for t in sorted(m.elem_lib):
+            h.update(np.ascontiguousarray(m.elem_lib[t]["Ke"]).tobytes())
+        h.update(repr(sorted(
+            (sorted((k, repr(v)) for k, v in mp.items())) for mp in m.mat_prop
+        )).encode())
+    ep = getattr(solver.pm, "elem_part", None)
+    if ep is not None:
+        h.update(np.ascontiguousarray(ep).tobytes())
+    return h.hexdigest()
+
+
 def _fingerprint(solver) -> dict:
     """Everything that must not drift between checkpoint and resume: the
-    numerics (precision/tol), the schedule values, and the export/plot
-    config (counters in the state refer to them)."""
+    model content, the numerics (precision/tol), the schedule values, and
+    the export/plot config (counters in the state refer to them)."""
     cfg = solver.config
     th = cfg.time_history
     return {
+        "model_hash": _model_hash(solver),
         "glob_n_dof": int(solver.pm.glob_n_dof),
         "n_parts": int(solver.pm.n_parts),
         "n_loc": int(solver.pm.n_loc),
@@ -92,11 +121,19 @@ class CheckpointManager:
         return os.path.join(self.path, f"ckpt_{t:06d}.npz")
 
     def save(self, solver, t: int) -> str:
-        """Checkpoint solver state after completed step ``t``."""
-        os.makedirs(self.path, exist_ok=True)
-        out = self._ckpt_file(t)
-        tmp = out + ".tmp"
+        """Checkpoint solver state after completed step ``t``.
+
+        Multi-host safe: state_dict's device fetch is collective and runs on
+        every process; only process 0 touches the filesystem (the analogue
+        of the reference's rank-0-gated writes, file_operations.py:348-396)."""
         payload = dict(state_dict(solver))
+        from pcg_mpi_solver_tpu.utils.io import is_primary
+
+        out = self._ckpt_file(t)
+        if not is_primary():
+            return out
+        os.makedirs(self.path, exist_ok=True)
+        tmp = out + ".tmp"
         payload["t"] = np.int64(t)
         payload["fingerprint"] = np.frombuffer(
             json.dumps(_fingerprint(solver), sort_keys=True).encode(),

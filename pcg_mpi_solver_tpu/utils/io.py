@@ -24,6 +24,19 @@ from typing import Dict
 import numpy as np
 
 
+def is_primary() -> bool:
+    """True on the one process that performs result-file writes (the
+    reference gates shared-file writes on rank 0 / uses MPI-IO offsets,
+    file_operations.py:348-396; here process 0 writes, everyone computes).
+    Local import so io stays importable without initializing jax."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
 def exportz(filename: str, data) -> None:
     """zlib-compressed pickle (reference file_operations.py:32-38)."""
     with open(filename, "wb") as f:
@@ -36,18 +49,36 @@ def importz(filename: str):
 
 
 class RunStore:
-    """Owns one Results_Run directory."""
+    """Owns one Results_Run directory.
 
-    def __init__(self, result_path: str, model_name: str = "model"):
+    Multi-host safe: every write method is a no-op on non-primary processes
+    (callers still evaluate their — possibly collective — arguments on all
+    processes, so device fetches stay in sync; only the file I/O is gated,
+    matching the reference's rank-0 write gating)."""
+
+    def __init__(self, result_path: str, model_name: str = "model",
+                 primary: bool = None):
         self.result_path = result_path.rstrip("/")
         self.model_name = model_name
         self.res_vec_path = f"{self.result_path}/ResVecData"
         self.plot_path = f"{self.result_path}/PlotData"
         self.vtk_path = f"{self.result_path}/VTKs"
+        # Lazily resolved at first write: is_primary() touches the JAX
+        # backend, and a RunStore may be constructed before
+        # jax.distributed.initialize().
+        self._primary = primary
+
+    @property
+    def primary(self) -> bool:
+        if self._primary is None:
+            self._primary = is_primary()
+        return self._primary
 
     def prepare(self) -> None:
         """Create result dirs; an existing run dir is renamed with a
         timestamp (crude run protection, reference pcg_solver.py:67-70)."""
+        if not self.primary:
+            return
         if os.path.exists(self.result_path):
             stamp = datetime.now().strftime("%d%m%Y_%H%M%S")
             os.rename(self.result_path, f"{self.result_path}_{stamp}")
@@ -56,12 +87,16 @@ class RunStore:
 
     # -- maps and frames ------------------------------------------------
     def write_map(self, name: str, ids: np.ndarray) -> None:
+        if not self.primary:
+            return
         np.save(f"{self.res_vec_path}/{name}.npy", ids)
 
     def read_map(self, name: str) -> np.ndarray:
         return np.load(f"{self.res_vec_path}/{name}.npy")
 
     def write_frame(self, var: str, k: int, values: np.ndarray) -> None:
+        if not self.primary:
+            return
         np.save(f"{self.res_vec_path}/{var}_{k}.npy", values)
 
     def read_frame(self, var: str, k: int) -> np.ndarray:
@@ -73,6 +108,8 @@ class RunStore:
         return len(glob.glob(f"{self.res_vec_path}/{var}_*.npy"))
 
     def write_time_list(self, times) -> None:
+        if not self.primary:
+            return
         np.save(f"{self.res_vec_path}/Time_T.npy", np.asarray(times))
 
     def read_time_list(self) -> np.ndarray:
@@ -83,6 +120,8 @@ class RunStore:
         """Probe-dof displacement history: .npz + .mat + rendered PNG
         (reference exportHistoryPlotData + TestPlot PNG,
         pcg_solver.py:817-838, 899-940)."""
+        if not self.primary:
+            return
         data = {"Plot_T": np.asarray(plot_t), "Plot_U": np.asarray(plot_u),
                 "Plot_Dof": np.asarray(plot_dofs) + 1}
         np.savez_compressed(f"{self.plot_path}/{self.model_name}_PlotData",
@@ -112,6 +151,8 @@ class RunStore:
     def write_time_data(self, n_parts: int, time_data: Dict) -> None:
         """Solve metadata: per-step Flag/RelRes/Iter + timing buckets
         (reference exportTimeData, pcg_solver.py:943-961)."""
+        if not self.primary:
+            return
         name = f"{self.plot_path}/{self.model_name}_MP{n_parts}_TimeData"
         np.savez_compressed(name, TimeData=np.array(time_data, dtype=object))
         _savemat(name + ".mat", time_data)

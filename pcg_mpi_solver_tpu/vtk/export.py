@@ -6,13 +6,20 @@ per-frame payloads via the Dof/NodeId maps (reference: A[RefDof] = InpData,
 export_vtk.py:251) and writes one .vtu per frame.
 
 Modes (export_vtk.py:84-258):
-- ``Full``      — every mesh face, fields on all nodes
+- ``Full``      — every stored mesh face, fields on all nodes
 - ``MidSlices`` — faces lying on the three mid-planes of the domain
-- ``Boundary``  — faces appearing in exactly one cell (true boundary)
+- ``Boundary``  — faces with incidence exactly 1 over the stored face list
+  (reference bincounts PolysFlat and keeps count==1 faces,
+  export_vtk.py:105-113).  Models that store every element face (octree
+  generator) get the true boundary; models that pre-store only boundary
+  faces (structured cube) see every face count 1, which is already the
+  boundary.
 - ``Delaunay``  — tetrahedralization of the point cloud
 
-Frame loop parallelism: the reference round-robins frames over MPI ranks
-(export_vtk.py:231); here a multiprocessing pool does the same on host cores.
+All face selections are vectorized (length-grouped gathers — no per-face
+Python loop), and the frame loop can fan out over a process pool
+(``n_workers``), the host-side analogue of the reference round-robining
+frames over MPI ranks (export_vtk.py:231).
 """
 
 from __future__ import annotations
@@ -33,48 +40,105 @@ from pcg_mpi_solver_tpu.vtk.writer import (
 SCALAR_VARS = ("D", "ES", "NS", "PS1", "PS2", "PS3", "PE1", "PE2", "PE3")
 
 
+def _face_table(flat, offset):
+    """Ragged faces -> list of (face_ids, (n, L) node array) per length."""
+    lens = offset[1:] - offset[:-1]
+    out = []
+    for L in np.unique(lens):
+        idx = np.where(lens == L)[0]
+        cols = offset[idx][:, None] + np.arange(L)[None, :]
+        out.append((idx, flat[cols]))
+    return out
+
+
+def _select_faces(model: ModelData, mode: str) -> np.ndarray:
+    """Face ids (into model.faces_offset) selected by the export mode."""
+    flat, offset = model.faces_flat, model.faces_offset
+    n_faces = len(offset) - 1
+    if mode == "Full":
+        return np.arange(n_faces)
+
+    if mode == "Boundary":
+        # Face-incidence counting: interior faces are stored by both of
+        # their cells, boundary faces once (export_vtk.py:105-113).
+        keep = []
+        for idx, arr in _face_table(flat, offset):
+            key = np.sort(arr, axis=1)
+            _, inv, counts = np.unique(key, axis=0, return_inverse=True,
+                                       return_counts=True)
+            keep.append(idx[counts[inv] == 1])
+        return np.sort(np.concatenate(keep)) if keep else np.zeros(0, int)
+
+    if mode == "MidSlices":
+        # Faces whose nodes all lie on one of the three mid-planes
+        # (reference export_vtk.py:86-103), fully vectorized.
+        coords = model.node_coords
+        lch = float(coords.max() - coords.min()) or 1.0
+        table = _face_table(flat, offset)
+        sel = []
+        for axis in range(3):
+            x = coords[:, axis]
+            mid = 0.5 * (x.min() + x.max())
+            on_plane = np.abs(x - mid) / lch < 1e-8
+            for idx, arr in table:
+                sel.append(idx[np.all(on_plane[arr], axis=1)])
+        return np.unique(np.concatenate(sel)) if sel else np.zeros(0, int)
+
+    raise ValueError(f"unknown mode {mode!r}")
+
+
 def _faces_of(model: ModelData, mode: str):
-    """(flat, offsets_1based_end, celltypes, node_subset or None)"""
+    """(flat, offsets_1based_end, celltypes)"""
     if mode == "Delaunay":
         from scipy.spatial import Delaunay
 
         polys = Delaunay(model.node_coords).simplices
         flat = polys.ravel()
         offs = np.arange(1, len(polys) + 1) * 4
-        return flat, offs, np.full(len(polys), VTK_TETRA, np.uint8), None
+        return flat, offs, np.full(len(polys), VTK_TETRA, np.uint8)
 
     if model.faces_flat is None:
         raise ValueError("model has no face topology; use Delaunay mode")
     flat, offset = model.faces_flat, model.faces_offset
-    n_faces = len(offset) - 1
-
-    if mode in ("Full", "Boundary"):
-        # our ModelData stores boundary faces already; Boundary == Full here
-        sel = np.arange(n_faces)
-    elif mode == "MidSlices":
-        # faces whose nodes all lie on one of the three mid-planes
-        # (reference export_vtk.py:86-103)
-        coords = model.node_coords
-        lch = coords.max() - coords.min()
-        sel = []
-        for axis in range(3):
-            x = coords[:, axis]
-            mid = 0.5 * (x.min() + x.max())
-            on_plane = np.abs(x - mid) / lch < 1e-8
-            for f in range(n_faces):
-                nodes = flat[offset[f]:offset[f + 1]]
-                if np.all(on_plane[nodes]):
-                    sel.append(f)
-        sel = np.asarray(sorted(set(sel)), dtype=int)
-    else:
-        raise ValueError(f"unknown mode {mode!r}")
+    sel = _select_faces(model, mode)
 
     lens = offset[1:] - offset[:-1]
-    sel_flat = np.concatenate([flat[offset[f]:offset[f + 1]] for f in sel]) \
-        if len(sel) else np.zeros(0, int)
-    sel_offs = np.cumsum(lens[sel])
+    starts = offset[sel]
+    sel_lens = lens[sel]
+    if len(sel):
+        # vectorized ragged gather
+        reps = np.repeat(starts, sel_lens)
+        within = np.arange(int(sel_lens.sum())) - np.repeat(
+            np.cumsum(sel_lens) - sel_lens, sel_lens)
+        sel_flat = flat[reps + within]
+        sel_offs = np.cumsum(sel_lens)
+    else:
+        sel_flat, sel_offs = np.zeros(0, int), np.zeros(0, int)
     ctype = np.full(len(sel), VTK_POLYGON, np.uint8)
-    return sel_flat, sel_offs, ctype, None
+    return sel_flat, sel_offs, ctype
+
+
+def _write_frame(args):
+    """One frame -> one .vtu (top-level function: picklable for the pool)."""
+    (i, store, model, export_vars, dof_map, node_map,
+     points, flat, offs, ctype) = args
+    from pcg_mpi_solver_tpu.utils.postproc import (
+        global_dof_frame, global_nodal_frame)
+
+    point_data = {}
+    for var in export_vars:
+        if var == "U":
+            a = global_dof_frame(store, model, i, dof_map)
+            point_data["U"] = (np.ascontiguousarray(a[0::3]),
+                               np.ascontiguousarray(a[1::3]),
+                               np.ascontiguousarray(a[2::3]))
+        elif var in SCALAR_VARS:
+            point_data[var] = global_nodal_frame(store, model, var, i,
+                                                 node_map)
+        else:
+            raise ValueError(f"unknown export var {var!r}")
+    path = f"{store.vtk_path}/{store.model_name}_{i}"
+    return write_vtu(path, points, flat, offs, ctype, point_data=point_data)
 
 
 def export_vtk(
@@ -83,10 +147,15 @@ def export_vtk(
     export_vars: Sequence[str] = ("U",),
     mode: str = "Full",
     frames: Optional[Sequence[int]] = None,
+    n_workers: int = 0,
 ) -> list:
-    """Write one .vtu per exported frame; returns the file list."""
+    """Write one .vtu per exported frame; returns the file list.
+
+    ``n_workers > 1`` fans frames out over a fork-based process pool
+    (frames are independent; the reference uses ``i % N_Workers == Rank``
+    round-robin over MPI ranks, export_vtk.py:231)."""
     os.makedirs(store.vtk_path, exist_ok=True)
-    flat, offs, ctype, _ = _faces_of(model, mode)
+    flat, offs, ctype = _faces_of(model, mode)
 
     dof_map = store.read_map("Dof")
     node_map = None
@@ -101,26 +170,18 @@ def export_vtk(
               np.ascontiguousarray(model.node_coords[:, 1]),
               np.ascontiguousarray(model.node_coords[:, 2]))
 
-    from pcg_mpi_solver_tpu.utils.postproc import (
-        global_dof_frame, global_nodal_frame)
+    jobs = [(i, store, model, tuple(export_vars), dof_map, node_map,
+             points, flat, offs, ctype) for i in frames]
+    if n_workers > 1 and len(jobs) > 1:
+        import multiprocessing as mp
 
-    written = []
-    for i in frames:
-        point_data = {}
-        for var in export_vars:
-            if var == "U":
-                a = global_dof_frame(store, model, i, dof_map)
-                point_data["U"] = (np.ascontiguousarray(a[0::3]),
-                                   np.ascontiguousarray(a[1::3]),
-                                   np.ascontiguousarray(a[2::3]))
-            elif var in SCALAR_VARS:
-                point_data[var] = global_nodal_frame(store, model, var, i,
-                                                     node_map)
-            else:
-                raise ValueError(f"unknown export var {var!r}")
-        path = f"{store.vtk_path}/{store.model_name}_{i}"
-        written.append(write_vtu(path, points, flat, offs, ctype,
-                                 point_data=point_data))
+        # spawn, not fork: the parent typically holds a multithreaded JAX
+        # runtime (fork would risk deadlock).  The worker import chain is
+        # numpy-only (no jax), so spawn startup is cheap.
+        with mp.get_context("spawn").Pool(min(n_workers, len(jobs))) as pool:
+            written = pool.map(_write_frame, jobs)
+    else:
+        written = [_write_frame(j) for j in jobs]
 
     # frame-time index (reference VTKInfo.txt, export_vtk.py:169-174)
     times = store.read_time_list()

@@ -72,16 +72,36 @@ assert jax.device_count() == 8, jax.device_count()
 from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
 from pcg_mpi_solver_tpu.models import make_cube_model
 from pcg_mpi_solver_tpu.solver import Solver
+from pcg_mpi_solver_tpu.utils.io import RunStore
 
+# Exports + checkpointing ON: every process computes (collective fetches),
+# only process 0 writes (multi-host-safe write gating).
+scratch = sys.argv[3]
 model = make_cube_model(6, 4, 4, heterogeneous=True)
-cfg = RunConfig(solver=SolverConfig(tol=1e-8, max_iter=500),
-                time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0],
-                                               export_flag=False))
+cfg = RunConfig(scratch_path=scratch, run_id="mh", checkpoint_every=1,
+                solver=SolverConfig(tol=1e-8, max_iter=500),
+                time_history=TimeHistoryConfig(
+                    time_step_delta=[0.0, 0.5, 1.0],
+                    export_flag=True, export_frame_rate=1,
+                    plot_flag=True, probe_dofs=(3, 10)))
 s = Solver(model, cfg, mesh=make_global_mesh(), n_parts=8, backend="general")
-res = s.solve()[0]
+store = RunStore(cfg.result_path)
+res = s.solve(store=store)[-1]
+import glob as _glob
+n_frames = len(_glob.glob(os.path.join(cfg.result_path, "ResVecData", "U_*.npy")))
+n_ckpts = len(_glob.glob(os.path.join(cfg.checkpoint_path, "ckpt_*.npz")))
 print(f"RESULT {pid} flag={res.flag} iters={res.iters} relres={res.relres:.6e}",
       flush=True)
+print(f"FILES {pid} primary={store.primary} frames={n_frames} ckpts={n_ckpts}",
+      flush=True)
 assert res.flag == 0
+assert store.primary == (pid == 0)
+if pid == 0:
+    # One consistent results dir, written only by the primary (the
+    # non-primary may still be counting while these writes land, so only
+    # the writer asserts counts).
+    assert n_frames == 3, n_frames   # steps 0, 1, 2 at frame_rate 1
+    assert n_ckpts == 2, n_ckpts     # steps 1, 2
 """
 
 
@@ -99,9 +119,11 @@ def test_two_process_solve(tmp_path):
     env["PYTHONPATH"] = os.pathsep.join(
         [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
         + env.get("PYTHONPATH", "").split(os.pathsep))
-    procs = [subprocess.Popen([sys.executable, str(script), coord, str(i)],
-                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                              text=True, env=env)
+    scratch = tmp_path / "scratch"
+    procs = [subprocess.Popen(
+                 [sys.executable, str(script), coord, str(i), str(scratch)],
+                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                 text=True, env=env)
              for i in range(2)]
     outs = []
     for p in procs:
@@ -122,9 +144,9 @@ def test_two_process_solve(tmp_path):
 
     model = make_cube_model(6, 4, 4, heterogeneous=True)
     cfg = RunConfig(solver=SolverConfig(tol=1e-8, max_iter=500),
-                    time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0],
-                                                   export_flag=False))
+                    time_history=TimeHistoryConfig(
+                        time_step_delta=[0.0, 0.5, 1.0], export_flag=False))
     s1 = Solver(model, cfg, mesh=make_mesh(8), n_parts=8, backend="general")
-    r1 = s1.solve()[0]
+    r1 = s1.solve()[-1]
     iters_multi = int(results[0].split("iters=")[1].split()[0])
     assert abs(r1.iters - iters_multi) <= 1
