@@ -154,27 +154,7 @@ def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
     )
 
 
-def _conv_kernels(Ke: np.ndarray):
-    """Fold the element stiffness into 3-D conv kernels.
-
-    The slab matvec y = G^T (ck * (Ke G x)) (G = 2x2x2 corner-patch gather)
-    is exactly two small convolutions with the cell-wise ck multiply in
-    between:  Wg[d, c, corner_a] = Ke[d, 3a+c]  (VALID conv, 3->24 ch) and
-    the 0/1 adjoint Ws[c, 3a+c, 1-corner_a] (full-padded conv, 24->3 ch).
-    XLA streams convs with O(1) temps — the slice-chain formulation
-    materialized multi-GB intermediates at 10M dofs.
-    """
-    Wg = np.zeros((24, 3, 2, 2, 2))
-    Ws = np.zeros((3, 24, 2, 2, 2))
-    for a, (dx, dy, dz) in enumerate(_CORNERS):
-        for c in range(3):
-            Wg[:, c, dx, dy, dz] += Ke[:, 3 * a + c]
-            Ws[c, 3 * a + c, 1 - dx, 1 - dy, 1 - dz] = 1.0
-    return Wg, Ws
-
-
 def device_data_structured(sp: StructuredPartition, dtype=jnp.float64) -> dict:
-    Wg, Ws = _conv_kernels(np.asarray(sp.Ke))
     return {
         "blocks": [{
             "Ke": jnp.asarray(sp.Ke, dtype),
@@ -182,8 +162,6 @@ def device_data_structured(sp: StructuredPartition, dtype=jnp.float64) -> dict:
             "Se": jnp.asarray(sp.Se, dtype),
             "ck": jnp.asarray(sp.ck, dtype),
             "ce": jnp.asarray(sp.ce, dtype),
-            "Wg": jnp.asarray(Wg, dtype),
-            "Ws": jnp.asarray(Ws, dtype),
         }],
         "weight": jnp.asarray(sp.weight, dtype),
         "node_weight": jnp.asarray(sp.node_weight, dtype),
@@ -223,23 +201,24 @@ class StructuredOps(Ops):
         return x.reshape(Pl, 3, self.nxc + 1, self.ny + 1, self.nz + 1)
 
     def _gather_cells(self, xg):
-        """(Pl,3,nxn,nny,nnz) -> (Pl,24,nxc,ny,nz) via 8 contiguous slices."""
-        nxc, ny, nz = self.nxc, self.ny, self.nz
+        """(Pl,3,cx+1,cy+1,cz+1) -> (Pl,24,cx,cy,cz) via 8 contiguous
+        slices (cell shape derived from the node grid, so x-slab chunks
+        work through the same code)."""
+        cx, cy, cz = xg.shape[2] - 1, xg.shape[3] - 1, xg.shape[4] - 1
         slots = []
         for a in range(8):
             dx, dy, dz = _CORNERS[a]
-            s = xg[:, :, dx:dx + nxc, dy:dy + ny, dz:dz + nz]
+            s = xg[:, :, dx:dx + cx, dy:dy + cy, dz:dz + cz]
             slots.append(s)
         return jnp.concatenate(slots, axis=1)  # dof order: 3*corner + comp
 
     def _scatter_cells(self, v):
-        """(Pl,24,nxc,ny,nz) -> (Pl,3,nxn,nny,nnz) via 8 shifted adds."""
-        Pl = v.shape[0]
-        nxc, ny, nz = self.nxc, self.ny, self.nz
-        y = jnp.zeros((Pl, 3, nxc + 1, ny + 1, nz + 1), v.dtype)
+        """(Pl,24,cx,cy,cz) -> (Pl,3,cx+1,cy+1,cz+1) via 8 shifted adds."""
+        Pl, cx, cy, cz = v.shape[0], v.shape[2], v.shape[3], v.shape[4]
+        y = jnp.zeros((Pl, 3, cx + 1, cy + 1, cz + 1), v.dtype)
         for a in range(8):
             dx, dy, dz = _CORNERS[a]
-            y = y.at[:, :, dx:dx + nxc, dy:dy + ny, dz:dz + nz].add(
+            y = y.at[:, :, dx:dx + cx, dy:dy + cy, dz:dz + cz].add(
                 v[:, 3 * a:3 * a + 3])
         return y
 
@@ -272,28 +251,18 @@ class StructuredOps(Ops):
         return yg
 
     # -- operator protocol ---------------------------------------------
-    _DN = ("NCXYZ", "OIXYZ", "NCXYZ")
-
-    def _conv_pair(self, blk, xg, ck):
-        """y = conv_full(ck * conv_valid(x)) — the whole matvec."""
-        v = jax.lax.conv_general_dilated(
-            xg, blk["Wg"], (1, 1, 1), "VALID",
-            dimension_numbers=self._DN,
-            precision=self.precision)                  # (P, 24, cells)
-        v = v * ck[:, None]
-        return jax.lax.conv_general_dilated(
-            v, blk["Ws"], (1, 1, 1), ((1, 1), (1, 1), (1, 1)),
-            dimension_numbers=self._DN,
-            precision=self.precision)                  # (P, 3, nodes)
-
     def _chunk_planes(self, dtype) -> int:
         """x-slab chunk size for the sequential matvec, or 0 for one shot.
 
-        f64 convs are emulated on TPU with several f32 passes; unchunked at
-        10M dofs the (24ch, cells) intermediates need multi-GB temp buffers
-        and crash the device.  f64 matvecs are rare (Dirichlet lifting +
-        one per refinement cycle), so a fori_loop over x-slabs trades a
-        little latency for bounded memory."""
+        f64 arithmetic on TPU is software-emulated (several f32 passes per
+        op); unchunked at 10M dofs the f64 (24, cells) gather/product
+        intermediates need multi-GB temp buffers.  f64 matvecs are rare
+        (Dirichlet lifting + one true-residual per refinement cycle), so a
+        fori_loop over x-slabs trades a little latency for bounded memory.
+        The body is the same gather/einsum/scatter as the one-shot path —
+        f64 conv lowerings proved pathological on real v5e (the remote
+        compile never returned), while the f64 einsum path is routinely
+        exercised; see bench history r01-r02."""
         cells = self.nxc * self.ny * self.nz
         if np.dtype(dtype) != np.float64 or cells < self.chunk_threshold:
             return 0
@@ -304,17 +273,21 @@ class StructuredOps(Ops):
                 return c if c < self.nxc else 0
         return 0
 
+    def _gse(self, blk, xg, ck):
+        """gather -> Ke einsum -> scatter on one x-slab (the whole matvec)."""
+        u = self._gather_cells(xg)                     # (P, 24, cells)
+        v = jnp.einsum("de,pexyz->pdxyz", blk["Ke"], ck[:, None] * u,
+                       precision=self.precision)
+        return self._scatter_cells(v)
+
     def matvec_local(self, data, x):
         blk = data["blocks"][0]
         xg = self._grid(x)                             # (P, 3, nxn, nny, nnz)
         chunk = self._chunk_planes(x.dtype)
         if chunk == 0:
-            # slice-gather + einsum beats the conv formulation for f32 on
-            # TPU (3-channel convs waste the channel tiling)
-            u = self._gather_cells(xg)
-            v = jnp.einsum("de,pexyz->pdxyz", blk["Ke"],
-                           blk["ck"][:, None] * u, precision=self.precision)
-            return self._scatter_cells(v).reshape(x.shape)
+            # slice-gather + einsum: contiguous slices, MXU matmul, shifted
+            # slice-adds — no vector gather/scatter anywhere.
+            return self._gse(blk, xg, blk["ck"]).reshape(x.shape)
 
         Pl = xg.shape[0]
         nxc, ny, nz = self.nxc, self.ny, self.nz
@@ -326,7 +299,7 @@ class StructuredOps(Ops):
                 xg, (0, 0, a, 0, 0), (Pl, 3, chunk + 1, ny + 1, nz + 1))
             cks = jax.lax.dynamic_slice(
                 blk["ck"], (0, a, 0, 0), (Pl, chunk, ny, nz))
-            ys = self._conv_pair(blk, xs, cks)
+            ys = self._gse(blk, xs, cks)
             cur = jax.lax.dynamic_slice(y, (0, 0, a, 0, 0), ys.shape)
             return jax.lax.dynamic_update_slice(y, cur + ys, (0, 0, a, 0, 0))
 

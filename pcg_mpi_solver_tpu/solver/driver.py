@@ -11,6 +11,8 @@ come back to the host.
 from __future__ import annotations
 
 import dataclasses
+import os
+import sys
 import time
 from functools import partial
 from typing import Callable, List, Optional
@@ -25,6 +27,15 @@ from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
 from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
 from pcg_mpi_solver_tpu.parallel.partition import PartitionedModel, partition_model
 from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_mixed
+
+
+def _vlog(msg: str) -> None:
+    """Dispatch-level breadcrumbs (PCG_TPU_VERBOSE=1): on tunneled TPUs a
+    pathological remote compile or execution hangs with no host activity;
+    these timestamps localize which dispatch it was."""
+    if os.environ.get("PCG_TPU_VERBOSE") == "1":
+        print(f"[pcg-tpu {time.strftime('%H:%M:%S')}] {msg}",
+              file=sys.stderr, flush=True)
 
 
 @dataclasses.dataclass
@@ -361,12 +372,14 @@ class Solver:
         (slightly more iterations) and align with refinement cycles in
         mixed mode."""
         scfg = self.config.solver
+        _vlog("start_fn dispatch (lifting + r0; first call pays compile)")
         out = self._start_fn(self.data, self.un, jnp.asarray(delta, self.dtype))
         if self.mixed:
             udi, fext, carry, normr0, n2b = out
         else:
             udi, fext, carry, normr0, n2b, inv_diag = out
         n2b_f = float(n2b)
+        _vlog(f"start_fn done; ||b||={n2b_f:.3e}")
         if n2b_f == 0.0:
             self.un = self._finish_fn(jnp.zeros_like(carry["x"]), udi)
             return 0, 0.0, 0
@@ -384,22 +397,28 @@ class Solver:
                 prev = cur
                 # One refinement cycle: run the f32 inner solve to ITS
                 # convergence via resumable capped dispatches, then refine.
+                _vlog(f"inner_start dispatch (normr={float(normr):.3e})")
                 rhat32, inv32, tol_cycle, c32 = self._inner_start_fn(
                     self.data, r, normr, n2b)
                 inner_flag, xin = 1, None
                 while inner_flag == 1 and total < scfg.max_iter:
                     budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
+                    _vlog(f"inner_cycle dispatch (total={total})")
                     xin, c32, iflag = self._inner_cycle_fn(
                         self.data, rhat32, inv32, tol_cycle, c32, budget)
                     total += int(c32["exec"])
                     inner_flag = int(iflag)
+                    _vlog(f"inner_cycle done: +{int(c32['exec'])} iters "
+                          f"flag={inner_flag}")
                 if inner_flag != 0:
                     # Failed/exhausted inner solve: min-residual selection
                     # (the resumable path defers it; matches one-shot
                     # pcg_mixed's inner finalize_bad).
                     xin = self._final32_fn(self.data, rhat32, c32)
+                _vlog("refine dispatch (f64 true-residual matvec)")
                 x, r, normr = self._refine_fn(self.data, fext, x, xin, normr)
                 cur = float(normr)
+                _vlog(f"refine done: relres={cur / n2b_f:.3e} total={total}")
                 if cur <= tolb:
                     flag = 0
                 elif inner_flag == 2:
@@ -770,7 +789,7 @@ class Solver:
 
 
 _REPLICATED_KEYS = frozenset(
-    {"Ke", "diag_Ke", "Me", "Se", "Ke4", "diag_Ke4", "Wg", "Ws"})
+    {"Ke", "diag_Ke", "Me", "Se", "Ke4", "diag_Ke4"})
 
 
 def _data_specs(data):

@@ -42,9 +42,11 @@ def _model_hash(solver) -> str:
         # above) and mat_prop — hash them too.
         for t in sorted(m.elem_lib):
             h.update(np.ascontiguousarray(m.elem_lib[t]["Ke"]).tobytes())
-        h.update(repr(sorted(
-            (sorted((k, repr(v)) for k, v in mp.items())) for mp in m.mat_prop
-        )).encode())
+        # Material identity is POSITIONAL (poly_mat indexes mat_prop, e.g.
+        # nonlocal_stress.py groups by poly_mat==m) — keep list order and
+        # canonicalize key order recursively (incl. nested param dicts).
+        h.update(json.dumps(m.mat_prop, sort_keys=True,
+                            default=repr).encode())
     ep = getattr(solver.pm, "elem_part", None)
     if ep is not None:
         h.update(np.ascontiguousarray(ep).tobytes())

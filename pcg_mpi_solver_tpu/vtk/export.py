@@ -118,6 +118,21 @@ def _faces_of(model: ModelData, mode: str):
     return sel_flat, sel_offs, ctype
 
 
+# Per-worker shared context: the model/points/face arrays are shipped ONCE
+# per worker via the pool initializer (several hundred MB at bench scale —
+# re-pickling them per frame would swamp the pool with IPC).
+_FRAME_CTX = None
+
+
+def _init_frame_ctx(ctx):
+    global _FRAME_CTX
+    _FRAME_CTX = ctx
+
+
+def _write_frame_idx(i):
+    return _write_frame((i,) + _FRAME_CTX)
+
+
 def _write_frame(args):
     """One frame -> one .vtu (top-level function: picklable for the pool)."""
     (i, store, model, export_vars, dof_map, node_map,
@@ -151,7 +166,7 @@ def export_vtk(
 ) -> list:
     """Write one .vtu per exported frame; returns the file list.
 
-    ``n_workers > 1`` fans frames out over a fork-based process pool
+    ``n_workers > 1`` fans frames out over a spawn-based process pool
     (frames are independent; the reference uses ``i % N_Workers == Rank``
     round-robin over MPI ranks, export_vtk.py:231)."""
     os.makedirs(store.vtk_path, exist_ok=True)
@@ -170,18 +185,23 @@ def export_vtk(
               np.ascontiguousarray(model.node_coords[:, 1]),
               np.ascontiguousarray(model.node_coords[:, 2]))
 
-    jobs = [(i, store, model, tuple(export_vars), dof_map, node_map,
-             points, flat, offs, ctype) for i in frames]
-    if n_workers > 1 and len(jobs) > 1:
+    ctx = (store, model, tuple(export_vars), dof_map, node_map,
+           points, flat, offs, ctype)
+    frames = list(frames)
+    if n_workers > 1 and len(frames) > 1:
         import multiprocessing as mp
 
         # spawn, not fork: the parent typically holds a multithreaded JAX
         # runtime (fork would risk deadlock).  The worker import chain is
-        # numpy-only (no jax), so spawn startup is cheap.
-        with mp.get_context("spawn").Pool(min(n_workers, len(jobs))) as pool:
-            written = pool.map(_write_frame, jobs)
+        # numpy-only (no jax), so spawn startup is cheap.  The big shared
+        # arrays go through the initializer once per worker; per-frame IPC
+        # is just the frame index.
+        with mp.get_context("spawn").Pool(
+                min(n_workers, len(frames)),
+                initializer=_init_frame_ctx, initargs=(ctx,)) as pool:
+            written = pool.map(_write_frame_idx, frames)
     else:
-        written = [_write_frame(j) for j in jobs]
+        written = [_write_frame((i,) + ctx) for i in frames]
 
     # frame-time index (reference VTKInfo.txt, export_vtk.py:169-174)
     times = store.read_time_list()
