@@ -65,7 +65,8 @@ def main():
 
     cfg = RunConfig(
         solver=SolverConfig(tol=tol, max_iter=20000, dtype=dtype,
-                            dot_dtype="float64", precision_mode=mode),
+                            dot_dtype="float64", precision_mode=mode,
+                            pallas=os.environ.get("BENCH_PALLAS", "auto")),
         time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
     )
     t_part0 = time.perf_counter()

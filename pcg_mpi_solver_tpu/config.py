@@ -36,6 +36,9 @@ class SolverConfig:
     # single dispatches can trip execution watchdogs on remote/tunneled
     # devices; state stays on device between dispatches.
     iters_per_dispatch: int = -1
+    # Fused Pallas matvec kernel for f32 structured-backend matvecs
+    # (ops/pallas_matvec.py): "auto" = on TPU devices, "on", "off".
+    pallas: str = "auto"
 
 
 @dataclasses.dataclass

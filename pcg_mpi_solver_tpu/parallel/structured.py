@@ -185,15 +185,20 @@ class StructuredOps(Ops):
     n_parts: int = 1
     # cells above which f64 matvecs run x-slab-chunked (see _chunk_planes)
     chunk_threshold: int = 500_000
+    # f32 matvecs through the fused Pallas plane-march kernel
+    # (ops/pallas_matvec.py) instead of the XLA gather/einsum/scatter
+    use_pallas: bool = False
 
     @classmethod
     def from_partition(cls, sp: StructuredPartition, dot_dtype=jnp.float64,
-                       axis_name=None, precision=jax.lax.Precision.HIGHEST):
+                       axis_name=None, precision=jax.lax.Precision.HIGHEST,
+                       use_pallas=False):
         return cls(n_loc=sp.n_loc, n_iface=0,
                    n_node_loc=sp.n_node_loc, n_node_iface=0,
                    dot_dtype=dot_dtype,
                    axis_name=axis_name, precision=precision,
-                   nxc=sp.nxc, ny=sp.ny, nz=sp.nz, n_parts=sp.n_parts)
+                   nxc=sp.nxc, ny=sp.ny, nz=sp.nz, n_parts=sp.n_parts,
+                   use_pallas=use_pallas)
 
     # -- grid helpers ---------------------------------------------------
     def _grid(self, x):
@@ -213,13 +218,18 @@ class StructuredOps(Ops):
         return jnp.concatenate(slots, axis=1)  # dof order: 3*corner + comp
 
     def _scatter_cells(self, v):
-        """(Pl,24,cx,cy,cz) -> (Pl,3,cx+1,cy+1,cz+1) via 8 shifted adds."""
-        Pl, cx, cy, cz = v.shape[0], v.shape[2], v.shape[3], v.shape[4]
-        y = jnp.zeros((Pl, 3, cx + 1, cy + 1, cz + 1), v.dtype)
+        """(Pl,24,cx,cy,cz) -> (Pl,3,cx+1,cy+1,cz+1) via a sum of 8
+        zero-padded translates (one fused output pass; an .at[].add chain
+        would serialize 8 read-modify-write sweeps of the node grid)."""
+        terms = []
         for a in range(8):
             dx, dy, dz = _CORNERS[a]
-            y = y.at[:, :, dx:dx + cx, dy:dy + cy, dz:dz + cz].add(
-                v[:, 3 * a:3 * a + 3])
+            terms.append(jnp.pad(
+                v[:, 3 * a:3 * a + 3],
+                ((0, 0), (0, 0), (dx, 1 - dx), (dy, 1 - dy), (dz, 1 - dz))))
+        y = terms[0]
+        for t in terms[1:]:
+            y = y + t
         return y
 
     def _halo(self, yg):
@@ -284,6 +294,20 @@ class StructuredOps(Ops):
         blk = data["blocks"][0]
         xg = self._grid(x)                             # (P, 3, nxn, nny, nnz)
         chunk = self._chunk_planes(x.dtype)
+        if (self.use_pallas and chunk == 0
+                and np.dtype(x.dtype) == np.float32):
+            from pcg_mpi_solver_tpu.ops.pallas_matvec import (
+                structured_matvec_pallas)
+
+            # Per-part Python loop, not vmap: the sharded structured path
+            # always has exactly one local slab (driver requires
+            # n_parts == n_devices), and vmap would shift the kernel's
+            # pl.program_id axis.  Identical shapes share one jit cache
+            # entry in the unsharded multi-part (test) case.
+            y = jnp.stack([
+                structured_matvec_pallas(xg[p], blk["ck"][p], blk["Ke"])
+                for p in range(xg.shape[0])])
+            return y.reshape(x.shape)
         if chunk == 0:
             # slice-gather + einsum: contiguous slices, MXU matmul, shifted
             # slice-adds — no vector gather/scatter anywhere.
@@ -351,10 +375,12 @@ class StructuredOps(Ops):
         vg = vals.reshape(Pl, k, nxc, ny, nz)
         cg = jnp.ones((Pl, 1, nxc, ny, nz), vals.dtype)
         both = jnp.concatenate([vg, cg], axis=1)               # (P, k+1, cells)
-        y = jnp.zeros((Pl, k + 1, nxc + 1, ny + 1, nz + 1), vals.dtype)
+        y = None
         for a in range(8):
             dx, dy, dz = _CORNERS[a]
-            y = y.at[:, :, dx:dx + nxc, dy:dy + ny, dz:dz + nz].add(both)
+            t = jnp.pad(both, ((0, 0), (0, 0), (dx, 1 - dx),
+                               (dy, 1 - dy), (dz, 1 - dz)))
+            y = t if y is None else y + t
         y = self._halo(y)
         avg = y[:, :k] / (y[:, k:] + 1e-15)
         return avg.reshape(Pl, k, -1)

@@ -38,6 +38,22 @@ def _vlog(msg: str) -> None:
               file=sys.stderr, flush=True)
 
 
+def _pallas_enabled(mode: str, mesh) -> bool:
+    """Resolve the SolverConfig.pallas knob: "auto" enables the fused
+    Mosaic kernel only on TPU devices (CPU runs use the interpretable XLA
+    path; tests exercise the kernel via interpret=True)."""
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    if mode != "auto":
+        raise ValueError(f"SolverConfig.pallas must be 'auto'|'on'|'off', "
+                         f"got {mode!r}")
+    d = mesh.devices.flat[0]
+    kind = f"{d.platform} {getattr(d, 'device_kind', '')}".lower()
+    return "tpu" in kind
+
+
 @dataclasses.dataclass
 class StepResult:
     flag: int
@@ -105,12 +121,15 @@ class Solver:
             from pcg_mpi_solver_tpu.parallel.structured import (
                 StructuredOps, device_data_structured, partition_structured)
 
+            use_pallas = _pallas_enabled(solver_cfg.pallas, self.mesh)
             self.pm = partition_structured(model, n_parts)
             self.ops = StructuredOps.from_partition(
-                self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS)
+                self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS,
+                use_pallas=use_pallas)
             data = device_data_structured(self.pm, dtype)
             ops32_factory = lambda: StructuredOps.from_partition(
-                self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS)
+                self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS,
+                use_pallas=use_pallas)
         else:
             self.pm = partition_model(model, n_parts, elem_part=elem_part,
                                       method=self.config.partition_method)

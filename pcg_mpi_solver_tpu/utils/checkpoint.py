@@ -73,6 +73,9 @@ def _fingerprint(solver) -> dict:
                    [int(f) for f in th.export_frames], th.export_vars],
         "plot": [bool(th.plot_flag), [int(d) for d in th.probe_dofs]],
         "backend": solver.backend,
+        # Resolved kernel choice, not the "auto" knob: a different matvec
+        # summation order changes iteration counts, breaking exact resume.
+        "pallas": bool(getattr(solver.ops, "use_pallas", False)),
     }
 
 

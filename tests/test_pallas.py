@@ -1,0 +1,57 @@
+"""Pallas fused structured matvec vs the XLA gather/einsum/scatter path.
+
+Run in interpret mode (tests execute on the CPU backend, conftest.py); the
+same kernel lowers to Mosaic on real TPU."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.models import make_cube_model
+from pcg_mpi_solver_tpu.ops.pallas_matvec import structured_matvec_pallas
+from pcg_mpi_solver_tpu.parallel.structured import (
+    StructuredOps, device_data_structured, partition_structured)
+
+
+@pytest.mark.parametrize("dims", [(6, 5, 4), (4, 4, 4), (7, 3, 5)])
+def test_pallas_matvec_matches_xla(dims):
+    nx, ny, nz = dims
+    model = make_cube_model(nx, ny, nz, heterogeneous=True, seed=11)
+    sp = partition_structured(model, 1)
+    data = device_data_structured(sp, jnp.float32)
+    ops = StructuredOps.from_partition(sp, dot_dtype=jnp.float32)
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(1, sp.n_loc)), jnp.float32)
+    y_ref = np.asarray(ops.matvec_local(data, x))[0]
+
+    blk = data["blocks"][0]
+    xg = x.reshape(1, 3, nx + 1, ny + 1, nz + 1)[0]
+    y = structured_matvec_pallas(xg, blk["ck"][0], blk["Ke"],
+                                 interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y).reshape(-1), y_ref, rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_matvec_zero_ck_column_isolated():
+    """Cells with ck=0 must contribute nothing (the padded-cell trick the
+    sharded integration relies on)."""
+    model = make_cube_model(4, 3, 3, heterogeneous=True, seed=1)
+    sp = partition_structured(model, 1)
+    data = device_data_structured(sp, jnp.float32)
+    blk = data["blocks"][0]
+    ck0 = blk["ck"][0]
+    ck_masked = ck0.at[:, :, -1].set(0.0)
+
+    rng = np.random.default_rng(9)
+    xg = jnp.asarray(rng.normal(size=(3, 5, 4, 4)), jnp.float32)
+    y = structured_matvec_pallas(xg, ck_masked, blk["Ke"], interpret=True)
+    # nodes on the far-z face only touch the zeroed cells via dz=1 corners;
+    # recompute with the XLA path and compare
+    ops = StructuredOps.from_partition(sp, dot_dtype=jnp.float32)
+    data2 = {"blocks": [{**blk, "ck": ck_masked[None]}],
+             **{k: v for k, v in data.items() if k != "blocks"}}
+    y_ref = np.asarray(ops.matvec_local(
+        data2, xg.reshape(1, -1)))[0]
+    np.testing.assert_allclose(np.asarray(y).reshape(-1), y_ref,
+                               rtol=2e-5, atol=2e-5)
