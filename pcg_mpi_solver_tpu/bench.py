@@ -45,6 +45,9 @@ def main():
     from pcg_mpi_solver_tpu.solver import Solver
     from pcg_mpi_solver_tpu.solver.numpy_ref import NumpyRefSolver
 
+    # Dispatch breadcrumbs on by default: a wedged remote compile/execute
+    # must be localizable from the driver's captured stderr.
+    os.environ.setdefault("PCG_TPU_VERBOSE", "1")
     nx = int(os.environ.get("BENCH_NX", 150))
     ny = int(os.environ.get("BENCH_NY", 150))
     nz = int(os.environ.get("BENCH_NZ", 150))

@@ -110,6 +110,37 @@ def fetch_global(x, mesh: Optional[jax.sharding.Mesh] = None) -> np.ndarray:
     return np.asarray(rep)
 
 
+def fetch_addressable(x) -> tuple:
+    """Fetch only this process's addressable rows of a parts-sharded array.
+
+    Returns ``(rows, p0, p1)`` with ``rows == x[p0:p1]``.  The collective-free
+    counterpart of :func:`fetch_global` — the basis of parallel result
+    writes (each process persists its own contiguous part block, the
+    analogue of the reference's MPI-IO shared-file writes at computed
+    offsets, file_operations.py:348-396)."""
+    if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        a = np.asarray(x)
+        return a, 0, a.shape[0]
+    shards = sorted(x.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    rows = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    p0 = shards[0].index[0].start or 0
+    p1 = p0 + rows.shape[0]
+    # The part-range labeling is only valid if this process's shards tile
+    # [p0, p1) contiguously — true for make_global_mesh's device order,
+    # not necessarily for an arbitrary (e.g. torus-reordered) mesh.
+    ends = [s.index[0] for s in shards]
+    cov = sorted((sl.start or 0, sl.stop) for sl in ends)
+    pos = p0
+    for a, b in cov:
+        if a != pos:
+            raise ValueError(
+                f"addressable shards are not part-contiguous: {cov} "
+                "(use make_global_mesh, or export via fetch_global)")
+        pos = b
+    return rows, p0, p1
+
+
 def put_tree(tree, mesh: jax.sharding.Mesh, specs):
     """put_sharded over a pytree of arrays with a matching pytree of specs
     (None leaves pass through, as with device_put)."""

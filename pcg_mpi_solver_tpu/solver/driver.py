@@ -528,6 +528,13 @@ class Solver:
             # On resume the run dir (maps + already-exported frames) must
             # survive; prepare() would rotate it away.
             store.prepare()
+            if jax.process_count() > 1:
+                # prepare() rotates a pre-existing run dir on the primary;
+                # a non-primary shard write racing that rotation would be
+                # stranded in the rotated dir.  Barrier before any writes.
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("runstore_prepared")
             store.write_map("Dof", self.export_dof_map())
             if self._nodal_vars():
                 store.write_map("NodeId", self.export_node_map())
@@ -591,13 +598,30 @@ class Solver:
         t0 = time.perf_counter()
         k = self._export_count
         if "U" in self._export_vars():
-            store.write_frame("U", k, self.displacement_owned())
+            if jax.process_count() > 1:
+                # Parallel I/O: each process writes its own part block —
+                # no DCN all-gather, no single-writer bottleneck
+                # (reference writeMPIFile_parallel, pcg_solver.py:869).
+                vals, p0, p1 = self.displacement_owned_local()
+                store.write_frame_shard("U", k, vals, p0, p1,
+                                        self.pm.n_parts)
+            else:
+                store.write_frame("U", k, self.displacement_owned())
         nodal = [v for v in self._nodal_vars() if v != "NS"]
         if nodal:
             fields = self._nodal_fields()
             mask = self.node_owner_mask()
-            for var, arr in fields.items():
-                store.write_frame(var, k, np.asarray(arr)[mask])
+            if jax.process_count() > 1:
+                from pcg_mpi_solver_tpu.parallel.distributed import (
+                    fetch_addressable)
+
+                for var, arr in fields.items():
+                    rows, p0, p1 = fetch_addressable(arr)
+                    store.write_frame_shard(var, k, rows[mask[p0:p1]],
+                                            p0, p1, self.pm.n_parts)
+            else:
+                for var, arr in fields.items():
+                    store.write_frame(var, k, np.asarray(arr)[mask])
         if "NS" in self._export_vars():
             ns = self._nonlocal_field()
             store.write_frame("NS", k, ns[self.export_node_map()])
@@ -799,6 +823,16 @@ class Solver:
 
         un = fetch_global(self.un, self.mesh)
         return un[self.owner_mask()]
+
+    def displacement_owned_local(self):
+        """This process's slice of :meth:`displacement_owned` without any
+        collective: ``(values, p0, p1)`` where values covers parts
+        [p0, p1).  Concatenating the slices in part order over all
+        processes reproduces displacement_owned() exactly."""
+        from pcg_mpi_solver_tpu.parallel.distributed import fetch_addressable
+
+        rows, p0, p1 = fetch_addressable(self.un)
+        return rows[self.owner_mask()[p0:p1]], p0, p1
 
     def displacement_global(self) -> np.ndarray:
         """Full global solution vector (n_dof,), assembled on host."""

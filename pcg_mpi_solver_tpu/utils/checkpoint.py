@@ -73,9 +73,13 @@ def _fingerprint(solver) -> dict:
                    [int(f) for f in th.export_frames], th.export_vars],
         "plot": [bool(th.plot_flag), [int(d) for d in th.probe_dofs]],
         "backend": solver.backend,
-        # Resolved kernel choice, not the "auto" knob: a different matvec
-        # summation order changes iteration counts, breaking exact resume.
-        "pallas": bool(getattr(solver.ops, "use_pallas", False)),
+        # EFFECTIVE kernel choice, not the "auto" knob: the Pallas matvec
+        # has a different summation order (changes iteration counts,
+        # breaking exact resume), but it only ever executes on f32 matvecs
+        # — a pure-f64 direct run is byte-identical either way.
+        "pallas": bool(getattr(solver.ops, "use_pallas", False)
+                       and (solver.mixed
+                            or np.dtype(solver.dtype) == np.float32)),
     }
 
 
@@ -172,6 +176,9 @@ class CheckpointManager:
                 return None
         with np.load(self._ckpt_file(t)) as z:
             saved = json.loads(bytes(z["fingerprint"]).decode())
+            # Checkpoints written before the pallas field existed can only
+            # have come from the XLA matvec path.
+            saved.setdefault("pallas", False)
             want = _fingerprint(solver)
             if saved != want:
                 diffs = {k: (saved.get(k), want[k]) for k in want

@@ -99,13 +99,67 @@ class RunStore:
             return
         np.save(f"{self.res_vec_path}/{var}_{k}.npy", values)
 
+    def write_frame_shard(self, var: str, k: int, values: np.ndarray,
+                          p0: int, p1: int, n_parts: int) -> None:
+        """Parallel I/O: EVERY process writes the slice of the frame its
+        devices own, named by part range + total (the analogue of the
+        reference's MPI-IO writes at computed offsets + sidecar metadata,
+        file_operations.py:348-531).  ``read_frame`` reassembles in part
+        order.  Not primary-gated by design."""
+        os.makedirs(self.res_vec_path, exist_ok=True)
+        np.save(f"{self.res_vec_path}/{var}_{k}"
+                f".part{p0:05d}-{p1:05d}of{n_parts:05d}.npy", values)
+
     def read_frame(self, var: str, k: int) -> np.ndarray:
-        return np.load(f"{self.res_vec_path}/{var}_{k}.npy")
+        mono = f"{self.res_vec_path}/{var}_{k}.npy"
+        if os.path.exists(mono):
+            return np.load(mono)
+        import glob
+        import re
+
+        shards = glob.glob(f"{self.res_vec_path}/{var}_{k}.part*.npy")
+        if not shards:
+            raise FileNotFoundError(mono)
+        ranged, totals = [], set()
+        for s in shards:
+            m = re.search(r"\.part(\d+)-(\d+)of(\d+)\.npy$", s)
+            if m is None:
+                raise ValueError(f"unrecognized frame shard name: {s}")
+            ranged.append((int(m.group(1)), int(m.group(2)), s))
+            totals.add(int(m.group(3)))
+        ranged.sort()
+        # The ranges must tile [0, n_parts) exactly — stale shards from an
+        # earlier run with a different process layout, or a not-yet-flushed
+        # writer, must fail loudly rather than merge into a garbled frame.
+        names = [os.path.basename(r[2]) for r in ranged]
+        if len(totals) != 1:
+            raise ValueError(f"mixed-generation frame shards for {var}_{k}: "
+                             f"{names}")
+        pos = 0
+        for p0, p1, s in ranged:
+            if p0 != pos:
+                raise ValueError(
+                    f"frame shards for {var}_{k} do not tile contiguously "
+                    f"(at part {pos}): {names}")
+            pos = p1
+        if pos != totals.pop():
+            raise ValueError(
+                f"incomplete frame shards for {var}_{k} (cover {pos} parts): "
+                f"{names}")
+        return np.concatenate([np.load(s) for _, _, s in ranged])
 
     def n_frames(self, var: str) -> int:
         import glob
+        import re
 
-        return len(glob.glob(f"{self.res_vec_path}/{var}_*.npy"))
+        ks = set()
+        for f in glob.glob(f"{self.res_vec_path}/{var}_*.npy"):
+            m = re.match(
+                rf"{re.escape(var)}_(\d+)(\.part\d+-\d+of\d+)?\.npy$",
+                os.path.basename(f))
+            if m:
+                ks.add(int(m.group(1)))
+        return len(ks)
 
     def write_time_list(self, times) -> None:
         if not self.primary:

@@ -87,8 +87,12 @@ cfg = RunConfig(scratch_path=scratch, run_id="mh", checkpoint_every=1,
 s = Solver(model, cfg, mesh=make_global_mesh(), n_parts=8, backend="general")
 store = RunStore(cfg.result_path)
 res = s.solve(store=store)[-1]
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("exports_flushed")
 import glob as _glob
-n_frames = len(_glob.glob(os.path.join(cfg.result_path, "ResVecData", "U_*.npy")))
+n_frames = store.n_frames("U")
+n_shards = len(_glob.glob(os.path.join(cfg.result_path, "ResVecData",
+                                       "U_0.part*.npy")))
 n_ckpts = len(_glob.glob(os.path.join(cfg.checkpoint_path, "ckpt_*.npz")))
 print(f"RESULT {pid} flag={res.flag} iters={res.iters} relres={res.relres:.6e}",
       flush=True)
@@ -96,11 +100,14 @@ print(f"FILES {pid} primary={store.primary} frames={n_frames} ckpts={n_ckpts}",
       flush=True)
 assert res.flag == 0
 assert store.primary == (pid == 0)
+# Parallel I/O: each of the 2 processes wrote its own part-range shard
+assert n_shards == 2, n_shards
+assert n_frames == 3, n_frames       # steps 0, 1, 2 at frame_rate 1
+# reassembled frame == collective (all-gather) owner-masked payload
+import numpy as _np
+_np.testing.assert_array_equal(store.read_frame("U", 2),
+                               s.displacement_owned())
 if pid == 0:
-    # One consistent results dir, written only by the primary (the
-    # non-primary may still be counting while these writes land, so only
-    # the writer asserts counts).
-    assert n_frames == 3, n_frames   # steps 0, 1, 2 at frame_rate 1
     assert n_ckpts == 2, n_ckpts     # steps 1, 2
 """
 
