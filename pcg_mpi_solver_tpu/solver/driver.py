@@ -38,10 +38,15 @@ def _vlog(msg: str) -> None:
               file=sys.stderr, flush=True)
 
 
+_PALLAS_PROBE: dict = {}
+
+
 def _pallas_enabled(mode: str, mesh) -> bool:
     """Resolve the SolverConfig.pallas knob: "auto" enables the fused
     Mosaic kernel only on TPU devices (CPU runs use the interpretable XLA
-    path; tests exercise the kernel via interpret=True)."""
+    path; tests exercise the kernel via interpret=True) — and only after a
+    one-time tiny compile probe succeeds, so a toolchain that cannot lower
+    the kernel degrades to the XLA path instead of failing at first step."""
     if mode == "on":
         return True
     if mode == "off":
@@ -51,7 +56,36 @@ def _pallas_enabled(mode: str, mesh) -> bool:
                          f"got {mode!r}")
     d = mesh.devices.flat[0]
     kind = f"{d.platform} {getattr(d, 'device_kind', '')}".lower()
-    return "tpu" in kind
+    if "tpu" not in kind:
+        return False
+    key = d.platform
+    if key not in _PALLAS_PROBE:
+        try:
+            from pcg_mpi_solver_tpu.ops.pallas_matvec import (
+                structured_matvec_pallas)
+
+            xg = jnp.zeros((3, 3, 3, 3), jnp.float32)
+            ck = jnp.ones((2, 2, 2), jnp.float32)
+            ke = jnp.eye(24, dtype=jnp.float32)
+            jax.block_until_ready(structured_matvec_pallas(xg, ck, ke))
+            ok = True
+        except Exception as e:                      # noqa: BLE001
+            import warnings
+
+            warnings.warn(f"Pallas matvec unavailable on {kind} "
+                          f"({type(e).__name__}: {e}); using the XLA path")
+            ok = False
+        if jax.process_count() > 1:
+            # One SPMD program, one kernel: all processes must agree, else
+            # hosts would silently run different matvecs (and the resume
+            # fingerprint would only record the primary's choice).
+            from jax.experimental import multihost_utils
+
+            all_ok = multihost_utils.process_allgather(
+                np.asarray([ok], dtype=bool))
+            ok = bool(np.all(all_ok))
+        _PALLAS_PROBE[key] = ok
+    return _PALLAS_PROBE[key]
 
 
 @dataclasses.dataclass
