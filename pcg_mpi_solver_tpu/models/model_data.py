@@ -69,6 +69,18 @@ class ModelData:
     # (parallel/structured.py); None for general octree/unstructured models.
     grid: Optional[tuple] = None
 
+    # Octree lattice metadata (set by models/octree.py) — unlocks the
+    # hybrid level-grid fast path (parallel/hybrid.py): uniform 8-node
+    # "brick" cells of each refinement level run as dense structured
+    # stencils, only transition cells stay on the gather/scatter path.
+    #   {"leaves": (n_elem, 4) lattice origin+size in finest units,
+    #    "dims": (X, Y, Z) finest-lattice extents,
+    #    "node_keys": sorted unique lattice keys of the mesh nodes,
+    #    "strides": (stride_y, stride_z) of the key encoding,
+    #    "brick_type": type id of the pure 8-node pattern (or None),
+    #    "brick_corners": (8, 3) corner offsets in that type's node order}
+    octree: Optional[dict] = None
+
     # Cohesive interface elements (reference type -1/-2 scaffolding,
     # partition_mesh.py:603-650 — built there but never solved with; here the
     # capability is live).  Each entry is a zero-thickness 4+4-node quad:

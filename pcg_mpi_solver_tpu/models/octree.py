@@ -486,7 +486,31 @@ def make_octree_model(
         faces_flat=np.asarray(face_quads, dtype=np.int64).ravel(),
         faces_offset=np.arange(len(face_quads) + 1) * 4,
         grid=None,
+        octree=_octree_meta(leaves, (X, Y, Z), node_keys,
+                            (stride_y, stride_z), mask_to_type),
     )
+
+
+def _octree_meta(leaves, dims, node_keys, strides, mask_to_type):
+    """Lattice metadata consumed by the hybrid level-grid backend
+    (parallel/hybrid.py).  The "brick" pattern is mask 0 (no mid-edge/face
+    nodes); its canonical reflection is the identity (canonical_mask(0) ==
+    (0, (0,0,0))), so brick connectivity has zero signs and its node order
+    is _slot_layout(0)'s corner order recorded here."""
+    brick_type = mask_to_type.get(0)
+    brick_corners = None
+    if brick_type is not None:
+        lat, _ = _slot_layout(0)
+        brick_corners = np.array(
+            [[l % 3, (l // 3) % 3, l // 9] for l in lat], dtype=np.int64) // 2
+    return {
+        "leaves": leaves,
+        "dims": tuple(int(d) for d in dims),
+        "node_keys": node_keys,
+        "strides": tuple(int(s) for s in strides),
+        "brick_type": brick_type,
+        "brick_corners": brick_corners,
+    }
 
 
 # Face f of a cell (lattice point p with two coords == 1): the 4 corner

@@ -249,21 +249,24 @@ class Ops:
                 v = jnp.where(blk["sign"], -v, v)
                 per_block_v.append(v)
             y = self._scatter_blocks(data, per_block_v)
-        if "spr_a" in data:
-            # cohesive interface springs: f_a += k*(x_a - x_b), f_b -= same
-            # (a live capability where the reference has only scaffolding,
-            # partition_mesh.py:603-650); padded entries have k = 0 and
-            # out-of-bounds ids, so they gather 0 and drop on scatter.
-            xa = jnp.take_along_axis(x, data["spr_a"], axis=1,
-                                     mode="fill", fill_value=0)
-            xb = jnp.take_along_axis(x, data["spr_b"], axis=1,
-                                     mode="fill", fill_value=0)
-            f = data["spr_k"] * (xa - xb)
-            y = jax.vmap(
-                lambda yp, ia, ib, fp: yp.at[ia].add(fp, mode="drop")
-                                         .at[ib].add(-fp, mode="drop")
-            )(y, data["spr_a"], data["spr_b"], f)
-        return y
+        return self._apply_springs(data, x, y)
+
+    def _apply_springs(self, data: dict, x, y):
+        """Cohesive interface springs: f_a += k*(x_a - x_b), f_b -= same
+        (a live capability where the reference has only scaffolding,
+        partition_mesh.py:603-650); padded entries have k = 0 and
+        out-of-bounds ids, so they gather 0 and drop on scatter."""
+        if "spr_a" not in data:
+            return y
+        xa = jnp.take_along_axis(x, data["spr_a"], axis=1,
+                                 mode="fill", fill_value=0)
+        xb = jnp.take_along_axis(x, data["spr_b"], axis=1,
+                                 mode="fill", fill_value=0)
+        f = data["spr_k"] * (xa - xb)
+        return jax.vmap(
+            lambda yp, ia, ib, fp: yp.at[ia].add(fp, mode="drop")
+                                     .at[ib].add(-fp, mode="drop")
+        )(y, data["spr_a"], data["spr_b"], f)
 
     def diag_local(self, data: dict) -> jnp.ndarray:
         """Part-local diag(K) via the same scatter path
@@ -285,12 +288,15 @@ class Ops:
                 for blk in data["blocks"]
             ]
             y = self._scatter_blocks(data, per_block_v)
-        if "spr_a" in data:
-            y = jax.vmap(
-                lambda yp, ia, ib, kp: yp.at[ia].add(kp, mode="drop")
-                                         .at[ib].add(kp, mode="drop")
-            )(y, data["spr_a"], data["spr_b"], data["spr_k"])
-        return y
+        return self._apply_springs_diag(data, y)
+
+    def _apply_springs_diag(self, data: dict, y):
+        if "spr_a" not in data:
+            return y
+        return jax.vmap(
+            lambda yp, ia, ib, kp: yp.at[ia].add(kp, mode="drop")
+                                     .at[ib].add(kp, mode="drop")
+        )(y, data["spr_a"], data["spr_b"], data["spr_k"])
 
     def _scatter(self, data: dict, flat: jnp.ndarray) -> jnp.ndarray:
         """(P, NC) element-dof values -> (P, n_loc) via sorted segment_sum."""

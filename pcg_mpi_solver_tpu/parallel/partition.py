@@ -225,8 +225,15 @@ def partition_model(
     elem_part: Optional[np.ndarray] = None,
     pad_multiple: int = 8,
     method: str = "rcb",
+    block_filter: Optional[np.ndarray] = None,
 ) -> PartitionedModel:
-    """Partition ``model`` into ``n_parts`` padded shards."""
+    """Partition ``model`` into ``n_parts`` padded shards.
+
+    ``block_filter`` (bool, n_elem): elements with False still belong to
+    their part (their nodes/dofs are in the local sets, weights, and
+    interface maps) but are EXCLUDED from the type blocks and scatter maps
+    — the hybrid level-grid backend (parallel/hybrid.py) applies their
+    stiffness through dense per-level stencils instead."""
     if elem_part is None:
         elem_part = make_elem_part(model, n_parts, method=method)
 
@@ -365,6 +372,8 @@ def partition_model(
         per_part = []
         for p in range(P):
             e = part_elems[p][model.elem_type[part_elems[p]] == t]
+            if block_filter is not None:
+                e = e[block_filter[e]]
             per_part.append(e)
         N_t = int(max((len(e) for e in per_part), default=0))
         if N_t == 0:
@@ -413,7 +422,7 @@ def partition_model(
     NC = sum(tb.d * tb.dof.shape[2] for tb in type_blocks)
     scat_perm = np.zeros((P, NC), dtype=np.int32)
     scat_ids = np.zeros((P, NC), dtype=np.int32)
-    for p in range(P):
+    for p in range(P if type_blocks else 0):
         flat = np.concatenate([tb.dof[p].ravel() for tb in type_blocks])
         nat = native.sort_i32(flat.astype(np.int32))
         if nat is not None:

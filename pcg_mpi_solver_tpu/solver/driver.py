@@ -106,7 +106,7 @@ class Solver:
         mesh: Optional[jax.sharding.Mesh] = None,
         n_parts: Optional[int] = None,
         elem_part: Optional[np.ndarray] = None,
-        backend: str = "auto",   # "auto" | "structured" | "general"
+        backend: str = "auto",   # "auto" | "structured" | "hybrid" | "general"
     ):
         self._t_init0 = time.perf_counter()
         self.config = config or RunConfig()
@@ -145,11 +145,25 @@ class Solver:
             and elem_part is None
             and model.grid[0] % n_parts == 0
         )
+        if backend not in ("auto", "structured", "hybrid", "general"):
+            raise ValueError(f"backend must be 'auto'|'structured'|'hybrid'|"
+                             f"'general', got {backend!r}")
         if backend == "structured" and not can_structured:
             raise ValueError("structured backend requested but model/partition "
                              "layout does not allow it")
-        self.backend = "structured" if (backend in ("auto", "structured")
-                                        and can_structured) else "general"
+        can_hybrid = (
+            model.octree is not None
+            and model.octree.get("brick_type") is not None
+        )
+        if backend == "hybrid" and not can_hybrid:
+            raise ValueError("hybrid backend requested but model has no "
+                             "octree/brick metadata")
+        if backend in ("auto", "structured") and can_structured:
+            self.backend = "structured"
+        elif backend in ("auto", "hybrid") and can_hybrid:
+            self.backend = "hybrid"
+        else:
+            self.backend = "general"
 
         if self.backend == "structured":
             from pcg_mpi_solver_tpu.parallel.structured import (
@@ -164,6 +178,17 @@ class Solver:
             ops32_factory = lambda: StructuredOps.from_partition(
                 self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS,
                 use_pallas=use_pallas)
+        elif self.backend == "hybrid":
+            from pcg_mpi_solver_tpu.parallel.hybrid import (
+                HybridOps, device_data_hybrid, partition_hybrid)
+
+            self.pm = partition_hybrid(model, n_parts, elem_part=elem_part,
+                                       method=self.config.partition_method)
+            self.ops = HybridOps.from_hybrid(
+                self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS)
+            data = device_data_hybrid(self.pm, dtype)
+            ops32_factory = lambda: HybridOps.from_hybrid(
+                self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS)
         else:
             self.pm = partition_model(model, n_parts, elem_part=elem_part,
                                       method=self.config.partition_method)
@@ -876,7 +901,8 @@ class Solver:
 
 
 _REPLICATED_KEYS = frozenset(
-    {"Ke", "diag_Ke", "Me", "Se", "Ke4", "diag_Ke4"})
+    {"Ke", "diag_Ke", "Me", "Se", "Ke4", "diag_Ke4",
+     "brick_Ke", "brick_diag", "brick_Se"})
 
 
 def _data_specs(data):
