@@ -98,6 +98,29 @@ def _matvec_kernel(ke_ref, x_hbm, ck_hbm, y_ref,
             y_ref[c, 0] = carry[c]
 
 
+def batched_structured_matvec(xg, ck, Ke):
+    """Batched dispatch over the leading parts axis: one kernel launch per
+    local part.  The structured backend always has exactly one local slab
+    (n_parts == n_devices); the hybrid backend may carry several local
+    parts and a few levels — the launches are sequential but share one
+    compile cache entry, so the overhead is launch latency only (~us per
+    part per level, negligible against a PCG iteration)."""
+    return jnp.stack([structured_matvec_pallas(xg[p], ck[p], Ke)
+                      for p in range(xg.shape[0])])
+
+
+def probe_shapes(shapes, dtype=jnp.float32) -> None:
+    """AOT-compile the kernel for each (node-grid, cell-grid) shape pair;
+    raises if any fails.  Used by the driver's pallas='auto' resolution so
+    a shape-dependent Mosaic lowering failure degrades to the XLA path at
+    init instead of crashing the first jitted step."""
+    for xg_shape, ck_shape in shapes:
+        structured_matvec_pallas.lower(
+            jax.ShapeDtypeStruct(xg_shape, dtype),
+            jax.ShapeDtypeStruct(ck_shape, dtype),
+            jax.ShapeDtypeStruct((24, 24), dtype)).compile()
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def structured_matvec_pallas(xg, ck, Ke, *, interpret=False):
     """y = scatter(Ke @ (ck * gather(x))) on one structured slab.

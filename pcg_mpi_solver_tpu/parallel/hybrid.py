@@ -192,11 +192,15 @@ class HybridOps(Ops):
 
     # static (bx, by, bz) per level — shapes must be trace-constants
     level_dims: tuple = ()
+    # run the f32 level stencils through the fused Pallas plane-march
+    # kernel (ops/pallas_matvec.py) — same kernel as the structured backend
+    use_pallas: bool = False
 
     @classmethod
     def from_hybrid(cls, hp: HybridPartition, dot_dtype=jnp.float64,
                     axis_name=None,
-                    precision=jax.lax.Precision.HIGHEST):
+                    precision=jax.lax.Precision.HIGHEST,
+                    use_pallas=False):
         pm = hp.pm
         return cls(n_loc=pm.n_loc, n_iface=pm.n_iface,
                    n_node_loc=pm.n_node_loc, n_node_iface=pm.n_node_iface,
@@ -204,7 +208,8 @@ class HybridOps(Ops):
                    precision=precision,
                    use_node_ell=pm.ell is not None,
                    level_dims=tuple((lv.bx, lv.by, lv.bz)
-                                    for lv in hp.levels))
+                                    for lv in hp.levels),
+                   use_pallas=use_pallas)
 
     # -- level-grid primitives -----------------------------------------
     def _rows_pad(self, x):
@@ -237,7 +242,12 @@ class HybridOps(Ops):
     def _stencil(self, Ke, ck, xg):
         """Structured brick matvec on one level grid (same formulation as
         parallel/structured.py: slice gather -> einsum -> sum of padded
-        translates)."""
+        translates; fused Pallas kernel when enabled)."""
+        if self.use_pallas and np.dtype(xg.dtype) == np.float32:
+            from pcg_mpi_solver_tpu.ops.pallas_matvec import (
+                batched_structured_matvec)
+
+            return batched_structured_matvec(xg, ck, Ke)
         bx, by, bz = ck.shape[1], ck.shape[2], ck.shape[3]
         slots = [xg[:, :, dx:dx + bx, dy:dy + by, dz:dz + bz]
                  for dx, dy, dz in _CORNERS]

@@ -297,16 +297,9 @@ class StructuredOps(Ops):
         if (self.use_pallas and chunk == 0
                 and np.dtype(x.dtype) == np.float32):
             from pcg_mpi_solver_tpu.ops.pallas_matvec import (
-                structured_matvec_pallas)
+                batched_structured_matvec)
 
-            # Per-part Python loop, not vmap: the sharded structured path
-            # always has exactly one local slab (driver requires
-            # n_parts == n_devices), and vmap would shift the kernel's
-            # pl.program_id axis.  Identical shapes share one jit cache
-            # entry in the unsharded multi-part (test) case.
-            y = jnp.stack([
-                structured_matvec_pallas(xg[p], blk["ck"][p], blk["Ke"])
-                for p in range(xg.shape[0])])
+            y = batched_structured_matvec(xg, blk["ck"], blk["Ke"])
             return y.reshape(x.shape)
         if chunk == 0:
             # slice-gather + einsum: contiguous slices, MXU matmul, shifted

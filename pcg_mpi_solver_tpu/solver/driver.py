@@ -41,12 +41,13 @@ def _vlog(msg: str) -> None:
 _PALLAS_PROBE: dict = {}
 
 
-def _pallas_enabled(mode: str, mesh) -> bool:
+def _pallas_enabled(mode: str, mesh, shapes=()) -> bool:
     """Resolve the SolverConfig.pallas knob: "auto" enables the fused
     Mosaic kernel only on TPU devices (CPU runs use the interpretable XLA
     path; tests exercise the kernel via interpret=True) — and only after a
-    one-time tiny compile probe succeeds, so a toolchain that cannot lower
-    the kernel degrades to the XLA path instead of failing at first step."""
+    compile probe of the ACTUAL kernel shapes succeeds, so a
+    shape-dependent Mosaic lowering failure degrades to the XLA path at
+    init instead of crashing the first jitted step."""
     if mode == "on":
         return True
     if mode == "off":
@@ -58,16 +59,12 @@ def _pallas_enabled(mode: str, mesh) -> bool:
     kind = f"{d.platform} {getattr(d, 'device_kind', '')}".lower()
     if "tpu" not in kind:
         return False
-    key = d.platform
+    key = (d.platform, tuple(shapes))
     if key not in _PALLAS_PROBE:
         try:
-            from pcg_mpi_solver_tpu.ops.pallas_matvec import (
-                structured_matvec_pallas)
+            from pcg_mpi_solver_tpu.ops.pallas_matvec import probe_shapes
 
-            xg = jnp.zeros((3, 3, 3, 3), jnp.float32)
-            ck = jnp.ones((2, 2, 2), jnp.float32)
-            ke = jnp.eye(24, dtype=jnp.float32)
-            jax.block_until_ready(structured_matvec_pallas(xg, ck, ke))
+            probe_shapes(list(shapes) or [((3, 3, 3, 3), (2, 2, 2))])
             ok = True
         except Exception as e:                      # noqa: BLE001
             import warnings
@@ -169,8 +166,12 @@ class Solver:
             from pcg_mpi_solver_tpu.parallel.structured import (
                 StructuredOps, device_data_structured, partition_structured)
 
-            use_pallas = _pallas_enabled(solver_cfg.pallas, self.mesh)
             self.pm = partition_structured(model, n_parts)
+            sp = self.pm
+            use_pallas = _pallas_enabled(
+                solver_cfg.pallas, self.mesh,
+                shapes=(((3, sp.nxc + 1, sp.ny + 1, sp.nz + 1),
+                         (sp.nxc, sp.ny, sp.nz)),))
             self.ops = StructuredOps.from_partition(
                 self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS,
                 use_pallas=use_pallas)
@@ -184,11 +185,18 @@ class Solver:
 
             self.pm = partition_hybrid(model, n_parts, elem_part=elem_part,
                                        method=self.config.partition_method)
+            use_pallas = _pallas_enabled(
+                solver_cfg.pallas, self.mesh,
+                shapes=tuple(((3, lv.bx + 1, lv.by + 1, lv.bz + 1),
+                              (lv.bx, lv.by, lv.bz))
+                             for lv in self.pm.levels))
             self.ops = HybridOps.from_hybrid(
-                self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS)
+                self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS,
+                use_pallas=use_pallas)
             data = device_data_hybrid(self.pm, dtype)
             ops32_factory = lambda: HybridOps.from_hybrid(
-                self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS)
+                self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS,
+                use_pallas=use_pallas)
         else:
             self.pm = partition_model(model, n_parts, elem_part=elem_part,
                                       method=self.config.partition_method)

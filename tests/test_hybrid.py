@@ -115,6 +115,29 @@ def test_auto_backend_prefers_hybrid(model):
     assert s.backend == "hybrid"
 
 
+def test_level_stencil_matches_pallas_kernel(pair):
+    """The hybrid level stencil and the Pallas kernel share corner order:
+    identical results on a real level grid (interpret mode)."""
+    from pcg_mpi_solver_tpu.ops.pallas_matvec import structured_matvec_pallas
+
+    _, (ops_h, data_h), _, hp = pair
+    lv = data_h["levels"][-1]
+    dims = ops_h.level_dims[-1]
+    rng = np.random.default_rng(2)
+    P = lv["ck"].shape[0]
+    xg = jnp.asarray(rng.normal(
+        size=(P, 3, dims[0] + 1, dims[1] + 1, dims[2] + 1)), jnp.float32)
+    Ke32 = data_h["brick_Ke"].astype(jnp.float32)
+    ck32 = lv["ck"].astype(jnp.float32)
+    y_xla = np.asarray(ops_h._stencil(Ke32, ck32, xg))
+    y_pal = np.stack([
+        np.asarray(structured_matvec_pallas(xg[p], ck32[p], Ke32,
+                                            interpret=True))
+        for p in range(P)])
+    np.testing.assert_allclose(y_pal, y_xla, rtol=2e-5,
+                               atol=2e-5 * max(np.abs(y_xla).max(), 1))
+
+
 def test_mixed_precision_hybrid(model):
     cfg = RunConfig(
         solver=SolverConfig(tol=1e-8, max_iter=4000, precision_mode="mixed"),
