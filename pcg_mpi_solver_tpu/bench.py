@@ -48,6 +48,7 @@ def main():
     # Dispatch breadcrumbs on by default: a wedged remote compile/execute
     # must be localizable from the driver's captured stderr.
     os.environ.setdefault("PCG_TPU_VERBOSE", "1")
+    kind = os.environ.get("BENCH_MODEL", "cube")   # cube | octree
     nx = int(os.environ.get("BENCH_NX", 150))
     ny = int(os.environ.get("BENCH_NY", 150))
     nz = int(os.environ.get("BENCH_NZ", 150))
@@ -58,9 +59,22 @@ def main():
     n_dev = len(jax.devices())
     n_parts = int(os.environ.get("BENCH_PARTS", n_dev))
 
+    def gen_octree(n, level):
+        from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+        return make_octree_model(n, n, n, max_level=level, n_incl=6,
+                                 seed=2, E=30e9, nu=0.2,
+                                 load="traction", load_value=1e6)
+
     t_gen0 = time.perf_counter()
-    model = make_cube_model(nx, ny, nz, E=30e9, nu=0.2, load="traction",
-                            load_value=1e6, heterogeneous=True)
+    if kind == "octree":
+        # graded octree with real transition pattern types: the reference's
+        # problem class, solved on the hybrid level-grid backend
+        model = gen_octree(int(os.environ.get("BENCH_OT_N", 12)),
+                           int(os.environ.get("BENCH_OT_LEVEL", 4)))
+    else:
+        model = make_cube_model(nx, ny, nz, E=30e9, nu=0.2, load="traction",
+                                load_value=1e6, heterogeneous=True)
     print(f"# model: {model.n_elem} elems / {model.n_dof} dofs "
           f"(gen {time.perf_counter()-t_gen0:.1f}s); devices={n_dev} "
           f"parts={n_parts} dtype={dtype} mode={mode} backend={backend}",
@@ -113,6 +127,9 @@ def main():
     ref_max_dofs = int(os.environ.get("BENCH_REF_MAX_DOFS", 800_000))
     if model.n_dof <= ref_max_dofs:
         ref_model, ref_note = model, "same model"
+    elif kind == "octree":
+        ref_model = gen_octree(8, 3)
+        ref_note = f"scaled per-dof from a {ref_model.n_dof}-dof octree"
     else:
         rn = max(8, int(round((ref_max_dofs / 3.1) ** (1 / 3))) - 1)
         ref_model = make_cube_model(rn, rn, rn, E=30e9, nu=0.2,
@@ -139,6 +156,7 @@ def main():
         "vs_baseline": round(vs_baseline, 3),
         "detail": {
             "n_dof": model.n_dof,
+            "model": kind,
             "iters": int(iters),
             "flag": int(r1.flag),
             "relres": float(r1.relres),

@@ -241,11 +241,15 @@ class _Octree:
             s *= 2
         raise AssertionError(f"no leaf covers {(x, y, z)}")
 
-    def split(self, leaf) -> None:
+    def split(self, leaf, created=None) -> list:
         """Split a leaf into 8 children; ripple-refine coarser neighbors so
         the 26-neighbor 2:1 balance is preserved (any coarser leaf touching
         this one covers the entire adjacent region in its direction, so one
-        sample point per direction suffices)."""
+        sample point per direction suffices).  Returns every leaf created
+        (children + ripple children) so callers need not diff the leaf set
+        — diffing was O(n) per split, O(n^2) over a refinement sweep."""
+        if created is None:
+            created = []
         x, y, z, s = leaf
         assert s >= 2, "cannot split finest-level cell"
         self.leaves.remove(leaf)
@@ -253,14 +257,17 @@ class _Octree:
         for dz in (0, h):
             for dy in (0, h):
                 for dx in (0, h):
-                    self.leaves.add((x + dx, y + dy, z + dz, h))
+                    child = (x + dx, y + dy, z + dz, h)
+                    self.leaves.add(child)
+                    created.append(child)
         for d in _DIRS:
             qx = x - 1 if d[0] == 0 else (x + s if d[0] == 2 else x)
             qy = y - 1 if d[1] == 0 else (y + s if d[1] == 2 else y)
             qz = z - 1 if d[2] == 0 else (z + s if d[2] == 2 else z)
             nb = self.find(qx, qy, qz)
-            if nb is not None and nb[3] > s:
-                self.split(nb)
+            if nb is not None and nb[3] > s and nb in self.leaves:
+                self.split(nb, created)
+        return created
 
 
 def make_octree_model(
@@ -323,9 +330,7 @@ def make_octree_model(
         if leaf not in tree.leaves or leaf[3] < 2:
             continue
         if cut_by_surface(*leaf):
-            before = set(tree.leaves)
-            tree.split(leaf)
-            work.extend(tree.leaves - before)
+            work.extend(tree.split(leaf))
 
     leaves = np.array(sorted(tree.leaves), dtype=np.int64)   # (n_elem, 4)
     n_elem = len(leaves)
@@ -340,8 +345,6 @@ def make_octree_model(
     corners = (leaves[:, None, :3]
                + _CORNER_P[None, :, :] // 2 * leaves[:, None, 3:4])
     node_keys = np.unique(encode(corners).ravel())
-    key_to_id = {int(k): i for i, k in enumerate(node_keys)}
-    node_set = set(key_to_id)
     n_node = len(node_keys)
     n_dof = 3 * n_node
     coords = np.stack([node_keys % stride_y,
@@ -352,27 +355,30 @@ def make_octree_model(
     # a mid-edge/mid-face node exists iff a finer neighbor created it) ----
     masks = np.zeros(n_elem, dtype=np.int64)
     half = leaves[:, 3] // 2
-    for e in range(n_elem):
-        if leaves[e, 3] < 2:
-            continue
-        base, h2 = leaves[e, :3], half[e]
-        m = 0
-        for i, p in enumerate(_EDGE_P):
-            if int(encode(base + p * h2)) in node_set:
-                m |= 1 << i
-        for i, p in enumerate(_FACE_P):
-            if int(encode(base + p * h2)) in node_set:
-                m |= 1 << (N_EDGE + i)
-        masks[e] = m
+    big = leaves[:, 3] >= 2
+    if big.any():
+        EF_P = np.concatenate([_EDGE_P, _FACE_P])     # (18, 3)
+        pts = (leaves[big, None, :3]
+               + EF_P[None] * half[big, None, None])  # (nb, 18, 3)
+        keys = encode(pts)
+        pos = np.minimum(np.searchsorted(node_keys, keys), n_node - 1)
+        present = node_keys[pos] == keys
+        masks[big] = (present.astype(np.int64)
+                      << np.arange(18, dtype=np.int64)).sum(axis=1)
 
-    # ---- pattern library (canonical or raw) ---------------------------
+    # ---- pattern library (canonical or raw); per-unique-mask lookup ----
+    uniq_masks = np.unique(masks)
     if canonicalize:
-        canon = [canonical_mask(int(m)) for m in masks]
-        elem_mask = np.array([c[0] for c in canon], dtype=np.int64)
-        elem_refl = [c[1] for c in canon]
+        canon_u = {int(m): canonical_mask(int(m)) for m in uniq_masks}
     else:
-        elem_mask = masks
-        elem_refl = [(0, 0, 0)] * n_elem
+        canon_u = {int(m): (int(m), (0, 0, 0)) for m in uniq_masks}
+    upos = np.searchsorted(uniq_masks, masks)
+    elem_mask = np.asarray([canon_u[int(m)][0] for m in uniq_masks],
+                           dtype=np.int64)[upos]
+    refl_u = np.asarray([c[1] for c in
+                         (canon_u[int(m)] for m in uniq_masks)],
+                        dtype=np.int64)                # (nu, 3)
+    elem_refl = refl_u[upos]                           # (n_elem, 3)
 
     type_masks = sorted(set(int(m) for m in elem_mask))
     mask_to_type = {m: t for t, m in enumerate(type_masks)}
@@ -382,33 +388,53 @@ def make_octree_model(
 
     # ---- connectivity: canonical slot order mapped through the
     # reflection (reflections are involutions: physical lattice point of
-    # canonical slot l-hat is r(l-hat)) --------------------------------
-    conn_list, dof_list, sign_list = [], [], []
+    # canonical slot l-hat is r(l-hat)).  Vectorized per
+    # (mask, reflection, size-class) group — a few hundred groups at most,
+    # each a batched encode + searchsorted. ----------------------------
     lat_cache: Dict[int, np.ndarray] = {}
-    for e in range(n_elem):
-        m = int(elem_mask[e])
-        if m not in lat_cache:
-            lat, _ = _slot_layout(m)
-            pts = np.array([[l % 3, (l // 3) % 3, l // 9] for l in lat],
-                           dtype=np.int64)
-            lat_cache[m] = pts
-        pts = lat_cache[m]
-        r = elem_refl[e]
-        phys = _reflect_lattice(pts, r)
-        keys = encode(leaves[e, :3] + phys * half[e]) if leaves[e, 3] >= 2 \
-            else encode(leaves[e, :3] + phys // 2 * leaves[e, 3])
-        nodes = np.array([key_to_id[int(k)] for k in keys], dtype=np.int64)
-        conn_list.append(nodes)
-        dof_list.append((3 * nodes[:, None] + np.arange(3)[None, :]).ravel())
-        sgn = np.zeros((len(nodes), 3), dtype=bool)
-        for ax in range(3):
-            if r[ax]:
-                sgn[:, ax] = True
-        sign_list.append(sgn.ravel())
-
-    nn_per = np.array([len(c) for c in conn_list])
+    for m in set(int(v) for v in elem_mask):
+        lat, _ = _slot_layout(m)
+        lat_cache[m] = np.array([[l % 3, (l // 3) % 3, l // 9] for l in lat],
+                                dtype=np.int64)
+    nn_of_mask = {m: len(v) for m, v in lat_cache.items()}
+    nn_per = np.asarray([nn_of_mask[int(m)] for m in elem_mask])
     elem_nodes_offset = np.concatenate([[0], np.cumsum(nn_per)])
     elem_dofs_offset = 3 * elem_nodes_offset
+
+    conn_flat = np.zeros(int(nn_per.sum()), dtype=np.int64)
+    sign_nodes = np.zeros((int(nn_per.sum()), 3), dtype=bool)
+    refl_code = elem_refl @ np.array([1, 2, 4])
+    group_key = (elem_mask * 16 + refl_code * 2 + big.astype(np.int64))
+    g_order = np.argsort(group_key, kind="stable")
+    _, g_starts = np.unique(group_key[g_order], return_index=True)
+    for a, b in zip(g_starts, np.append(g_starts[1:], len(g_order))):
+        sel = g_order[a:b]
+        m = int(elem_mask[sel[0]])
+        r = tuple(int(v) for v in elem_refl[sel[0]])
+        pts = lat_cache[m]
+        phys = _reflect_lattice(pts, r)                # (nn, 3)
+        if big[sel[0]]:
+            lat_off = phys[None] * half[sel, None, None]
+        else:
+            lat_off = phys[None] // 2 * leaves[sel, None, 3:4]
+        keys = encode(leaves[sel, None, :3] + lat_off)  # (ng, nn)
+        nodes = np.searchsorted(node_keys, keys)
+        # fail fast if a slot's lattice point is not a mesh node (the old
+        # dict lookup raised KeyError; searchsorted would silently alias)
+        if not np.array_equal(node_keys[np.minimum(nodes, n_node - 1)], keys):
+            raise AssertionError(
+                f"pattern slot lattice point missing from the node set "
+                f"(mask {m}, reflection {r})")
+        flat_pos = (np.repeat(elem_nodes_offset[sel], len(pts))
+                    + np.tile(np.arange(len(pts)), len(sel)))
+        conn_flat[flat_pos] = nodes.reshape(-1)
+        for ax in range(3):
+            if r[ax]:
+                sign_nodes[flat_pos, ax] = True
+
+    dof_flat_all = (3 * conn_flat[:, None]
+                    + np.arange(3)[None, :]).reshape(-1)
+    sign_flat_all = sign_nodes.reshape(-1)
 
     # ---- materials ----------------------------------------------------
     sctrs = (leaves[:, :3] + leaves[:, 3:4] / 2.0) * hf
@@ -428,15 +454,23 @@ def make_octree_model(
     cm = rho * h_elem ** 3
     ce = 1.0 / h_elem
 
-    # ---- mass diagonal ------------------------------------------------
+    # ---- mass diagonal (vectorized per type) -------------------------
     diag_M = np.zeros(n_dof)
-    for e in range(n_elem):
-        me_rowsum = elem_lib[int(elem_type[e])]["Me"].sum(axis=1)
-        np.add.at(diag_M, dof_list[e], cm[e] * me_rowsum)
+    for t, lib in elem_lib.items():
+        sel = np.where(elem_type == t)[0]
+        if not len(sel):
+            continue
+        d = lib["Ke"].shape[0]
+        me_rowsum = lib["Me"].sum(axis=1)              # (d,)
+        dofs = dof_flat_all[
+            (elem_dofs_offset[sel, None]
+             + np.arange(d)[None, :])]                 # (nt, d)
+        np.add.at(diag_M, dofs.reshape(-1),
+                  (cm[sel, None] * me_rowsum[None]).reshape(-1))
 
     # ---- faces (ALL element faces; subdivided ones as 4 sub-quads so
     # interior incidence is exactly 2 — reference export_vtk.py:105-113) --
-    face_quads = _collect_faces(leaves, masks, key_to_id, encode)
+    face_quads = _collect_faces(leaves, masks, node_keys, encode)
 
     # ---- BCs ----------------------------------------------------------
     F = np.zeros(n_dof)
@@ -469,11 +503,11 @@ def make_octree_model(
         fixed_dof=fixed,
         dof_eff=dof_eff,
         elem_type=elem_type,
-        elem_nodes_flat=np.concatenate(conn_list),
+        elem_nodes_flat=conn_flat,
         elem_nodes_offset=elem_nodes_offset,
-        elem_dofs_flat=np.concatenate(dof_list),
+        elem_dofs_flat=dof_flat_all,
         elem_dofs_offset=elem_dofs_offset,
-        elem_sign_flat=np.concatenate(sign_list),
+        elem_sign_flat=sign_flat_all,
         ck=ck,
         cm=cm,
         ce=ce,
@@ -529,41 +563,59 @@ def _face_corner_lats(p: np.ndarray) -> np.ndarray:
 _FACE_CORNERS = [_face_corner_lats(p) for p in _FACE_P]
 
 
-def _collect_faces(leaves, masks, key_to_id, encode) -> np.ndarray:
-    quads = []
-    for e in range(len(leaves)):
-        base, s = leaves[e, :3], leaves[e, 3]
-        h2 = max(s // 2, 1)
-        for f, p in enumerate(_FACE_P):
-            corners = _FACE_CORNERS[f]
-            if s >= 2 and (masks[e] >> (N_EDGE + f)) & 1:
-                # subdivided: 4 sub-quads (corner, edge mid, center, edge mid)
-                c = p  # face center lattice point
-                for k in range(4):
-                    q0 = corners[k]
-                    q1 = (corners[k] + corners[(k + 1) % 4]) // 2
-                    q3 = (corners[k] + corners[(k - 1) % 4]) // 2
-                    lat = np.stack([q0, q1, c, q3])
-                    keys = encode(base + lat * h2)
-                    quads.append([key_to_id[int(x)] for x in keys])
-            else:
-                keys = encode(base + corners * h2) if s >= 2 else \
-                    encode(base + corners // 2 * s)
-                quads.append([key_to_id[int(x)] for x in keys])
-    return np.asarray(quads, dtype=np.int64)
+def _collect_faces(leaves, masks, node_keys, encode) -> np.ndarray:
+    """All element faces as node-id quads, vectorized per (face, case):
+    subdivided faces (mask bit set) as their 4 sub-quads."""
+    big = leaves[:, 3] >= 2
+    h2 = np.maximum(leaves[:, 3] // 2, 1)
+    quad_batches = []
+    order = []                      # (elem id, face id, sub id) for ordering
+
+    def lookup(keys):
+        ids = np.searchsorted(node_keys, keys)
+        if not np.array_equal(
+                node_keys[np.minimum(ids, len(node_keys) - 1)], keys):
+            raise AssertionError("face corner missing from the node set")
+        return ids
+
+    for f, p in enumerate(_FACE_P):
+        corners = _FACE_CORNERS[f]                      # (4, 3)
+        sub = big & ((masks >> (N_EDGE + f)) & 1).astype(bool)
+        # whole faces (coarse lattice for size-1 cells)
+        sel = np.where(~sub)[0]
+        if len(sel):
+            lat = np.where(big[sel, None, None], corners[None] * h2[sel, None, None],
+                           corners[None] // 2 * leaves[sel, None, 3:4])
+            quad_batches.append(lookup(encode(leaves[sel, None, :3] + lat)))
+            order.append(sel * 24 + f * 4)
+        # subdivided faces: 4 sub-quads each
+        sel = np.where(sub)[0]
+        for k in range(4):
+            if not len(sel):
+                continue
+            q0 = corners[k]
+            q1 = (corners[k] + corners[(k + 1) % 4]) // 2
+            q3 = (corners[k] + corners[(k - 1) % 4]) // 2
+            lat = np.stack([q0, q1, p, q3])             # (4, 3)
+            quad_batches.append(lookup(encode(
+                leaves[sel, None, :3] + lat[None] * h2[sel, None, None])))
+            order.append(sel * 24 + f * 4 + k)
+    quads = np.concatenate(quad_batches, axis=0)
+    # restore per-element, per-face order (stable downstream exports)
+    return quads[np.argsort(np.concatenate(order), kind="stable")]
 
 
 def _boundary_quads_at(face_quads, coords, axis: int, value: float):
     """Quads whose 4 nodes all lie on the plane coords[axis] == value, with
     their areas, deduplicated (interior faces appear twice)."""
-    seen = set()
-    for quad in face_quads:
-        if np.all(np.abs(coords[quad, axis] - value) < 1e-12):
-            key = tuple(sorted(int(n) for n in quad))
-            if key in seen:
-                continue
-            seen.add(key)
-            pts = coords[quad]
-            area = float(np.linalg.norm(
-                np.cross(pts[1] - pts[0], pts[3] - pts[0])))
-            yield np.asarray(quad), area
+    on = np.abs(coords[face_quads, axis] - value) < 1e-12
+    sel = face_quads[on.all(axis=1)]
+    if not len(sel):
+        return
+    _, first = np.unique(np.sort(sel, axis=1), axis=0, return_index=True)
+    sel = sel[np.sort(first)]
+    pts = coords[sel]                                   # (n, 4, 3)
+    areas = np.linalg.norm(
+        np.cross(pts[:, 1] - pts[:, 0], pts[:, 3] - pts[:, 0]), axis=1)
+    for quad, area in zip(sel, areas):
+        yield quad, float(area)
