@@ -1,0 +1,66 @@
+"""Matvec microbenchmark: XLA vs Pallas v1 (VPU) vs Pallas v2 (MXU).
+
+Times the structured-slab matvec formulations in isolation on the current
+default device.  Usage: python examples/bench_matvec.py [nx [ny [nz]]]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pcg_mpi_solver_tpu.models import make_cube_model
+from pcg_mpi_solver_tpu.ops.pallas_matvec import (
+    structured_matvec_pallas, structured_matvec_pallas_v2)
+from pcg_mpi_solver_tpu.parallel.structured import (
+    StructuredOps, device_data_structured, partition_structured)
+
+
+def timeit(fn, *args, n=20):
+    y = fn(*args)
+    jax.block_until_ready(y)
+    float(jnp.asarray(y).ravel()[0])     # tunneled-device sync
+    t0 = time.perf_counter()
+    for _ in range(n):
+        y = fn(*args)
+    float(jnp.asarray(y).ravel()[0])
+    return (time.perf_counter() - t0) / n, y
+
+
+def main():
+    nx = int(sys.argv[1]) if len(sys.argv) > 1 else 150
+    ny = int(sys.argv[2]) if len(sys.argv) > 2 else nx
+    nz = int(sys.argv[3]) if len(sys.argv) > 3 else nx
+    model = make_cube_model(nx, ny, nz, heterogeneous=True)
+    sp = partition_structured(model, 1)
+    data = device_data_structured(sp, jnp.float32)
+    ops = StructuredOps.from_partition(sp, dot_dtype=jnp.float32)
+    blk = data["blocks"][0]
+    print(f"{model.n_dof} dofs on {jax.devices()[0]}", flush=True)
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        jnp.asarray(rng.normal(size=(1, sp.n_loc)), jnp.float32))
+    xg = x.reshape(1, 3, nx + 1, ny + 1, nz + 1)[0]
+
+    xla = jax.jit(lambda d, xx: ops.matvec_local(d, xx))
+    t_xla, y0 = timeit(xla, data, x)
+    print(f"xla:       {t_xla*1e3:8.3f} ms/matvec", flush=True)
+
+    for name, fn in (("pallas v1", structured_matvec_pallas),
+                     ("pallas v2", structured_matvec_pallas_v2)):
+        try:
+            t, y = timeit(fn, xg, blk["ck"][0], blk["Ke"])
+            err = float(jnp.abs(y.reshape(-1) - y0[0]).max()
+                        / jnp.abs(y0).max())
+            print(f"{name}: {t*1e3:8.3f} ms/matvec  "
+                  f"(vs xla {t_xla/t:5.2f}x, maxrelerr {err:.2e})",
+                  flush=True)
+        except Exception as e:                      # noqa: BLE001
+            print(f"{name}: FAILED {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -104,18 +104,35 @@ def batched_structured_matvec(xg, ck, Ke):
     (n_parts == n_devices); the hybrid backend may carry several local
     parts and a few levels — the launches are sequential but share one
     compile cache entry, so the overhead is launch latency only (~us per
-    part per level, negligible against a PCG iteration)."""
-    return jnp.stack([structured_matvec_pallas(xg[p], ck[p], Ke)
-                      for p in range(xg.shape[0])])
+    part per level, negligible against a PCG iteration).
+
+    PCG_TPU_PALLAS_V=1 selects the VPU-FMA variant; default is the
+    flat-lane MXU variant (v2)."""
+    fn = selected_variant()[1]
+    return jnp.stack([fn(xg[p], ck[p], Ke) for p in range(xg.shape[0])])
+
+
+def selected_variant():
+    """(name, fn) of the kernel variant the PCG_TPU_PALLAS_V env knob
+    selects — the single source of truth for dispatch AND probing.  Read
+    at trace time: toggling the knob after a solver compiled does not
+    retrace (build a new Solver to switch)."""
+    import os
+
+    if os.environ.get("PCG_TPU_PALLAS_V") == "1":
+        return "v1", structured_matvec_pallas
+    return "v2", structured_matvec_pallas_v2
 
 
 def probe_shapes(shapes, dtype=jnp.float32) -> None:
     """AOT-compile the kernel for each (node-grid, cell-grid) shape pair;
     raises if any fails.  Used by the driver's pallas='auto' resolution so
     a shape-dependent Mosaic lowering failure degrades to the XLA path at
-    init instead of crashing the first jitted step."""
+    init instead of crashing the first jitted step.  Probes the SAME
+    variant batched_structured_matvec dispatches to."""
+    fn = selected_variant()[1]
     for xg_shape, ck_shape in shapes:
-        structured_matvec_pallas.lower(
+        fn.lower(
             jax.ShapeDtypeStruct(xg_shape, dtype),
             jax.ShapeDtypeStruct(ck_shape, dtype),
             jax.ShapeDtypeStruct((24, 24), dtype)).compile()
@@ -152,3 +169,112 @@ def structured_matvec_pallas(xg, ck, Ke, *, interpret=False):
         ],
         interpret=interpret,
     )(Ke, xg, ck)
+
+
+# ----------------------------------------------------------------------
+# v2: flat-lane plane march with a REAL MXU matmul per plane.
+#
+# v1 computes Ke @ (ck*u) as 576 unrolled VPU plane-FMAs — memory-optimal
+# but VPU-compute-bound.  v2 flattens each (ny+1, nz+1) plane into one lane
+# axis: with the cell grid padded to NODE-plane strides and ck = 0 in the
+# padding (a zero-stiffness cell contributes nothing), every corner gather
+# is a contiguous lane slice at a static offset {0, 1, nz+1, nz+2}, the
+# element product is one (24,24) @ (24, M) dot_general on the MXU per
+# plane, and the scatter is eight shifted lane-slice adds.  Same HBM
+# traffic as v1, MXU instead of VPU for the FLOPs.
+# ----------------------------------------------------------------------
+
+
+def _matvec_kernel_v2(ke_ref, x_hbm, ck_hbm, y_ref,
+                      xv, ckv, carry, dma_sem, ck_sem, *, nx, m, sy):
+    """One grid step = one finished output node plane (flat lanes).
+
+    ke_ref: (24, 24) VMEM
+    x_hbm:  (3, nx+1, m) ANY/HBM — node planes, flat (ny+1)*(nz+1) lanes
+    ck_hbm: (nx, m) ANY/HBM — cell planes PADDED to node strides, ck=0 pad
+    y_ref:  (3, 1, m) VMEM output block (plane i)
+    xv:     (3, 2, m + sy + 2) VMEM (planes i, i+1; zero tail for the
+            padded-cell gather overhang)
+    ckv:    (1, m) VMEM
+    carry:  (3, m + sy + 2) VMEM — upper-corner partials for plane i+1
+    """
+    i = pl.program_id(0)
+    mp = m + sy + 2
+
+    @pl.when(i == 0)
+    def _init():
+        carry[...] = jnp.zeros_like(carry)
+        xv[...] = jnp.zeros_like(xv)       # zero gather-overhang tails
+
+    @pl.when(i < nx)
+    def _work():
+        cp_x = pltpu.make_async_copy(
+            x_hbm.at[:, pl.ds(i, 2)], xv.at[:, :, :m], dma_sem)
+        cp_ck = pltpu.make_async_copy(
+            ck_hbm.at[pl.ds(i, 1)], ckv, ck_sem)
+        cp_x.start()
+        cp_ck.start()
+        cp_x.wait()
+        cp_ck.wait()
+
+        ck = ckv[0]                                     # (m,)
+        rows = []
+        for a, (dx, dy, dz) in enumerate(_CORNERS):
+            off = dy * sy + dz
+            for c in range(3):
+                rows.append(ck * xv[c, dx, off:off + m])
+        u = jnp.stack(rows)                             # (24, m)
+        v = jax.lax.dot_general(
+            ke_ref[...], u, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (24, m) on the MXU
+        lo = jnp.zeros((3, mp), u.dtype)
+        hi = jnp.zeros((3, mp), u.dtype)
+        for a, (dx, dy, dz) in enumerate(_CORNERS):
+            off = dy * sy + dz
+            for c in range(3):
+                if dx == 0:
+                    lo = lo.at[c, off:off + m].add(v[3 * a + c])
+                else:
+                    hi = hi.at[c, off:off + m].add(v[3 * a + c])
+        for c in range(3):
+            y_ref[c, 0] = (carry[c] + lo[c])[:m]
+            carry[c] = hi[c]
+
+    @pl.when(i == nx)
+    def _last():
+        for c in range(3):
+            y_ref[c, 0] = carry[c][:m]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def structured_matvec_pallas_v2(xg, ck, Ke, *, interpret=False):
+    """Flat-lane MXU variant of :func:`structured_matvec_pallas`.
+
+    Same signature/semantics: xg (3, nx+1, ny+1, nz+1), ck (nx, ny, nz),
+    Ke (24, 24), all f32."""
+    _, nxn, nyn, nzn = xg.shape
+    nx, ny, nz = nxn - 1, nyn - 1, nzn - 1
+    m = nyn * nzn
+    x_flat = xg.reshape(3, nxn, m)
+    ck_pad = jnp.pad(ck, ((0, 0), (0, 1), (0, 1))).reshape(nx, m)
+    kernel = functools.partial(_matvec_kernel_v2, nx=nx, m=m, sy=nzn)
+    y = pl.pallas_call(
+        kernel,
+        grid=(nx + 1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # Ke
+            pl.BlockSpec(memory_space=pl.ANY),         # x (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),         # ck (manual DMA)
+        ],
+        out_specs=pl.BlockSpec((3, 1, m), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, nxn, m), xg.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((3, 2, m + nzn + 2), xg.dtype),
+            pltpu.VMEM((1, m), ck.dtype),
+            pltpu.VMEM((3, m + nzn + 2), xg.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(Ke, x_flat, ck_pad)
+    return y.reshape(3, nxn, nyn, nzn)

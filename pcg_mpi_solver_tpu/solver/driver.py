@@ -59,11 +59,12 @@ def _pallas_enabled(mode: str, mesh, shapes=()) -> bool:
     kind = f"{d.platform} {getattr(d, 'device_kind', '')}".lower()
     if "tpu" not in kind:
         return False
-    key = (d.platform, tuple(shapes))
+    from pcg_mpi_solver_tpu.ops.pallas_matvec import (
+        probe_shapes, selected_variant)
+
+    key = (d.platform, selected_variant()[0], tuple(shapes))
     if key not in _PALLAS_PROBE:
         try:
-            from pcg_mpi_solver_tpu.ops.pallas_matvec import probe_shapes
-
             probe_shapes(list(shapes) or [((3, 3, 3, 3), (2, 2, 2))])
             ok = True
         except Exception as e:                      # noqa: BLE001

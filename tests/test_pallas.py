@@ -33,9 +33,37 @@ def test_pallas_matvec_matches_xla(dims):
         np.asarray(y).reshape(-1), y_ref, rtol=2e-5, atol=2e-5)
 
 
-def test_pallas_matvec_zero_ck_column_isolated():
+@pytest.mark.parametrize("dims", [(6, 5, 4), (4, 4, 4), (7, 3, 5)])
+def test_pallas_matvec_v2_matches_xla(dims):
+    from pcg_mpi_solver_tpu.ops.pallas_matvec import structured_matvec_pallas_v2
+
+    nx, ny, nz = dims
+    model = make_cube_model(nx, ny, nz, heterogeneous=True, seed=11)
+    sp = partition_structured(model, 1)
+    data = device_data_structured(sp, jnp.float32)
+    ops = StructuredOps.from_partition(sp, dot_dtype=jnp.float32)
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(1, sp.n_loc)), jnp.float32)
+    y_ref = np.asarray(ops.matvec_local(data, x))[0]
+
+    blk = data["blocks"][0]
+    xg = x.reshape(1, 3, nx + 1, ny + 1, nz + 1)[0]
+    y = structured_matvec_pallas_v2(xg, blk["ck"][0], blk["Ke"],
+                                    interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y).reshape(-1), y_ref, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("kernel_fn", ["v1", "v2"])
+def test_pallas_matvec_zero_ck_column_isolated(kernel_fn):
     """Cells with ck=0 must contribute nothing (the padded-cell trick the
-    sharded integration relies on)."""
+    sharded integration — and v2's own gather padding — relies on)."""
+    from pcg_mpi_solver_tpu.ops.pallas_matvec import (
+        structured_matvec_pallas_v2)
+
+    fn = (structured_matvec_pallas if kernel_fn == "v1"
+          else structured_matvec_pallas_v2)
     model = make_cube_model(4, 3, 3, heterogeneous=True, seed=1)
     sp = partition_structured(model, 1)
     data = device_data_structured(sp, jnp.float32)
@@ -45,7 +73,7 @@ def test_pallas_matvec_zero_ck_column_isolated():
 
     rng = np.random.default_rng(9)
     xg = jnp.asarray(rng.normal(size=(3, 5, 4, 4)), jnp.float32)
-    y = structured_matvec_pallas(xg, ck_masked, blk["Ke"], interpret=True)
+    y = fn(xg, ck_masked, blk["Ke"], interpret=True)
     # nodes on the far-z face only touch the zeroed cells via dz=1 corners;
     # recompute with the XLA path and compare
     ops = StructuredOps.from_partition(sp, dot_dtype=jnp.float32)
