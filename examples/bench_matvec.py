@@ -4,6 +4,7 @@ Times the structured-slab matvec formulations in isolation on the current
 default device.  Usage: python examples/bench_matvec.py [nx [ny [nz]]]
 """
 
+import functools
 import sys
 import time
 
@@ -13,7 +14,8 @@ import numpy as np
 
 from pcg_mpi_solver_tpu.models import make_cube_model
 from pcg_mpi_solver_tpu.ops.pallas_matvec import (
-    structured_matvec_pallas, structured_matvec_pallas_v2)
+    structured_matvec_pallas, structured_matvec_pallas_v2,
+    structured_matvec_pallas_v3)
 from pcg_mpi_solver_tpu.parallel.structured import (
     StructuredOps, device_data_structured, partition_structured)
 
@@ -49,8 +51,12 @@ def main():
     t_xla, y0 = timeit(xla, data, x)
     print(f"xla:       {t_xla*1e3:8.3f} ms/matvec", flush=True)
 
-    for name, fn in (("pallas v1", structured_matvec_pallas),
-                     ("pallas v2", structured_matvec_pallas_v2)):
+    variants = [("pallas v1", structured_matvec_pallas),
+                ("pallas v2", structured_matvec_pallas_v2)]
+    for c in (2, 4, 8):
+        variants.append((f"pallas v3 C={c}", functools.partial(
+            structured_matvec_pallas_v3, planes=c)))
+    for name, fn in variants:
         try:
             t, y = timeit(fn, xg, blk["ck"][0], blk["Ke"])
             err = float(jnp.abs(y.reshape(-1) - y0[0]).max()

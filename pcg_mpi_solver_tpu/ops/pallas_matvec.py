@@ -106,8 +106,8 @@ def batched_structured_matvec(xg, ck, Ke):
     compile cache entry, so the overhead is launch latency only (~us per
     part per level, negligible against a PCG iteration).
 
-    PCG_TPU_PALLAS_V=1 selects the VPU-FMA variant; default is the
-    flat-lane MXU variant (v2)."""
+    PCG_TPU_PALLAS_V selects the variant (1 = per-plane VPU-FMA, 2 =
+    per-plane MXU, default 3 = chunked double-buffered MXU)."""
     fn = selected_variant()[1]
     return jnp.stack([fn(xg[p], ck[p], Ke) for p in range(xg.shape[0])])
 
@@ -119,9 +119,12 @@ def selected_variant():
     retrace (build a new Solver to switch)."""
     import os
 
-    if os.environ.get("PCG_TPU_PALLAS_V") == "1":
+    v = os.environ.get("PCG_TPU_PALLAS_V", "3")
+    if v == "1":
         return "v1", structured_matvec_pallas
-    return "v2", structured_matvec_pallas_v2
+    if v == "2":
+        return "v2", structured_matvec_pallas_v2
+    return "v3", structured_matvec_pallas_v3
 
 
 def probe_shapes(shapes, dtype=jnp.float32) -> None:
@@ -278,3 +281,127 @@ def structured_matvec_pallas_v2(xg, ck, Ke, *, interpret=False):
         interpret=interpret,
     )(Ke, x_flat, ck_pad)
     return y.reshape(3, nxn, nyn, nzn)
+
+
+# ----------------------------------------------------------------------
+# v3: C-plane chunks + double-buffered DMA.
+#
+# v2 marches one plane per grid step: ~microseconds of work per step, so
+# fixed per-step costs (DMA issue/wait latency, loop overhead) dominate.
+# v3 processes C cell planes per step.  The flat-lane trick extends to the
+# x axis: within a chunk buffer of C+1 node planes, corner (dx,dy,dz) is
+# the contiguous lane offset dx*M + dy*(nz+1) + dz, so the whole chunk is
+# gathered by 24 slices and multiplied by 8 (24,3)@(3,C*M) MXU dots
+# accumulated in VMEM (no (24, C*M) u buffer).  DMA for chunk j+1 is
+# issued before chunk j's compute (double buffering).
+# ----------------------------------------------------------------------
+
+
+def _matvec_kernel_v3(ke_ref, x_hbm, ck_hbm, y_ref,
+                      xv, ckv, acc, sems, ck_sems, *, g, cpp, m, sy):
+    """One grid step = C finished output node planes (flat lanes).
+
+    ke_ref: (24, 24) VMEM
+    x_hbm:  (3, g*cpp + 1, m) ANY/HBM (zero-padded past plane nx)
+    ck_hbm: (g*cpp, m) ANY/HBM (zero-padded)
+    y_ref:  (3, cpp, m) VMEM output block (planes j*cpp ..< (j+1)*cpp)
+    xv:     (2, 3, (cpp+1)*m + sy + 2) VMEM — double-buffered chunk +
+            one overlap plane + gather-overhang tail (zeroed once)
+    ckv:    (2, cpp, m) VMEM
+    acc:    (3, (cpp+1)*m + sy + 2) VMEM — chunk output accumulator;
+            its tail plane [cpp*m:] is the carry into the next chunk
+    """
+    j = pl.program_id(0)
+    cm = cpp * m
+
+    def chunk_copies(slot, chunk):
+        """Copy descriptors for one chunk: cpp+1 node planes into flat
+        lane offsets of the slot buffer + the ck plane block.  Recreated
+        identically at wait time (standard double-buffering pattern)."""
+        cps = [pltpu.make_async_copy(
+                   x_hbm.at[:, chunk * cpp + k],
+                   xv.at[slot, :, pl.ds(k * m, m)], sems.at[slot])
+               for k in range(cpp + 1)]
+        cps.append(pltpu.make_async_copy(
+            ck_hbm.at[pl.ds(chunk * cpp, cpp)],
+            ckv.at[slot], ck_sems.at[slot]))
+        return cps
+
+    @pl.when(j == 0)
+    def _init():
+        xv[...] = jnp.zeros_like(xv)       # zero overhang tails once
+        acc[...] = jnp.zeros_like(acc)
+        for cp in chunk_copies(0, 0):
+            cp.start()
+
+    # wait for this chunk's data; prefetch the next chunk
+    slot = jax.lax.rem(j, jnp.asarray(2, j.dtype))
+    for cp in chunk_copies(slot, j):
+        cp.wait()
+
+    @pl.when(j + 1 < g)
+    def _prefetch():
+        for cp in chunk_copies(1 - slot, j + 1):
+            cp.start()
+
+    ck = ckv[slot].reshape(1, cm)                       # (1, cm)
+    # v = sum_a Ke[:, 3a:3a+3] @ (ck * x_slice_a)  — 8 MXU dots, no
+    # (24, cm) gather buffer
+    v = None
+    for a, (dx, dy, dz) in enumerate(_CORNERS):
+        off = dx * m + dy * sy + dz
+        t = ck * jax.lax.dynamic_slice(
+            xv[slot], (0, off), (3, cm))                # (3, cm)
+        pa = jax.lax.dot_general(
+            ke_ref[:, 3 * a:3 * a + 3], t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        v = pa if v is None else v + pa                 # (24, cm)
+    # scatter: out[q + off_e] += v_e[q]; the dx offset folds the carry to
+    # the next output plane into the accumulator's overlap plane
+    out = acc[...]
+    for a, (dx, dy, dz) in enumerate(_CORNERS):
+        off = dx * m + dy * sy + dz
+        for c in range(3):
+            out = out.at[c, off:off + cm].add(v[3 * a + c])
+    y_ref[...] = out[:, :cm].reshape(3, cpp, m)
+    # roll: overlap plane (+ tail zeros) becomes the next chunk's head
+    nxt = jnp.zeros_like(out)
+    acc[...] = nxt.at[:, :m + sy + 2].set(
+        jax.lax.dynamic_slice(out, (0, cm), (3, m + sy + 2)))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "planes"))
+def structured_matvec_pallas_v3(xg, ck, Ke, *, interpret=False, planes=4):
+    """Chunked double-buffered variant of :func:`structured_matvec_pallas_v2`.
+
+    Same signature/semantics; ``planes`` = cell planes per grid step."""
+    _, nxn, nyn, nzn = xg.shape
+    nx, ny, nz = nxn - 1, nyn - 1, nzn - 1
+    m = nyn * nzn
+    cpp = max(1, min(planes, nx + 1))
+    g = -(-(nx + 1) // cpp)                 # ceil: covers all output planes
+    x_flat = jnp.pad(xg.reshape(3, nxn, m),
+                     ((0, 0), (0, g * cpp + 1 - nxn), (0, 0)))
+    ck_pad = jnp.pad(ck, ((0, 0), (0, 1), (0, 1))).reshape(nx, m)
+    ck_pad = jnp.pad(ck_pad, ((0, g * cpp - nx), (0, 0)))
+    kernel = functools.partial(_matvec_kernel_v3, g=g, cpp=cpp, m=m, sy=nzn)
+    y = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # Ke
+            pl.BlockSpec(memory_space=pl.ANY),         # x (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),         # ck (manual DMA)
+        ],
+        out_specs=pl.BlockSpec((3, cpp, m), lambda j: (0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, g * cpp, m), xg.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, 3, (cpp + 1) * m + nzn + 2), xg.dtype),
+            pltpu.VMEM((2, cpp, m), ck.dtype),
+            pltpu.VMEM((3, (cpp + 1) * m + nzn + 2), xg.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(Ke, x_flat, ck_pad)
+    return y[:, :nxn].reshape(3, nxn, nyn, nzn)
