@@ -1,4 +1,5 @@
-"""Matvec microbenchmark: XLA vs Pallas v1 (VPU) vs Pallas v2 (MXU).
+"""Matvec microbenchmark: XLA vs Pallas v1 (per-plane VPU), v2 (per-plane
+MXU), and v3 (chunked double-buffered MXU, swept over chunk sizes).
 
 Times the structured-slab matvec formulations in isolation on the current
 default device.  Usage: python examples/bench_matvec.py [nx [ny [nz]]]

@@ -95,19 +95,29 @@ def main():
     # Warm-up: compile + first solve.  If the Pallas kernel fails at bench
     # scale (the init probe only validates a tiny compile), fall back to
     # the XLA matvec rather than losing the round's perf number.
-    try:
-        r0 = s.step(1.0)
-    except Exception as e:                          # noqa: BLE001
-        if s.ops.__class__.__name__ != "StructuredOps" or \
-                not getattr(s.ops, "use_pallas", False):
-            raise
-        print(f"# pallas path failed at scale ({type(e).__name__}: {e}); "
-              "retrying with pallas=off", file=sys.stderr, flush=True)
+    def pallas_fallback(why):
+        nonlocal s
+        print(f"# pallas path {why}; retrying with pallas=off",
+              file=sys.stderr, flush=True)
         cfg.solver.pallas = "off"
         del s   # free the failed solver's device buffers before re-upload
         s = Solver(model, cfg, mesh=make_mesh(), n_parts=n_parts,
                    backend=backend)
+        return s.step(1.0)
+
+    pallas_on = getattr(s.ops, "use_pallas", False)
+    try:
         r0 = s.step(1.0)
+    except Exception as e:                          # noqa: BLE001
+        if not pallas_on:
+            raise
+        r0 = pallas_fallback(f"failed at scale ({type(e).__name__}: {e})")
+    else:
+        if r0.flag != 0 and pallas_on:
+            # a mis-lowered kernel cannot fake convergence (the f64 true
+            # residual is computed on the XLA path) — a failed solve with
+            # pallas on warrants one XLA retry before reporting failure
+            r0 = pallas_fallback(f"solve flag={r0.flag}")
     print(f"# warm solve: flag={r0.flag} iters={r0.iters} "
           f"relres={r0.relres:.3e} wall={r0.wall_s:.2f}s (incl. compile)",
           file=sys.stderr, flush=True)

@@ -124,6 +124,8 @@ def selected_variant():
         return "v1", structured_matvec_pallas
     if v == "2":
         return "v2", structured_matvec_pallas_v2
+    if v != "3":
+        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3, got {v!r}")
     return "v3", structured_matvec_pallas_v3
 
 
@@ -298,11 +300,13 @@ def structured_matvec_pallas_v2(xg, ck, Ke, *, interpret=False):
 
 
 def _matvec_kernel_v3(ke_ref, x_hbm, ck_hbm, y_ref,
-                      xv, ckv, acc, sems, ck_sems, *, g, cpp, m, sy):
+                      xv, ckv, acc, sems, ck_sems, *, g, cpp, nxn, m, sy):
     """One grid step = C finished output node planes (flat lanes).
 
     ke_ref: (24, 24) VMEM
-    x_hbm:  (3, g*cpp + 1, m) ANY/HBM (zero-padded past plane nx)
+    x_hbm:  (3, nxn, m) ANY/HBM — NOT padded; tail-chunk plane copies
+            beyond nxn are skipped and the stale slot lanes they leave
+            behind only ever multiply ck = 0 (ck IS zero-padded)
     ck_hbm: (g*cpp, m) ANY/HBM (zero-padded)
     y_ref:  (3, cpp, m) VMEM output block (planes j*cpp ..< (j+1)*cpp)
     xv:     (2, 3, (cpp+1)*m + sy + 2) VMEM — double-buffered chunk +
@@ -314,35 +318,36 @@ def _matvec_kernel_v3(ke_ref, x_hbm, ck_hbm, y_ref,
     j = pl.program_id(0)
     cm = cpp * m
 
-    def chunk_copies(slot, chunk):
-        """Copy descriptors for one chunk: cpp+1 node planes into flat
-        lane offsets of the slot buffer + the ck plane block.  Recreated
-        identically at wait time (standard double-buffering pattern)."""
-        cps = [pltpu.make_async_copy(
-                   x_hbm.at[:, chunk * cpp + k],
-                   xv.at[slot, :, pl.ds(k * m, m)], sems.at[slot])
-               for k in range(cpp + 1)]
-        cps.append(pltpu.make_async_copy(
+    def for_chunk(slot, chunk, act):
+        """Start or wait the chunk's copies: cpp+1 node planes into flat
+        lane offsets of the slot buffer + the ck plane block.  Descriptors
+        are recreated identically at wait time (standard double-buffering
+        pattern); out-of-range tail planes are skipped on BOTH sides."""
+        for k in range(cpp + 1):
+            plane = chunk * cpp + k
+
+            @pl.when(plane < nxn)
+            def _cp():
+                getattr(pltpu.make_async_copy(
+                    x_hbm.at[:, plane],
+                    xv.at[slot, :, pl.ds(k * m, m)], sems.at[slot]), act)()
+        getattr(pltpu.make_async_copy(
             ck_hbm.at[pl.ds(chunk * cpp, cpp)],
-            ckv.at[slot], ck_sems.at[slot]))
-        return cps
+            ckv.at[slot], ck_sems.at[slot]), act)()
 
     @pl.when(j == 0)
     def _init():
         xv[...] = jnp.zeros_like(xv)       # zero overhang tails once
         acc[...] = jnp.zeros_like(acc)
-        for cp in chunk_copies(0, 0):
-            cp.start()
+        for_chunk(0, 0, "start")
 
     # wait for this chunk's data; prefetch the next chunk
     slot = jax.lax.rem(j, jnp.asarray(2, j.dtype))
-    for cp in chunk_copies(slot, j):
-        cp.wait()
+    for_chunk(slot, j, "wait")
 
     @pl.when(j + 1 < g)
     def _prefetch():
-        for cp in chunk_copies(1 - slot, j + 1):
-            cp.start()
+        for_chunk(1 - slot, j + 1, "start")
 
     ck = ckv[slot].reshape(1, cm)                       # (1, cm)
     # v = sum_a Ke[:, 3a:3a+3] @ (ck * x_slice_a)  — 8 MXU dots, no
@@ -380,11 +385,12 @@ def structured_matvec_pallas_v3(xg, ck, Ke, *, interpret=False, planes=4):
     m = nyn * nzn
     cpp = max(1, min(planes, nx + 1))
     g = -(-(nx + 1) // cpp)                 # ceil: covers all output planes
-    x_flat = jnp.pad(xg.reshape(3, nxn, m),
-                     ((0, 0), (0, g * cpp + 1 - nxn), (0, 0)))
-    ck_pad = jnp.pad(ck, ((0, 0), (0, 1), (0, 1))).reshape(nx, m)
-    ck_pad = jnp.pad(ck_pad, ((0, g * cpp - nx), (0, 0)))
-    kernel = functools.partial(_matvec_kernel_v3, g=g, cpp=cpp, m=m, sy=nzn)
+    x_flat = xg.reshape(3, nxn, m)          # free reshape, no copy
+    # single pad; loop-invariant, so XLA hoists it out of the PCG loop
+    ck_pad = jnp.pad(ck, ((0, g * cpp - nx), (0, 1), (0, 1))) \
+        .reshape(g * cpp, m)
+    kernel = functools.partial(_matvec_kernel_v3, g=g, cpp=cpp, nxn=nxn,
+                               m=m, sy=nzn)
     y = pl.pallas_call(
         kernel,
         grid=(g,),

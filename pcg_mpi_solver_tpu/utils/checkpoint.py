@@ -73,14 +73,21 @@ def _fingerprint(solver) -> dict:
                    [int(f) for f in th.export_frames], th.export_vars],
         "plot": [bool(th.plot_flag), [int(d) for d in th.probe_dofs]],
         "backend": solver.backend,
-        # EFFECTIVE kernel choice, not the "auto" knob: the Pallas matvec
-        # has a different summation order (changes iteration counts,
-        # breaking exact resume), but it only ever executes on f32 matvecs
-        # — a pure-f64 direct run is byte-identical either way.
-        "pallas": bool(getattr(solver.ops, "use_pallas", False)
-                       and (solver.mixed
-                            or np.dtype(solver.dtype) == np.float32)),
+        # EFFECTIVE kernel choice, not the "auto" knob: each Pallas matvec
+        # variant has its own summation order (changes iteration counts,
+        # breaking exact resume), but kernels only ever execute on f32
+        # matvecs — a pure-f64 direct run is byte-identical either way.
+        "pallas": _effective_kernel(solver),
     }
+
+
+def _effective_kernel(solver) -> str:
+    if not (getattr(solver.ops, "use_pallas", False)
+            and (solver.mixed or np.dtype(solver.dtype) == np.float32)):
+        return "off"
+    from pcg_mpi_solver_tpu.ops.pallas_matvec import selected_variant
+
+    return selected_variant()[0]
 
 
 def state_dict(solver) -> dict:
@@ -177,8 +184,12 @@ class CheckpointManager:
         with np.load(self._ckpt_file(t)) as z:
             saved = json.loads(bytes(z["fingerprint"]).decode())
             # Checkpoints written before the pallas field existed can only
-            # have come from the XLA matvec path.
-            saved.setdefault("pallas", False)
+            # have come from the XLA matvec path; a bool False predates
+            # the variant-name format and also means the XLA path.  (A
+            # bool True is left as-is: the variant it ran is unknown, so
+            # the mismatch error is the correct outcome.)
+            if saved.get("pallas", False) is False:
+                saved["pallas"] = "off"
             want = _fingerprint(solver)
             if saved != want:
                 diffs = {k: (saved.get(k), want[k]) for k in want
