@@ -27,6 +27,10 @@ citations:
   interface elements — the reference keeps these only inside its partition
   pickles (partition_mesh.py:603-650), so they have no MDF representation
   to mirror
+- ``Grid.npz`` / ``Octree.npz`` (OUR schema extensions): structured-grid /
+  octree-lattice fast-path metadata (ModelData.grid / .octree), so a
+  re-ingested model keeps its structured/hybrid backend eligibility;
+  readers of the reference schema can ignore both
 
 The writer emits the same schema from a ModelData (round-trip tested), so
 synthetic models can feed the reference and vice versa.
@@ -155,6 +159,27 @@ def read_mdf(mdf_path: str) -> ModelData:
         fo2 = bin_("FacesOffset", np.int64, (n_faces, 2), "F")
         faces_flat, faces_offset = _offsets_to_csr(ff, fo2)
 
+    # fast-path metadata sidecars (not part of the reference schema;
+    # re-ingested models keep their structured/hybrid backend eligibility)
+    grid = None
+    octree = None
+    if os.path.exists(p("Grid.npz")):
+        with np.load(p("Grid.npz")) as z:
+            grid = (int(z["nx"]), int(z["ny"]), int(z["nz"]),
+                    float(z["h"]))
+    if os.path.exists(p("Octree.npz")):
+        with np.load(p("Octree.npz")) as z:
+            octree = {
+                "leaves": z["leaves"],
+                "dims": tuple(int(d) for d in z["dims"]),
+                "node_keys": z["node_keys"],
+                "strides": tuple(int(s) for s in z["strides"]),
+                "brick_type": (int(z["brick_type"])
+                               if int(z["brick_type"]) >= 0 else None),
+                "brick_corners": (z["brick_corners"]
+                                  if z["brick_corners"].size else None),
+            }
+
     intfc_elems = None
     if os.path.exists(p("Intfc.npz")):
         with np.load(p("Intfc.npz")) as z:
@@ -179,6 +204,7 @@ def read_mdf(mdf_path: str) -> ModelData:
         ck=ck, cm=cm, ce=ce, level=level, poly_mat=poly_mat, sctrs=sctrs,
         elem_lib=elem_lib, mat_prop=mat_prop, dt=dt,
         faces_flat=faces_flat, faces_offset=faces_offset,
+        grid=grid, octree=octree,
         intfc_elems=intfc_elems,
     )
 
@@ -259,6 +285,28 @@ def write_mdf(model: ModelData, mdf_path: str) -> str:
         # boundary (reference export_vtk.py:112 bincounts |ids| 0-based).  Our
         # stored faces are all boundary, so each id appears exactly once.
         np.arange(n_faces, dtype=np.int32).tofile(p("PolysFlat.bin"))
+
+    for name, present in (("Grid.npz", model.grid is not None),
+                          ("Octree.npz", model.octree is not None)):
+        if not present and os.path.exists(p(name)):
+            os.remove(p(name))      # never leave stale sidecars behind
+    if model.grid is not None:
+        nx_, ny_, nz_, h_ = model.grid
+        np.savez(p("Grid.npz"), nx=nx_, ny=ny_, nz=nz_, h=h_)
+    if model.octree is not None:
+        ot = model.octree
+        bt = ot.get("brick_type")
+        bc = ot.get("brick_corners")
+        np.savez(
+            p("Octree.npz"),
+            leaves=np.asarray(ot["leaves"], np.int64),
+            dims=np.asarray(ot["dims"], np.int64),
+            node_keys=np.asarray(ot["node_keys"], np.int64),
+            strides=np.asarray(ot["strides"], np.int64),
+            brick_type=np.int64(-1 if bt is None else bt),
+            brick_corners=(np.zeros((0, 3), np.int64) if bc is None
+                           else np.asarray(bc, np.int64)),
+        )
 
     if not model.intfc_elems and os.path.exists(p("Intfc.npz")):
         os.remove(p("Intfc.npz"))   # never leave stale interfaces behind

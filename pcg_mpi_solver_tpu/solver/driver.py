@@ -146,6 +146,10 @@ class Solver:
         if backend not in ("auto", "structured", "hybrid", "general"):
             raise ValueError(f"backend must be 'auto'|'structured'|'hybrid'|"
                              f"'general', got {backend!r}")
+        # Kernel variant is FIXED at construction (the env knob is read at
+        # trace time); the checkpoint fingerprint must record what this
+        # solver actually compiled, not the env at save() time.
+        self.pallas_variant = "off"
         if backend == "structured" and not can_structured:
             raise ValueError("structured backend requested but model/partition "
                              "layout does not allow it")
@@ -173,6 +177,11 @@ class Solver:
                 solver_cfg.pallas, self.mesh,
                 shapes=(((3, sp.nxc + 1, sp.ny + 1, sp.nz + 1),
                          (sp.nxc, sp.ny, sp.nz)),))
+            if use_pallas:
+                from pcg_mpi_solver_tpu.ops.pallas_matvec import (
+                    selected_variant)
+
+                self.pallas_variant = selected_variant()[0]
             self.ops = StructuredOps.from_partition(
                 self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS,
                 use_pallas=use_pallas)
@@ -191,6 +200,11 @@ class Solver:
                 shapes=tuple(((3, lv.bx + 1, lv.by + 1, lv.bz + 1),
                               (lv.bx, lv.by, lv.bz))
                              for lv in self.pm.levels))
+            if use_pallas:
+                from pcg_mpi_solver_tpu.ops.pallas_matvec import (
+                    selected_variant)
+
+                self.pallas_variant = selected_variant()[0]
             self.ops = HybridOps.from_hybrid(
                 self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS,
                 use_pallas=use_pallas)

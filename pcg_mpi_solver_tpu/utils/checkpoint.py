@@ -82,12 +82,13 @@ def _fingerprint(solver) -> dict:
 
 
 def _effective_kernel(solver) -> str:
+    """The variant this solver COMPILED (pinned at construction — the env
+    knob is read at trace time, so the env at save() time is irrelevant),
+    gated on an f32 matvec path actually existing."""
     if not (getattr(solver.ops, "use_pallas", False)
             and (solver.mixed or np.dtype(solver.dtype) == np.float32)):
         return "off"
-    from pcg_mpi_solver_tpu.ops.pallas_matvec import selected_variant
-
-    return selected_variant()[0]
+    return getattr(solver, "pallas_variant", "off")
 
 
 def state_dict(solver) -> dict:

@@ -60,3 +60,38 @@ def test_ingest_archive(tmp_path):
     mdf = ingest_archive(archive, str(tmp_path / "scratch"))
     m2 = read_mdf(mdf)
     assert m2.n_elem == model.n_elem
+
+def test_mdf_roundtrip_fastpath_sidecars(tmp_path):
+    """grid/octree metadata survives the MDF round trip, so re-ingested
+    models keep their structured/hybrid backend eligibility."""
+    from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+    cube = make_cube_model(4, 3, 3)
+    m2 = read_mdf(write_mdf(cube, str(tmp_path / "cube")))
+    assert m2.grid == cube.grid
+
+    ot = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
+    m3 = read_mdf(write_mdf(ot, str(tmp_path / "ot")))
+    assert m3.octree is not None
+    assert m3.octree["brick_type"] == ot.octree["brick_type"]
+    np.testing.assert_array_equal(m3.octree["leaves"], ot.octree["leaves"])
+    np.testing.assert_array_equal(m3.octree["node_keys"],
+                                  ot.octree["node_keys"])
+    np.testing.assert_array_equal(m3.octree["brick_corners"],
+                                  ot.octree["brick_corners"])
+    assert m3.octree["strides"] == ot.octree["strides"]
+
+    # the re-read model solves on the hybrid backend like the original
+    from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
+    from pcg_mpi_solver_tpu.solver import Solver
+
+    cfg = RunConfig(solver=SolverConfig(tol=1e-8, max_iter=2000),
+                    time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]))
+    s1 = Solver(ot, cfg, mesh=make_mesh(4), n_parts=4)
+    s2 = Solver(m3, cfg, mesh=make_mesh(4), n_parts=4)
+    assert s1.backend == s2.backend == "hybrid"
+    r1, r2 = s1.step(1.0), s2.step(1.0)
+    assert r1.flag == 0 and r2.flag == 0
+    assert abs(r1.iters - r2.iters) <= 1
+    np.testing.assert_allclose(s1.displacement_global(),
+                               s2.displacement_global(), rtol=1e-8)
