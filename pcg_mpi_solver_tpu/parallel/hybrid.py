@@ -82,13 +82,20 @@ class HybridPartition:
         return getattr(self.pm, name)
 
 
+def can_hybrid(model: ModelData) -> bool:
+    """Single source of truth for hybrid-backend eligibility (used by the
+    quasi-static driver, the dynamics solver, and partition_hybrid)."""
+    return (model.octree is not None
+            and model.octree.get("brick_type") is not None)
+
+
 def partition_hybrid(model: ModelData, n_parts: int,
                      elem_part: Optional[np.ndarray] = None,
                      method: str = "rcb") -> HybridPartition:
-    meta = model.octree
-    if meta is None or meta.get("brick_type") is None:
+    if not can_hybrid(model):
         raise ValueError("model has no octree/brick metadata for the "
                          "hybrid backend")
+    meta = model.octree
     bt = meta["brick_type"]
     leaves = np.asarray(meta["leaves"])
     node_keys = np.asarray(meta["node_keys"])

@@ -153,10 +153,9 @@ class Solver:
         if backend == "structured" and not can_structured:
             raise ValueError("structured backend requested but model/partition "
                              "layout does not allow it")
-        can_hybrid = (
-            model.octree is not None
-            and model.octree.get("brick_type") is not None
-        )
+        from pcg_mpi_solver_tpu.parallel.hybrid import can_hybrid as _can_hy
+
+        can_hybrid = _can_hy(model)
         if backend == "hybrid" and not can_hybrid:
             raise ValueError("hybrid backend requested but model has no "
                              "octree/brick metadata")

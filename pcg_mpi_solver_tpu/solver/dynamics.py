@@ -68,6 +68,7 @@ class DynamicsSolver:
         dt: Optional[float] = None,
         damping: float = 0.0,          # c_m: mass-proportional damping
         probe_dofs: Sequence[int] = (),
+        backend: str = "auto",         # "auto" | "hybrid" | "general"
     ):
         self.config = config or RunConfig()
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -83,11 +84,41 @@ class DynamicsSolver:
             jax.config.update("jax_enable_x64", True)
         self.dtype = dtype
 
-        self.pm = partition_model(model, n_parts,
-                                  method=self.config.partition_method)
-        self.ops = Ops.from_model(self.pm, dot_dtype=dtype,
-                                  axis_name=PARTS_AXIS)
-        data = device_data(self.pm, dtype)
+        # Backend: the hybrid level-grid path serves octree models' matvec
+        # (the per-step hot op) exactly as in the quasi-static driver;
+        # everything else stays on the general path.
+        from pcg_mpi_solver_tpu.parallel.hybrid import can_hybrid
+
+        if backend not in ("auto", "hybrid", "general"):
+            raise ValueError(f"backend must be 'auto'|'hybrid'|'general', "
+                             f"got {backend!r}")
+        if backend == "hybrid" and not can_hybrid(model):
+            raise ValueError("hybrid backend requested but model has no "
+                             "octree/brick metadata")
+        if backend in ("auto", "hybrid") and can_hybrid(model):
+            from pcg_mpi_solver_tpu.parallel.hybrid import (
+                HybridOps, device_data_hybrid, partition_hybrid)
+            from pcg_mpi_solver_tpu.solver.driver import _pallas_enabled
+
+            self.backend = "hybrid"
+            self.pm = partition_hybrid(model, n_parts,
+                                       method=self.config.partition_method)
+            use_pallas = _pallas_enabled(
+                self.config.solver.pallas, self.mesh,
+                shapes=tuple(((3, lv.bx + 1, lv.by + 1, lv.bz + 1),
+                              (lv.bx, lv.by, lv.bz))
+                             for lv in self.pm.levels))
+            self.ops = HybridOps.from_hybrid(self.pm, dot_dtype=dtype,
+                                             axis_name=PARTS_AXIS,
+                                             use_pallas=use_pallas)
+            data = device_data_hybrid(self.pm, dtype)
+        else:
+            self.backend = "general"
+            self.pm = partition_model(model, n_parts,
+                                      method=self.config.partition_method)
+            self.ops = Ops.from_model(self.pm, dot_dtype=dtype,
+                                      axis_name=PARTS_AXIS)
+            data = device_data(self.pm, dtype)
         # Assembled lumped-mass diagonal: model.diag_M is already the global
         # assembled diagonal, sliced per part (partition extract_NodalVectors
         # analogue) — no cross-part assembly needed.
