@@ -104,7 +104,8 @@ def cmd_solve(args):
     print(f">solving on {n_dev_used}/{n_dev} device(s), {n_parts} parts "
           f"({cfg.solver.precision_mode} precision)..")
     s = Solver(model, cfg, mesh=make_mesh(n_dev_used), n_parts=n_parts,
-               elem_part=elem_part)
+               elem_part=elem_part, backend=args.backend)
+    print(f">backend: {s.backend}")
     store = RunStore(cfg.result_path, cfg.model_name)
     res = s.solve(store=None if cfg.speed_test else store,
                   resume=bool(args.resume))
@@ -140,12 +141,23 @@ def cmd_demo(args):
 
     cfg = _load_settings(args.settings, args)
     cfg.scratch_path = args.scratch
-    cfg.model_name = "demo_cube"
     cfg.time_history.export_vars = "U D ES PS PE"
-    model = make_cube_model(args.nx, args.ny or 0, args.nz or 0,
-                            E=30e9, nu=0.2, load="traction", load_value=1e6,
-                            heterogeneous=True)
-    print(f">demo model: {model.n_elem} elems / {model.n_dof} dofs")
+    if args.octree:
+        from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+        cfg.model_name = "demo_octree"
+        model = make_octree_model(
+            args.nx, args.ny or args.nx, args.nz or args.nx,
+            max_level=args.max_level, n_incl=3, seed=1,
+            E=30e9, nu=0.2, load="traction", load_value=1e6)
+        print(f">demo octree: {model.n_elem} elems / {model.n_dof} dofs / "
+              f"{len(model.elem_lib)} pattern types")
+    else:
+        cfg.model_name = "demo_cube"
+        model = make_cube_model(args.nx, args.ny or 0, args.nz or 0,
+                                E=30e9, nu=0.2, load="traction",
+                                load_value=1e6, heterogeneous=True)
+        print(f">demo model: {model.n_elem} elems / {model.n_dof} dofs")
     s = Solver(model, cfg)
     store = RunStore(cfg.result_path, cfg.model_name)
     res = s.solve(store=store)
@@ -195,6 +207,11 @@ def main(argv=None):
                    help="write a solver checkpoint every N time steps")
     p.add_argument("--resume", action="store_true",
                    help="continue from the latest checkpoint of this run")
+    p.add_argument("--backend",
+                   choices=["auto", "structured", "hybrid", "general"],
+                   default="auto",
+                   help="matvec backend (auto: structured for uniform "
+                        "grids, hybrid for octrees, else general)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of the solve here "
                         "(open with TensorBoard; shows the per-op "
@@ -217,6 +234,12 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default="mixed")
+    p.add_argument("--octree", action="store_true",
+                   help="graded octree model with transition pattern types "
+                        "(nx/ny/nz = base cells; solved on the hybrid "
+                        "level-grid backend)")
+    p.add_argument("--max-level", type=int, default=2,
+                   help="octree refinement levels (with --octree)")
     p.set_defaults(fn=cmd_demo)
 
     p = sub.add_parser("bench", help="benchmark harness (prints one JSON line)")

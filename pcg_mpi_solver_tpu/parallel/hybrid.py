@@ -89,6 +89,19 @@ def can_hybrid(model: ModelData) -> bool:
             and model.octree.get("brick_type") is not None)
 
 
+def hybrid_pallas_enabled(hp: "HybridPartition", pallas_mode: str,
+                          mesh) -> bool:
+    """Resolve the pallas knob with THIS partition's level-grid shapes —
+    the one shared probe call for every hybrid consumer (quasi-static
+    driver, dynamics)."""
+    from pcg_mpi_solver_tpu.solver.driver import _pallas_enabled
+
+    return _pallas_enabled(
+        pallas_mode, mesh,
+        shapes=tuple(((3, lv.bx + 1, lv.by + 1, lv.bz + 1),
+                      (lv.bx, lv.by, lv.bz)) for lv in hp.levels))
+
+
 def partition_hybrid(model: ModelData, n_parts: int,
                      elem_part: Optional[np.ndarray] = None,
                      method: str = "rcb") -> HybridPartition:

@@ -192,13 +192,13 @@ class Solver:
             from pcg_mpi_solver_tpu.parallel.hybrid import (
                 HybridOps, device_data_hybrid, partition_hybrid)
 
+            from pcg_mpi_solver_tpu.parallel.hybrid import (
+                hybrid_pallas_enabled)
+
             self.pm = partition_hybrid(model, n_parts, elem_part=elem_part,
                                        method=self.config.partition_method)
-            use_pallas = _pallas_enabled(
-                solver_cfg.pallas, self.mesh,
-                shapes=tuple(((3, lv.bx + 1, lv.by + 1, lv.bz + 1),
-                              (lv.bx, lv.by, lv.bz))
-                             for lv in self.pm.levels))
+            use_pallas = hybrid_pallas_enabled(
+                self.pm, solver_cfg.pallas, self.mesh)
             if use_pallas:
                 from pcg_mpi_solver_tpu.ops.pallas_matvec import (
                     selected_variant)

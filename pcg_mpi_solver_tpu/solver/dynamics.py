@@ -97,17 +97,16 @@ class DynamicsSolver:
                              "octree/brick metadata")
         if backend in ("auto", "hybrid") and can_hybrid(model):
             from pcg_mpi_solver_tpu.parallel.hybrid import (
-                HybridOps, device_data_hybrid, partition_hybrid)
-            from pcg_mpi_solver_tpu.solver.driver import _pallas_enabled
+                HybridOps, device_data_hybrid, hybrid_pallas_enabled,
+                partition_hybrid)
 
             self.backend = "hybrid"
             self.pm = partition_hybrid(model, n_parts,
                                        method=self.config.partition_method)
-            use_pallas = _pallas_enabled(
-                self.config.solver.pallas, self.mesh,
-                shapes=tuple(((3, lv.bx + 1, lv.by + 1, lv.bz + 1),
-                              (lv.bx, lv.by, lv.bz))
-                             for lv in self.pm.levels))
+            # Pallas only ever dispatches on f32 matvecs; dynamics has no
+            # mixed-precision f32 shadow, so skip the probe in f64 runs.
+            use_pallas = (dtype == jnp.float32 and hybrid_pallas_enabled(
+                self.pm, self.config.solver.pallas, self.mesh))
             self.ops = HybridOps.from_hybrid(self.pm, dot_dtype=dtype,
                                              axis_name=PARTS_AXIS,
                                              use_pallas=use_pallas)

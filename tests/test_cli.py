@@ -56,3 +56,31 @@ def test_cli_speed_test_no_exports(tmp_path, capsys):
           "--precision", "direct"])
     capsys.readouterr()
     assert not os.path.exists(f"{scratch}/Results_Run2_SpeedTest/ResVecData/U_1.npy")
+
+def test_cli_octree_demo(tmp_path, capsys):
+    main(["demo", "--octree", "--nx", "2", "--max-level", "2",
+          "--scratch", str(tmp_path / "sc"), "--max-iter", "2000"])
+    out = capsys.readouterr().out
+    assert "pattern types" in out
+    assert "[hybrid backend]" in out
+    assert "flag=0" in out and ">success!" in out
+
+
+def test_cli_solve_backend_flag(tmp_path, capsys):
+    from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+    model = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
+    src = tmp_path / "src"
+    write_mdf(model, str(src))
+    archive = shutil.make_archive(str(tmp_path / "ot"), "zip", src)
+    scratch = str(tmp_path / "scratch")
+    main(["ingest", archive, scratch])
+    # sidecar survives ingest -> auto backend resolves hybrid; the flag
+    # can force the general path
+    main(["solve", scratch, "3", "--n-parts", "4", "--precision", "direct"])
+    out = capsys.readouterr().out
+    assert ">backend: hybrid" in out and "flag=0" in out
+    main(["solve", scratch, "4", "--n-parts", "4", "--backend", "general",
+          "--precision", "direct"])
+    out = capsys.readouterr().out
+    assert ">backend: general" in out and "flag=0" in out
