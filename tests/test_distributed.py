@@ -80,14 +80,21 @@ from pcg_mpi_solver_tpu.utils.io import RunStore
 # Exports + checkpointing ON: every process computes (collective fetches),
 # only process 0 writes (multi-host-safe write gating).
 scratch = sys.argv[3]
-model = make_cube_model(6, 4, 4, heterogeneous=True)
+BACKEND = sys.argv[5]
+if BACKEND == "hybrid":
+    from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+    model = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
+else:
+    model = make_cube_model(6, 4, 4, heterogeneous=True)
 cfg = RunConfig(scratch_path=scratch, run_id="mh", checkpoint_every=1,
                 solver=SolverConfig(tol=1e-8, max_iter=500),
                 time_history=TimeHistoryConfig(
                     time_step_delta=[0.0, 0.5, 1.0],
                     export_flag=True, export_frame_rate=1,
                     plot_flag=True, probe_dofs=(3, 10)))
-s = Solver(model, cfg, mesh=make_global_mesh(), n_parts=8, backend="general")
+s = Solver(model, cfg, mesh=make_global_mesh(), n_parts=8, backend=BACKEND)
+assert s.backend == BACKEND, s.backend
 store = RunStore(cfg.result_path)
 res = s.solve(store=store)[-1]
 from jax.experimental import multihost_utils
@@ -117,8 +124,9 @@ if pid == 0:
 
 @pytest.mark.skipif(os.environ.get("PCG_TPU_SKIP_MULTIPROC") == "1",
                     reason="multi-process test disabled")
-@pytest.mark.parametrize("n_procs", [2, 4])
-def test_multi_process_solve(tmp_path, n_procs):
+@pytest.mark.parametrize("n_procs,backend", [(2, "general"), (4, "general"),
+                                             (2, "hybrid")])
+def test_multi_process_solve(tmp_path, n_procs, backend):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -133,7 +141,7 @@ def test_multi_process_solve(tmp_path, n_procs):
     scratch = tmp_path / "scratch"
     procs = [subprocess.Popen(
                  [sys.executable, str(script), coord, str(i), str(scratch),
-                  str(n_procs)],
+                  str(n_procs), backend],
                  stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                  text=True, env=env)
              for i in range(n_procs)]
@@ -152,27 +160,32 @@ def test_multi_process_solve(tmp_path, n_procs):
 
     # and it matches a single-process 8-part solve
     iters_multi = int(results[0].split("iters=")[1].split()[0])
-    assert abs(_reference_iters() - iters_multi) <= 1
+    assert abs(_reference_iters(backend) - iters_multi) <= 1
 
 
-_REF_ITERS = []
+_REF_ITERS = {}
 
 
-def _reference_iters() -> int:
-    """Single-process 8-part reference solve (computed once; both
-    n_procs parametrizations compare against the same number)."""
-    if not _REF_ITERS:
+def _reference_iters(backend: str) -> int:
+    """Single-process 8-part reference solve (computed once per backend;
+    all n_procs parametrizations compare against the same number)."""
+    if backend not in _REF_ITERS:
         from pcg_mpi_solver_tpu import (RunConfig, SolverConfig,
                                         TimeHistoryConfig)
         from pcg_mpi_solver_tpu.models import make_cube_model
         from pcg_mpi_solver_tpu.solver import Solver
 
-        model = make_cube_model(6, 4, 4, heterogeneous=True)
+        if backend == "hybrid":
+            from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+            model = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
+        else:
+            model = make_cube_model(6, 4, 4, heterogeneous=True)
         cfg = RunConfig(solver=SolverConfig(tol=1e-8, max_iter=500),
                         time_history=TimeHistoryConfig(
                             time_step_delta=[0.0, 0.5, 1.0],
                             export_flag=False))
         s1 = Solver(model, cfg, mesh=make_mesh(8), n_parts=8,
-                    backend="general")
-        _REF_ITERS.append(s1.solve()[-1].iters)
-    return _REF_ITERS[0]
+                    backend=backend)
+        _REF_ITERS[backend] = s1.solve()[-1].iters
+    return _REF_ITERS[backend]
