@@ -36,6 +36,14 @@ import numpy as np
 
 
 def main():
+    from pcg_mpi_solver_tpu.utils.backend_probe import probe_backend
+
+    ok, detail = probe_backend()
+    if not ok:
+        print(f"# FATAL: {detail}\n# No perf number can be produced from "
+              "this host.", file=sys.stderr, flush=True)
+        sys.exit(3)
+
     import jax
     import jax.numpy as jnp
 

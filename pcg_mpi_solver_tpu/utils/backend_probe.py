@@ -1,0 +1,52 @@
+"""Fail-fast accelerator-backend probe.
+
+On a tunneled TPU a dead relay makes the first backend touch
+(``jax.devices()``) block forever in a native retry loop that Python
+cannot interrupt — a caller would then eat its supervisor's whole timeout
+with zero diagnostics.  Probing in a subprocess turns that into a quick,
+explained failure.  The probe is skipped when it cannot add information:
+when the env pins the CPU backend (cannot hang on a tunnel), or when a
+backend is already live in this process (first touch already happened —
+and on process-exclusive TPUs a subprocess probe would falsely fail
+against our own device lock).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Tuple
+
+
+def backend_live() -> bool:
+    """True when a JAX backend is already initialized in this process."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:                                  # noqa: BLE001
+        return False
+
+
+def probe_backend(timeout_s: float = 180.0) -> Tuple[bool, str]:
+    """Returns (ok, detail).  detail explains a failure for the operator."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return True, "cpu backend pinned; probe skipped"
+    if backend_live():
+        return True, "backend already live in this process; probe skipped"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False, (
+            f"backend init did not complete within {timeout_s:.0f}s — "
+            "accelerator tunnel/relay is unreachable (dead relay process, "
+            "or the device is held by a wedged session)")
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-8:]
+        return False, (
+            f"backend init failed (rc={proc.returncode}):\n"
+            + "\n".join(tail))
+    return True, "ok"

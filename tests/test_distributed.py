@@ -53,6 +53,19 @@ def test_put_tree_handles_nested_and_none():
     assert out["c"].sharding.spec == P()
 
 
+def make_mh_test_model(backend):
+    """The multi-process test model — ONE definition, embedded into the
+    child script via getsource so the reference solve and the child can
+    never drift apart."""
+    if backend == "hybrid":
+        from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+        return make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
+    from pcg_mpi_solver_tpu.models import make_cube_model
+
+    return make_cube_model(6, 4, 4, heterogeneous=True)
+
+
 _CHILD = r"""
 import os, sys
 N_PROCS = int(sys.argv[4])
@@ -81,12 +94,7 @@ from pcg_mpi_solver_tpu.utils.io import RunStore
 # only process 0 writes (multi-host-safe write gating).
 scratch = sys.argv[3]
 BACKEND = sys.argv[5]
-if BACKEND == "hybrid":
-    from pcg_mpi_solver_tpu.models.octree import make_octree_model
-
-    model = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
-else:
-    model = make_cube_model(6, 4, 4, heterogeneous=True)
+model = make_mh_test_model(BACKEND)
 cfg = RunConfig(scratch_path=scratch, run_id="mh", checkpoint_every=1,
                 solver=SolverConfig(tol=1e-8, max_iter=500),
                 time_history=TimeHistoryConfig(
@@ -131,8 +139,10 @@ def test_multi_process_solve(tmp_path, n_procs, backend):
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     coord = f"127.0.0.1:{port}"
+    import inspect
+
     script = tmp_path / "child.py"
-    script.write_text(_CHILD)
+    script.write_text(inspect.getsource(make_mh_test_model) + _CHILD)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = os.pathsep.join(
@@ -172,15 +182,9 @@ def _reference_iters(backend: str) -> int:
     if backend not in _REF_ITERS:
         from pcg_mpi_solver_tpu import (RunConfig, SolverConfig,
                                         TimeHistoryConfig)
-        from pcg_mpi_solver_tpu.models import make_cube_model
         from pcg_mpi_solver_tpu.solver import Solver
 
-        if backend == "hybrid":
-            from pcg_mpi_solver_tpu.models.octree import make_octree_model
-
-            model = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
-        else:
-            model = make_cube_model(6, 4, 4, heterogeneous=True)
+        model = make_mh_test_model(backend)
         cfg = RunConfig(solver=SolverConfig(tol=1e-8, max_iter=500),
                         time_history=TimeHistoryConfig(
                             time_step_delta=[0.0, 0.5, 1.0],
