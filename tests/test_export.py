@@ -166,3 +166,54 @@ def test_probe_dof_history(tmp_path):
     u_hist = dat["Plot_U"]
     assert u_hist.shape == (1, 2)
     np.testing.assert_allclose(u_hist[0, 0] * 4.0, u_hist[0, 1], rtol=1e-5)
+
+
+def test_frame_shard_validation(tmp_path):
+    """read_frame must reject stale, incomplete, or mixed-generation
+    shard sets instead of merging them into a garbled frame."""
+    import pytest
+
+    from pcg_mpi_solver_tpu.utils.io import RunStore
+
+    store = RunStore(str(tmp_path / "res"))
+    store.prepare()
+    store.write_frame_shard("U", 0, np.arange(3.0), 0, 4, 8)
+    # incomplete: missing parts 4..8
+    with pytest.raises(ValueError, match="incomplete"):
+        store.read_frame("U", 0)
+    store.write_frame_shard("U", 0, np.arange(2.0), 4, 8, 8)
+    np.testing.assert_array_equal(store.read_frame("U", 0),
+                                  [0.0, 1.0, 2.0, 0.0, 1.0])
+    assert store.n_frames("U") == 1
+    # mixed generation: stale shard from an older 4-part layout
+    store.write_frame_shard("U", 0, np.arange(1.0), 0, 2, 4)
+    with pytest.raises(ValueError, match="mixed-generation"):
+        store.read_frame("U", 0)
+
+
+def test_frame_shard_gap_detected(tmp_path):
+    import pytest
+
+    from pcg_mpi_solver_tpu.utils.io import RunStore
+
+    store = RunStore(str(tmp_path / "res"))
+    store.prepare()
+    store.write_frame_shard("U", 1, np.arange(3.0), 0, 2, 6)
+    store.write_frame_shard("U", 1, np.arange(2.0), 4, 6, 6)
+    with pytest.raises(ValueError, match="tile contiguously"):
+        store.read_frame("U", 1)
+
+
+def test_backend_probe_skips():
+    """The probe must not spawn subprocesses when it cannot add info."""
+    import os
+
+    from pcg_mpi_solver_tpu.utils.backend_probe import (backend_live,
+                                                        probe_backend)
+
+    # conftest pins JAX_PLATFORMS=cpu for the test session
+    assert os.environ.get("JAX_PLATFORMS", "").lower() == "cpu"
+    ok, detail = probe_backend(timeout_s=1.0)
+    assert ok and "skipped" in detail
+    # jax is live in the test process by now
+    assert backend_live()
