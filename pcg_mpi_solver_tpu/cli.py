@@ -39,6 +39,7 @@ def _load_settings(path, args) -> "RunConfig":
         tol=float(getattr(args, "tol", None) or sp.get("Tol", 1e-7)),
         max_iter=int(getattr(args, "max_iter", None) or sp.get("MaxIter", 10000)),
         precision_mode=getattr(args, "precision", None) or sp.get("PrecisionMode", "direct"),
+        precond=getattr(args, "precond", None) or sp.get("Precond", "jacobi"),
     )
     time_history = TimeHistoryConfig(
         time_step_delta=th.get("TimeStepDelta", [0.0, 1.0]),
@@ -200,6 +201,10 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
+    p.add_argument("--precond", choices=["jacobi", "block3"], default=None,
+                   help="preconditioner: scalar Jacobi (reference parity) "
+                        "or 3x3 node-block Jacobi (stronger on "
+                        "heterogeneous elasticity)")
     p.add_argument("--speed-test", action="store_true",
                    help="disable all exports for clean timing "
                         "(reference SpeedTestFlag)")

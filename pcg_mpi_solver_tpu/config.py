@@ -30,6 +30,11 @@ class SolverConfig:
     inner_tol: float = 1e-5       # per-refinement-cycle residual reduction (mixed)
     # MATLAB-pcg compatibility knobs (pcg_solver.py:399-404)
     max_stag_steps: int = 3
+    # Preconditioner: "jacobi" (scalar diag(K)^-1 — the reference's only
+    # choice, pcg_solver.py:346-352) or "block3" (assembled 3x3 node-block
+    # Jacobi, inverted per node — stronger on vector-valued elasticity;
+    # beyond-reference, BASELINE.json config 4 "block-Jacobi").
+    precond: str = "jacobi"
     # Split the solve into several device dispatches of at most this many
     # Krylov iterations each (-1 = auto: engage on large problems, sized so
     # one dispatch stays well under a minute; 0 = single dispatch).  Long

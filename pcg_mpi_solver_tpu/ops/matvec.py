@@ -298,6 +298,84 @@ class Ops:
                                      .at[ib].add(kp, mode="drop")
         )(y, data["spr_a"], data["spr_b"], data["spr_k"])
 
+    # -- node-block (3x3) diagonal for block-Jacobi ---------------------
+    def _node_block_local(self, data: dict) -> jnp.ndarray:
+        """Part-local per-node 3x3 diagonal blocks of K, flattened to
+        (P, n_node_loc, 9) row-major.  Same assembly path as diag_local but
+        keeping the full within-node coupling K[3a+i, 3a+j]; mirrored
+        patterns scale entry (i, j) by sign_i*sign_j (the diag's sign^2 == 1
+        generalized off the diagonal)."""
+        if not self.use_node_ell:
+            raise ValueError(
+                "block-Jacobi needs the node-contiguous dof layout "
+                "(PartitionedModel.ell); this model/partition lacks it — "
+                "use precond='jacobi'")
+        Pl = data["weight"].shape[0]
+        dt = data["weight"].dtype
+        out = jnp.zeros((Pl, self.n_node_loc, 9), dt)
+        for blk in data["blocks"]:
+            node = blk["node"]                            # (P, nn, N)
+            Pn, nn, N = node.shape
+            Ke4 = blk["Ke4"]                              # (nn, 3, nn, 3)
+            D = jnp.stack([Ke4[a, :, a, :] for a in range(nn)])  # (nn, 3, 3)
+            sv = jnp.where(blk["sign"], -1.0, 1.0).astype(dt) \
+                .reshape(Pn, nn, 3, N)
+            contrib = jnp.einsum("aij,pn,pain,pajn->panij",
+                                 D, blk["ck"], sv, sv,
+                                 precision=self.precision)
+            out = jax.vmap(
+                lambda o, idx, r: o.at[idx].add(r, mode="drop")
+            )(out, node.reshape(Pn, -1),
+              contrib.reshape(Pn, nn * N, 9))
+        return self._springs_into_blocks(data, out)
+
+    def _springs_into_blocks(self, data: dict, out):
+        """Cohesive-spring diagonal contributions into the (i, i) entries of
+        the endpoint nodes' blocks (off-node coupling is dropped — the
+        preconditioner is approximate there, like scalar Jacobi)."""
+        if "spr_a" not in data:
+            return out
+        Pl = out.shape[0]
+        flat = out.reshape(Pl, -1)
+
+        def add(fp, dof, kp):
+            idx = (dof // 3) * 9 + (dof % 3) * 4
+            return fp.at[idx].add(kp, mode="drop")
+
+        flat = jax.vmap(add)(flat, data["spr_a"], data["spr_k"])
+        flat = jax.vmap(add)(flat, data["spr_b"], data["spr_k"])
+        return flat.reshape(out.shape)
+
+    def node_block_diag(self, data: dict) -> jnp.ndarray:
+        """Fully assembled per-node 3x3 diagonal blocks (P, n_node_loc,
+        3, 3): local blocks summed across parts sharing the node (same
+        psum assembly as the scalar diag)."""
+        y = self._node_block_local(data)                  # (P, n, 9)
+        y = self.niface_assemble(data, y.transpose(0, 2, 1)).transpose(0, 2, 1)
+        return y.reshape(y.shape[0], self.n_node_loc, 3, 3)
+
+    def _as_node3(self, v: jnp.ndarray) -> jnp.ndarray:
+        """(P, n_loc) dof vector -> (P, n_node_loc, 3) node rows (the
+        node-contiguous layout; StructuredOps overrides for its
+        component-major grid layout)."""
+        return v.reshape(v.shape[0], self.n_node_loc, 3)
+
+    def block_precond(self, data: dict) -> jnp.ndarray:
+        """Inverted eff-masked node blocks, ready for ``apply_prec``."""
+        from pcg_mpi_solver_tpu.ops.precond import invert_node_blocks
+
+        return invert_node_blocks(self.node_block_diag(data),
+                                  self._as_node3(data["eff"]))
+
+    def apply_prec(self, m: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
+        """z = M^-1 r: elementwise for the scalar Jacobi inverse (ndim 2),
+        batched 3x3 block multiply for the block-Jacobi inverse (ndim 4)."""
+        if m.ndim == 2:
+            return m * r
+        z3 = jnp.einsum("pnij,pnj->pni", m, self._as_node3(r),
+                        precision=self.precision)
+        return z3.reshape(r.shape)
+
     def _scatter(self, data: dict, flat: jnp.ndarray) -> jnp.ndarray:
         """(P, NC) element-dof values -> (P, n_loc) via sorted segment_sum."""
         svals = jnp.take_along_axis(flat, data["scat_perm"], axis=1)

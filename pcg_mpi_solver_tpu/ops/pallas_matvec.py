@@ -77,7 +77,8 @@ def _matvec_kernel(ke_ref, x_hbm, ck_hbm, y_ref,
             for c in range(3):
                 t[3 * a + c] = ck * xv[c, dx, dy:dy + ny, dz:dz + nz]
         # v[d] = sum_e Ke[d, e] * t[e]  — unrolled plane-FMAs on the VPU;
-        # split by target corner as we go.
+        # split by target corner as we go.  Corner placement is a zero-pad
+        # (pure concatenate — Mosaic has no scatter-add lowering).
         lo = [jnp.zeros((ny + 1, nz + 1), xv.dtype) for _ in range(3)]
         hi = [jnp.zeros((ny + 1, nz + 1), xv.dtype) for _ in range(3)]
         for b, (ex, ey, ez) in enumerate(_CORNERS):
@@ -87,7 +88,7 @@ def _matvec_kernel(ke_ref, x_hbm, ck_hbm, y_ref,
                 for e in range(1, 24):
                     v = v + ke_ref[d, e] * t[e]
                 tgt = lo if ex == 0 else hi
-                tgt[c] = tgt[c].at[ey:ey + ny, ez:ez + nz].add(v)
+                tgt[c] = tgt[c] + jnp.pad(v, ((ey, 1 - ey), (ez, 1 - ez)))
         for c in range(3):
             y_ref[c, 0] = carry[c] + lo[c]
             carry[c] = hi[c]
@@ -349,30 +350,33 @@ def _matvec_kernel_v3(ke_ref, x_hbm, ck_hbm, y_ref,
     def _prefetch():
         for_chunk(1 - slot, j + 1, "start")
 
+    xb = xv[slot]                                       # (3, (cpp+1)m + tail)
     ck = ckv[slot].reshape(1, cm)                       # (1, cm)
     # v = sum_a Ke[:, 3a:3a+3] @ (ck * x_slice_a)  — 8 MXU dots, no
-    # (24, cm) gather buffer
+    # (24, cm) gather buffer.  All slice offsets are STATIC (Mosaic has no
+    # dynamic_slice lowering; the only dynamic index is the slot read).
     v = None
     for a, (dx, dy, dz) in enumerate(_CORNERS):
         off = dx * m + dy * sy + dz
-        t = ck * jax.lax.dynamic_slice(
-            xv[slot], (0, off), (3, cm))                # (3, cm)
+        t = ck * xb[:, off:off + cm]                    # (3, cm)
         pa = jax.lax.dot_general(
             ke_ref[:, 3 * a:3 * a + 3], t, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         v = pa if v is None else v + pa                 # (24, cm)
-    # scatter: out[q + off_e] += v_e[q]; the dx offset folds the carry to
-    # the next output plane into the accumulator's overlap plane
+    # scatter: out[q + off_e] += v_e[q] as 8 zero-padded adds (Mosaic has
+    # no scatter-add lowering; pads with static widths are pure
+    # concatenates); the dx offset folds the carry to the next output
+    # plane into the accumulator's overlap plane
+    mp = (cpp + 1) * m + sy + 2
     out = acc[...]
     for a, (dx, dy, dz) in enumerate(_CORNERS):
         off = dx * m + dy * sy + dz
-        for c in range(3):
-            out = out.at[c, off:off + cm].add(v[3 * a + c])
+        out = out + jnp.pad(v[3 * a:3 * a + 3],
+                            ((0, 0), (off, mp - off - cm)))
     y_ref[...] = out[:, :cm].reshape(3, cpp, m)
     # roll: overlap plane (+ tail zeros) becomes the next chunk's head
-    nxt = jnp.zeros_like(out)
-    acc[...] = nxt.at[:, :m + sy + 2].set(
-        jax.lax.dynamic_slice(out, (0, cm), (3, m + sy + 2)))
+    acc[...] = jnp.pad(out[:, cm:cm + m + sy + 2],
+                       ((0, 0), (0, mp - (m + sy + 2))))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "planes"))

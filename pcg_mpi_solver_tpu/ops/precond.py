@@ -1,0 +1,124 @@
+"""Node-block (3x3) Jacobi preconditioning.
+
+The reference has only the scalar Jacobi preconditioner (diag(K) assembled
+via the scatter path, pcg_solver.py:282-287,346-352).  For vector-valued
+elasticity the natural strengthening is BLOCK Jacobi over the 3 dofs of
+each node: M = blkdiag(K_aa) with K_aa the assembled 3x3 node-diagonal
+block.  It costs one extra small batched 3x3 inverse per preconditioner
+rebuild and a batched (n,3,3)@(n,3) matmul per PCG iteration — both
+MXU/VPU-friendly — and typically cuts iteration counts 10-30% on
+heterogeneous elastic models (BASELINE.json config 4: "block-Jacobi").
+
+This module holds the backend-agnostic piece: masked batched inversion.
+Assembling the blocks is an Ops-protocol method (``node_block_diag``),
+implemented per backend (general ELL, hybrid level-grid, structured slab).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def invert_node_blocks(B: jnp.ndarray, eff3: jnp.ndarray) -> jnp.ndarray:
+    """Invert per-node 3x3 blocks restricted to effective (free) dofs.
+
+    B:    (..., n, 3, 3) assembled node-diagonal blocks of K (SPD on the
+          free dofs).
+    eff3: (..., n, 3) 0/1 mask of effective dofs (0 = Dirichlet-fixed or
+          padding).
+
+    Fixed components are decoupled by masking row+column and placing 1 on
+    the diagonal, so the inverse acts as the identity there — applied to an
+    eff-masked residual those components stay exactly 0 (same contract as
+    the scalar path's ``where(eff > 0, 1/diag, 0)``).
+
+    Inversion is by explicit adjugate on blocks normalized by their diagonal
+    max (keeps determinants O(1): raw stiffness entries are ~E*h, whose
+    cube would overflow f32).  Blocks with a vanishing determinant fall
+    back to their scalar-Jacobi diagonal inverse.
+    """
+    dt = B.dtype
+    e = eff3.astype(dt)
+    eye = jnp.eye(3, dtype=dt)
+    Bm = B * e[..., :, None] * e[..., None, :] + (1.0 - e)[..., :, None] * eye
+
+    # normalize: s ~ the block's diagonal scale (>= 1 on fixed/padded rows)
+    d = jnp.diagonal(Bm, axis1=-2, axis2=-1)
+    s = jnp.max(jnp.abs(d), axis=-1)
+    s = jnp.where(s > 0, s, 1.0)
+    a = Bm / s[..., None, None]
+
+    c00 = a[..., 1, 1] * a[..., 2, 2] - a[..., 1, 2] * a[..., 2, 1]
+    c01 = a[..., 1, 2] * a[..., 2, 0] - a[..., 1, 0] * a[..., 2, 2]
+    c02 = a[..., 1, 0] * a[..., 2, 1] - a[..., 1, 1] * a[..., 2, 0]
+    det = (a[..., 0, 0] * c00 + a[..., 0, 1] * c01 + a[..., 0, 2] * c02)
+
+    # adj[i, j] = cofactor(j, i)
+    adj = jnp.stack([
+        jnp.stack([c00,
+                   a[..., 0, 2] * a[..., 2, 1] - a[..., 0, 1] * a[..., 2, 2],
+                   a[..., 0, 1] * a[..., 1, 2] - a[..., 0, 2] * a[..., 1, 1]],
+                  axis=-1),
+        jnp.stack([c01,
+                   a[..., 0, 0] * a[..., 2, 2] - a[..., 0, 2] * a[..., 2, 0],
+                   a[..., 0, 2] * a[..., 1, 0] - a[..., 0, 0] * a[..., 1, 2]],
+                  axis=-1),
+        jnp.stack([c02,
+                   a[..., 0, 1] * a[..., 2, 0] - a[..., 0, 0] * a[..., 2, 1],
+                   a[..., 0, 0] * a[..., 1, 1] - a[..., 0, 1] * a[..., 1, 0]],
+                  axis=-1),
+    ], axis=-2)
+
+    # a is diagonal-normalized, so a healthy SPD block has det >> eps;
+    # below that the adjugate inverse is numerically meaningless.
+    tiny = jnp.asarray(np.finfo(np.dtype(dt)).eps, dt)
+    ok = jnp.abs(det) > tiny
+    dinv = jnp.where(ok, 1.0 / jnp.where(ok, det, 1.0), 0.0)
+    inv = adj * (dinv / s)[..., None, None]
+
+    # Degenerate block: scalar Jacobi on its diagonal.  A zero diagonal on
+    # an EFFECTIVE dof (for SPD K: a fully disconnected dof) maps to inf,
+    # preserving pcg's flag-2 inf-preconditioner contract exactly like the
+    # scalar path's 1/0 (fixed/padded rows were masked to diagonal 1 above,
+    # so they never produce inf).
+    dsafe = jnp.where(d != 0, d, 1.0)
+    dvals = jnp.where(d != 0, 1.0 / dsafe, jnp.inf)
+    # embed on the diagonal by select, not multiply (inf * 0 would NaN)
+    scalar = jnp.where(eye > 0, dvals[..., :, None], jnp.zeros((), dt))
+    return jnp.where(ok[..., None, None], inv, scalar)
+
+
+VALID_PRECONDS = ("jacobi", "block3")
+
+
+def corner_block_field(Ke: jnp.ndarray, ck: jnp.ndarray,
+                       corners) -> jnp.ndarray:
+    """Brick-grid node-block assembly: every cell adds ``ck * Ke[3a:3a+3,
+    3a:3a+3]`` to its corner-``a`` node, as 8 pad-translated 9-channel
+    terms.  ck: (P, cx, cy, cz) cell grid -> (P, 9, cx+1, cy+1, cz+1) node
+    grid.  Shared by the structured slab and hybrid level-grid backends."""
+    Ke4 = Ke.reshape(8, 3, 8, 3)
+    D9 = jnp.stack([Ke4[a, :, a, :].reshape(9) for a in range(8)])
+    terms = []
+    for a, (dx, dy, dz) in enumerate(corners):
+        contrib = D9[a][None, :, None, None, None] * ck[:, None]
+        terms.append(jnp.pad(
+            contrib,
+            ((0, 0), (0, 0), (dx, 1 - dx), (dy, 1 - dy), (dz, 1 - dz))))
+    g = terms[0]
+    for t in terms[1:]:
+        g = g + t
+    return g
+
+
+def make_prec(ops, data: dict, kind: str):
+    """The preconditioner inverse for ``kind`` ("jacobi" | "block3"), ready
+    for ``ops.apply_prec`` inside the PCG body — the one shared builder for
+    every solver (quasi-static driver, implicit Newmark)."""
+    if kind == "block3":
+        return ops.block_precond(data)
+    if kind != "jacobi":
+        raise ValueError(f"precond must be 'jacobi'|'block3', got {kind!r}")
+    diag_k = ops.diag(data)
+    return jnp.where(data["eff"] > 0, 1.0 / diag_k, 0.0)

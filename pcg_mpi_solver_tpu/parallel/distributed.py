@@ -91,6 +91,17 @@ def put_sharded(x: np.ndarray, mesh: jax.sharding.Mesh,
         np.shape(x), sharding, lambda idx: np.asarray(x[idx]))
 
 
+def gather_owned_global(pm, x, mesh: Optional[jax.sharding.Mesh] = None,
+                        dtype=None) -> np.ndarray:
+    """(P, n_loc) part-padded dof vector -> (glob_n_dof,) global vector via
+    the owner mask (each dof written by exactly one part).  The one shared
+    mask-and-scatter idiom for every solver's global views."""
+    out = np.zeros(pm.glob_n_dof, dtype=dtype or np.float64)
+    m = (pm.weight > 0) & (pm.dof_gid >= 0)
+    out[pm.dof_gid[m]] = fetch_global(x, mesh)[m]
+    return out
+
+
 def fetch_global(x, mesh: Optional[jax.sharding.Mesh] = None) -> np.ndarray:
     """Fetch a (possibly multi-host sharded) jax.Array as full host numpy.
 

@@ -323,6 +323,28 @@ class HybridOps(Ops):
             y = self._level_scatter_add(y, g, lv, dims, Pn)
         return y
 
+    def _node_block_local(self, data):
+        """Transition-block node blocks (general path) + brick-cell corner
+        blocks pad-translated onto each level's node grid."""
+        if data["blocks"]:
+            y = Ops._node_block_local(self, data)
+        else:
+            Pl = data["weight"].shape[0]
+            y = self._springs_into_blocks(
+                data, jnp.zeros((Pl, self.n_node_loc, 9),
+                                data["weight"].dtype))
+        from pcg_mpi_solver_tpu.ops.precond import corner_block_field
+
+        for lv, dims in zip(data["levels"], self.level_dims):
+            ck = lv["ck"]
+            Pn = ck.shape[0]
+            g = corner_block_field(data["brick_Ke"], ck, _CORNERS)
+            rows = g.transpose(0, 2, 3, 4, 1).reshape(Pn, -1, 9)
+            y = jax.vmap(
+                lambda yp, idx, r: yp.at[idx].add(r, mode="drop")
+            )(y, lv["nidx"], rows)
+        return y
+
     # -- export protocol (strain + nodal averaging over blocks + levels) --
     def elem_strain(self, data, x):
         out = Ops.elem_strain(self, data, x) if data["blocks"] else []

@@ -1,4 +1,5 @@
 from pcg_mpi_solver_tpu.solver.pcg import pcg, PCGResult
 from pcg_mpi_solver_tpu.solver.driver import Solver, StepResult
+from pcg_mpi_solver_tpu.solver.newmark import NewmarkSolver
 
-__all__ = ["pcg", "PCGResult", "Solver", "StepResult"]
+__all__ = ["pcg", "PCGResult", "Solver", "StepResult", "NewmarkSolver"]

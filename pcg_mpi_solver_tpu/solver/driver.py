@@ -118,6 +118,11 @@ class Solver:
             raise ValueError(f"n_parts={n_parts} must be a multiple of device count {n_dev}")
 
         solver_cfg = self.config.solver
+        from pcg_mpi_solver_tpu.ops.precond import VALID_PRECONDS
+
+        if solver_cfg.precond not in VALID_PRECONDS:
+            raise ValueError(f"SolverConfig.precond must be one of "
+                             f"{VALID_PRECONDS}, got {solver_cfg.precond!r}")
         self.mixed = solver_cfg.precision_mode == "mixed"
         dtype = jnp.dtype(jnp.float64) if self.mixed else jnp.dtype(solver_cfg.dtype)
         dot_dtype = jnp.dtype(solver_cfg.dot_dtype)
@@ -253,9 +258,8 @@ class Solver:
             x0 = eff * un_prev
             if self.mixed:
                 data32 = data["f32"]
-                # Jacobi rebuild in f32 (pcg_solver.py:346-352)
-                diag32 = self.ops32.diag(data32)
-                inv_diag32 = jnp.where(data32["eff"] > 0, 1.0 / diag32, 0.0)
+                # preconditioner rebuild in f32 (pcg_solver.py:346-352)
+                inv_diag32 = self._make_prec(self.ops32, data32)
                 res = pcg_mixed(
                     self.ops32, data32, self.ops, data64,
                     fext, x0, inv_diag32,
@@ -265,9 +269,8 @@ class Solver:
                     inner_tol=solver_cfg.inner_tol,
                 )
             else:
-                # Jacobi preconditioner rebuild (pcg_solver.py:346-352)
-                diag_k = self.ops.diag(data64)
-                inv_diag = jnp.where(eff > 0, 1.0 / diag_k, 0.0)
+                # preconditioner rebuild (pcg_solver.py:346-352)
+                inv_diag = self._make_prec(self.ops, data64)
                 res = pcg(
                     self.ops, data64, fext, x0, inv_diag,
                     tol=solver_cfg.tol, max_iter=solver_cfg.max_iter,
@@ -328,6 +331,15 @@ class Solver:
         self._proc_step_times: List[float] = []
 
     # ------------------------------------------------------------------
+    def _make_prec(self, ops, d):
+        """Preconditioner inverse per config.solver.precond: scalar Jacobi
+        (P, n_loc) or 3x3 node-block Jacobi (P, n_node_loc, 3, 3); either
+        feeds ops.apply_prec inside the PCG body."""
+        from pcg_mpi_solver_tpu.ops.precond import make_prec
+
+        return make_prec(ops, d, self.config.solver.precond)
+
+    # ------------------------------------------------------------------
     def _build_chunked(self, scfg, glob_n_eff):
         """Jitted pieces of the dispatch-chunked solve (see __init__)."""
         cap = self._dispatch_cap
@@ -352,9 +364,8 @@ class Solver:
             carry0 = cold_carry(x0, r0, normr0, self.ops.dot_dtype)
             if mixed:
                 return udi, fext, carry0, normr0, n2b
-            # Jacobi rebuild once per step (not per dispatch).
-            diag_k = self.ops.diag(data64)
-            inv_diag = jnp.where(eff > 0, 1.0 / diag_k, 0.0)
+            # preconditioner rebuild once per step (not per dispatch).
+            inv_diag = self._make_prec(self.ops, data64)
             return udi, fext, carry0, normr0, n2b, inv_diag
 
         start_out_specs = ((P, P, carry_specs, R, R) if mixed
@@ -386,9 +397,7 @@ class Solver:
 
             def _inner_start(data, r, normr, n2b):
                 data32 = data["f32"]
-                eff32 = data32["eff"]
-                diag32 = self.ops32.diag(data32)
-                inv32 = jnp.where(eff32 > 0, 1.0 / diag32, 0.0)
+                inv32 = self._make_prec(self.ops32, data32)
                 tol_cycle = refine_tol(scfg.tol * n2b, normr, scfg.inner_tol)
                 rhat32 = (r / normr).astype(dd32)
                 # ||rhat||_w = ||r||_w / normr = 1 exactly; no matvec needed.

@@ -229,9 +229,6 @@ class DynamicsSolver:
         )
 
     def _global_u(self, u) -> np.ndarray:
-        from pcg_mpi_solver_tpu.parallel.distributed import fetch_global
+        from pcg_mpi_solver_tpu.parallel.distributed import gather_owned_global
 
-        out = np.zeros(self.pm.glob_n_dof, dtype=self.dtype)
-        m = (self.pm.weight > 0) & (self.pm.dof_gid >= 0)
-        out[self.pm.dof_gid[m]] = fetch_global(u, self.mesh)[m]
-        return out
+        return gather_owned_global(self.pm, u, self.mesh, self.dtype)

@@ -1,0 +1,342 @@
+"""Implicit elasto-dynamics: Newmark-beta time integration with a PCG
+solve per step.
+
+The reference's dynamics era was explicit-only (vestigial ``DiagM``/``Vd``/
+``Cm`` arrays, partition_mesh.py:324-330; no implicit integrator exists
+anywhere in it).  This module adds the implicit path (BASELINE.json
+config 5: "elasto-dynamic (implicit Newmark), repeated PCG solves per
+timestep"), TPU-first: each step is ONE jitted shard_map program — the
+effective-force build, the full PCG ``lax.while_loop`` on the shifted
+operator, and the kinematic updates never leave the device.
+
+Discretization (a-form, lumped mass M, mass-proportional damping C=c_m M):
+
+    A u_{n+1} = F(t_{n+1}) + M (a0 u_n + a2 v_n + a3 w_n)
+                           + C (a1 u_n + a4 v_n + a5 w_n)
+    w_{n+1}   = a0 (u_{n+1} - u_n) - a2 v_n - a3 w_n
+    v_{n+1}   = v_n + dt ((1-gamma) w_n + gamma w_{n+1})
+
+with A = K + a0 M + a1 C,  a0 = 1/(beta dt^2),  a1 = gamma/(beta dt),
+a2 = 1/(beta dt), a3 = 1/(2 beta) - 1, a4 = gamma/beta - 1,
+a5 = dt (gamma/(2 beta) - 1); (w = acceleration).  Default
+beta=1/4, gamma=1/2 (average acceleration: unconditionally stable, no
+algorithmic damping) — dt is a resolution choice, not a CFL bound, unlike
+the explicit solver (solver/dynamics.py).
+
+Because M is lumped (diagonal) and assembled, the shifted operator is the
+stock matrix-free K matvec plus an elementwise axpy; its Jacobi diagonal
+and 3x3 node blocks shift the same way, so both preconditioners and the
+mixed-precision refinement path work unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pcg_mpi_solver_tpu.config import RunConfig
+from pcg_mpi_solver_tpu.models.model_data import ModelData
+from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
+from pcg_mpi_solver_tpu.parallel.partition import partition_model
+from pcg_mpi_solver_tpu.solver.driver import StepResult, _data_specs
+from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_mixed
+
+
+@dataclasses.dataclass(frozen=True)
+class MassShiftedOps:
+    """A + c*M wrapper over any backend's Ops: matvec/diag/node blocks gain
+    the (assembled, diagonal) mass term; everything else delegates."""
+
+    base: Ops
+    c: float
+
+    def matvec(self, data, x):
+        return self.base.matvec(data, x) + self.c * data["diag_M"] * x
+
+    def matvec_local(self, data, x):
+        # diag_M holds ASSEMBLED values on every copy of a shared dof, so
+        # the shift must ride on the assembled product only (matvec above);
+        # a local partial sum plus the full mass term would double-count
+        # after assembly.
+        raise NotImplementedError("MassShiftedOps only exposes the "
+                                  "assembled matvec")
+
+    def diag(self, data):
+        return self.base.diag(data) + self.c * data["diag_M"]
+
+    def node_block_diag(self, data):
+        B = self.base.node_block_diag(data)
+        m3 = self.base._as_node3(self.c * data["diag_M"])
+        return B + m3[..., :, None] * jnp.eye(3, dtype=B.dtype)
+
+    def block_precond(self, data):
+        from pcg_mpi_solver_tpu.ops.precond import invert_node_blocks
+
+        return invert_node_blocks(self.node_block_diag(data),
+                                  self.base._as_node3(data["eff"]))
+
+    def __getattr__(self, name):
+        if name in ("base", "c") or name.startswith("__"):
+            raise AttributeError(name)
+        return getattr(self.base, name)
+
+
+class NewmarkSolver:
+    """Implicit Newmark-beta on the SPMD-partitioned model.
+
+    Shares the quasi-static driver's backend selection (general node-ELL or
+    hybrid level-grid; the structured slab path has no mass data) and its
+    precision/preconditioner config (``config.solver.precision_mode``,
+    ``config.solver.precond``)."""
+
+    def __init__(
+        self,
+        model: ModelData,
+        config: Optional[RunConfig] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        n_parts: Optional[int] = None,
+        dt: float = 1.0,
+        beta: float = 0.25,
+        gamma: float = 0.5,
+        damping: float = 0.0,          # c_m: C = c_m * M
+        backend: str = "auto",         # "auto" | "hybrid" | "general"
+    ):
+        self.config = config or RunConfig()
+        scfg = self.config.solver
+        from pcg_mpi_solver_tpu.ops.precond import VALID_PRECONDS
+
+        if scfg.precond not in VALID_PRECONDS:
+            raise ValueError(f"SolverConfig.precond must be one of "
+                             f"{VALID_PRECONDS}, got {scfg.precond!r}")
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n_dev = self.mesh.devices.size
+        n_parts = n_parts or max(self.config.n_parts, n_dev)
+        if n_parts % n_dev != 0:
+            raise ValueError(f"n_parts={n_parts} must be a multiple of "
+                             f"device count {n_dev}")
+        if beta <= 0:
+            raise ValueError("NewmarkSolver requires beta > 0 (beta == 0 is "
+                             "the explicit path: solver/dynamics.py)")
+        if dt <= 0:
+            raise ValueError(f"NewmarkSolver requires dt > 0, got {dt}")
+        if scfg.iters_per_dispatch > 0:
+            import warnings
+
+            warnings.warn(
+                "SolverConfig.iters_per_dispatch is not supported by "
+                "NewmarkSolver (each step runs one device dispatch); "
+                "the setting is ignored")
+        self.dt, self.beta, self.gamma = float(dt), float(beta), float(gamma)
+        self.damping = float(damping)
+
+        self.mixed = scfg.precision_mode == "mixed"
+        dtype = jnp.dtype(jnp.float64) if self.mixed else jnp.dtype(scfg.dtype)
+        dot_dtype = jnp.dtype(scfg.dot_dtype)
+        if self.mixed or jnp.float64 in (dtype, dot_dtype):
+            if not jax.config.jax_enable_x64:
+                # honor requested f64 math (same rule as the quasi-static
+                # driver) — f32 storage still gets f64-accumulated dots
+                jax.config.update("jax_enable_x64", True)
+        self.dtype = dtype
+
+        from pcg_mpi_solver_tpu.parallel.hybrid import can_hybrid
+
+        if backend not in ("auto", "hybrid", "general"):
+            raise ValueError(f"backend must be 'auto'|'hybrid'|'general', "
+                             f"got {backend!r}")
+        if backend == "hybrid" and not can_hybrid(model):
+            raise ValueError("hybrid backend requested but model has no "
+                             "octree/brick metadata")
+        if backend in ("auto", "hybrid") and can_hybrid(model):
+            from pcg_mpi_solver_tpu.parallel.hybrid import (
+                HybridOps, device_data_hybrid, hybrid_pallas_enabled,
+                partition_hybrid)
+
+            self.backend = "hybrid"
+            self.pm = partition_hybrid(model, n_parts,
+                                       method=self.config.partition_method)
+            use_pallas = ((self.mixed or dtype == jnp.float32)
+                          and hybrid_pallas_enabled(self.pm, scfg.pallas,
+                                                    self.mesh))
+            mk_ops = lambda dd: HybridOps.from_hybrid(
+                self.pm, dot_dtype=dd, axis_name=PARTS_AXIS,
+                use_pallas=use_pallas)
+            data = device_data_hybrid(self.pm, dtype)
+        else:
+            self.backend = "general"
+            self.pm = partition_model(model, n_parts,
+                                      method=self.config.partition_method)
+            mk_ops = lambda dd: Ops.from_model(self.pm, dot_dtype=dd,
+                                               axis_name=PARTS_AXIS)
+            data = device_data(self.pm, dtype)
+
+        # Newmark coefficients (a-form)
+        dt_, b, g = self.dt, self.beta, self.gamma
+        self.a0 = 1.0 / (b * dt_ * dt_)
+        self.a1 = g / (b * dt_)
+        self.a2 = 1.0 / (b * dt_)
+        self.a3 = 1.0 / (2.0 * b) - 1.0
+        self.a4 = g / b - 1.0
+        self.a5 = dt_ * (g / (2.0 * b) - 1.0)
+        cshift = self.a0 + self.a1 * self.damping
+
+        base_ops = mk_ops(dot_dtype)
+        self.ops = MassShiftedOps(base_ops, cshift)
+
+        # Assembled lumped-mass diagonal, per-part (reference DiagM,
+        # partition_mesh.py:324-330); reconstructed from the stored inverse
+        # (zero-mass dofs stay 0: A = K there, still SPD).
+        inv_m = self.pm.inv_diag_M
+        diag_m = np.where(inv_m > 0, 1.0 / np.where(inv_m > 0, inv_m, 1.0), 0.0)
+        data["diag_M"] = jnp.asarray(diag_m, dtype)
+        gid = self.pm.dof_gid
+        data["Vd"] = jnp.asarray(
+            np.where(gid >= 0, model.Vd[np.maximum(gid, 0)], 0.0), dtype)
+
+        if self.mixed:
+            data = {
+                "f64": data,
+                "f32": jax.tree.map(
+                    lambda x: x.astype(jnp.float32)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, data),
+            }
+            self.ops32 = MassShiftedOps(mk_ops(jnp.float32), cshift)
+        self._specs = _data_specs(data)
+
+        from pcg_mpi_solver_tpu.parallel.distributed import put_sharded, put_tree
+
+        self.data = put_tree(data, self.mesh, self._specs)
+        self._part_spec = jax.sharding.PartitionSpec(PARTS_AXIS)
+        self._rep_spec = jax.sharding.PartitionSpec()
+        P, n_loc = self.pm.n_parts, self.pm.n_loc
+        zeros = lambda: put_sharded(np.zeros((P, n_loc), dtype),
+                                    self.mesh, self._part_spec)
+        self.u, self.v, self.w = zeros(), zeros(), zeros()
+
+        glob_n_eff = self.pm.glob_n_dof_eff
+        a0, a2_, a3_ = self.a0, self.a2, self.a3
+        a1_, a4_, a5_ = self.a1, self.a4, self.a5
+        cm = self.damping
+
+        def _step(data, prec, u, v, w, delta_next):
+            data64 = data["f64"] if self.mixed else data
+            eff = data64["eff"]
+            fix = 1.0 - eff
+            M = data64["diag_M"]
+            # effective force from the previous state (free + fixed dofs —
+            # the fixed-dof terms are lifted out below)
+            hist = M * ((a0 * u + a2_ * v + a3_ * w)
+                        + cm * (a1_ * u + a4_ * v + a5_ * w))
+            rhs = data64["F"] * delta_next + hist
+            # Dirichlet lifting at t_{n+1} (same shape as the quasi-static
+            # driver's updateBC, pcg_solver.py:226-238, with A in place of K)
+            udi = fix * data64["Ud"] * delta_next
+            fext = eff * (rhs - self.ops.matvec(data64, udi))
+            x0 = eff * u
+            if self.mixed:
+                res = pcg_mixed(
+                    self.ops32, data["f32"], self.ops, data64, fext, x0,
+                    prec,
+                    tol=scfg.tol, max_iter=scfg.max_iter,
+                    glob_n_dof_eff=glob_n_eff,
+                    max_stag_steps=scfg.max_stag_steps,
+                    inner_tol=scfg.inner_tol)
+            else:
+                res = pcg(
+                    self.ops, data64, fext, x0, prec,
+                    tol=scfg.tol, max_iter=scfg.max_iter,
+                    glob_n_dof_eff=glob_n_eff,
+                    max_stag_steps=scfg.max_stag_steps)
+            u2 = res.x + udi
+            # kinematic updates; on fixed dofs u2 carries the prescribed
+            # motion, so w2 is its finite-difference-consistent acceleration
+            w2 = a0 * (u2 - u) - a2_ * v - a3_ * w
+            v2 = v + dt_ * ((1.0 - g) * w + g * w2)
+            v2 = eff * v2 + fix * data64["Vd"] * delta_next
+            return u2, v2, w2, res.flag, res.relres, res.iters
+
+        P_, R_ = self._part_spec, self._rep_spec
+        self._step_fn = jax.jit(jax.shard_map(
+            _step, mesh=self.mesh,
+            in_specs=(self._specs, P_, P_, P_, P_, R_),
+            out_specs=(P_, P_, P_, R_, R_, R_), check_vma=False))
+
+        # A = K + c*M is CONSTANT over the run (unlike the quasi-static
+        # driver, whose per-step Jacobi rebuild is reference parity):
+        # build + invert the preconditioner ONCE, device-resident.
+        from pcg_mpi_solver_tpu.ops.precond import make_prec
+
+        def _prec(data):
+            if self.mixed:
+                return make_prec(self.ops32, data["f32"], scfg.precond)
+            return make_prec(self.ops, data, scfg.precond)
+
+        self._prec = jax.jit(jax.shard_map(
+            _prec, mesh=self.mesh,
+            in_specs=(self._specs,), out_specs=P_,
+            check_vma=False))(self.data)
+
+        def _init_accel(data, u, v, delta0):
+            """w = M^-1 (F(t)*delta0 - K u - C v) at the CURRENT state:
+            lumped M makes the solve elementwise (one K matvec)."""
+            data64 = data["f64"] if self.mixed else data
+            M = data64["diag_M"]
+            inv_m = jnp.where(M > 0, 1.0 / jnp.where(M > 0, M, 1.0), 0.0)
+            fint = base_ops.matvec(data64, u)      # K u (unshifted)
+            return data64["eff"] * (
+                inv_m * (data64["F"] * delta0 - fint) - cm * v)
+
+        self._init_fn = jax.jit(jax.shard_map(
+            _init_accel, mesh=self.mesh,
+            in_specs=(self._specs, P_, P_, R_), out_specs=P_,
+            check_vma=False))
+
+        self.flags: List[int] = []
+        self.relres: List[float] = []
+        self.iters: List[int] = []
+
+    def step(self, delta_next: float) -> StepResult:
+        import time
+
+        t0 = time.perf_counter()
+        u, v, w, flag, relres, iters = self._step_fn(
+            self.data, self._prec, self.u, self.v, self.w,
+            jnp.asarray(delta_next, self.dtype))
+        self.u, self.v, self.w = u, v, w
+        res = StepResult(int(flag), float(relres), int(iters),
+                         time.perf_counter() - t0)
+        self.flags.append(res.flag)
+        self.relres.append(res.relres)
+        self.iters.append(res.iters)
+        return res
+
+    def run(self, load_factor: Sequence[float],
+            init_accel_delta: Optional[float] = None) -> List[StepResult]:
+        """Integrate one step per load factor (load_factor[t] scales F, Ud
+        and Vd at t_{t+1}, like the quasi-static schedule).  With
+        ``init_accel_delta`` set, w is (re)initialized consistently from
+        the CURRENT state, w = M^-1 (F*delta - K u - C v) — standard when
+        F(t_0) != 0, and also correct for continuing a run."""
+        if init_accel_delta is not None:
+            self.w = self._init_fn(self.data, self.u, self.v,
+                                   jnp.asarray(init_accel_delta, self.dtype))
+        return [self.step(d) for d in load_factor]
+
+    def displacement_global(self) -> np.ndarray:
+        from pcg_mpi_solver_tpu.parallel.distributed import gather_owned_global
+
+        return gather_owned_global(self.pm, self.u, self.mesh,
+                                   np.dtype(self.dtype))
+
+    def state_global(self):
+        """(u, v, w) global vectors (for tests/restarts)."""
+        from pcg_mpi_solver_tpu.parallel.distributed import gather_owned_global
+
+        return tuple(gather_owned_global(self.pm, arr, self.mesh,
+                                         np.dtype(self.dtype))
+                     for arr in (self.u, self.v, self.w))

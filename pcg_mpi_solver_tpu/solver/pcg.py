@@ -91,7 +91,9 @@ def pcg(
     data: dict,
     fext: jnp.ndarray,        # (P, n_loc) rhs, already restricted to eff dofs
     x0: jnp.ndarray,          # (P, n_loc) initial guess (eff-restricted)
-    inv_diag: jnp.ndarray,    # (P, n_loc) Jacobi M^-1 on eff dofs (0 elsewhere)
+    inv_diag: jnp.ndarray,    # M^-1 on eff dofs (0 elsewhere): (P, n_loc)
+                              # scalar Jacobi, or (P, n_node_loc, 3, 3)
+                              # block-Jacobi (applied via ops.apply_prec)
     tol,
     max_iter,                 # static int, or traced scalar (then pass
                               # max_iter_nominal for the MoreSteps budget)
@@ -161,7 +163,9 @@ def pcg(
 
     def body(c):
         i = c["i"]
-        z = inv_diag * c["r"]
+        # scalar Jacobi inverse (P, n_loc) or block-Jacobi inverse
+        # (P, n_node_loc, 3, 3) — ops.apply_prec dispatches on rank
+        z = ops.apply_prec(inv_diag, c["r"])
 
         # The inf-preconditioner predicate must agree across shards or the
         # while_loop exits divergently and collective counts desync; fuse its
@@ -315,7 +319,8 @@ def pcg_mixed(
     data64: dict,
     fext: jnp.ndarray,        # (P, n_loc) f64 rhs on eff dofs
     x0: jnp.ndarray,          # (P, n_loc) f64 initial guess
-    inv_diag32: jnp.ndarray,  # (P, n_loc) f32 Jacobi inverse
+    inv_diag32: jnp.ndarray,  # f32 preconditioner inverse (scalar Jacobi
+                              # (P, n_loc) or block-Jacobi (P, n, 3, 3))
     tol: float,
     max_iter: int,
     glob_n_dof_eff: int,

@@ -66,6 +66,7 @@ def _fingerprint(solver) -> dict:
         "n_loc": int(solver.pm.n_loc),
         "dtype": str(np.dtype(solver.dtype)),
         "precision_mode": cfg.solver.precision_mode,
+        "precond": cfg.solver.precond,
         "tol": float(cfg.solver.tol),
         "max_iter": int(cfg.solver.max_iter),
         "deltas": [float(d) for d in th.time_step_delta],
@@ -191,6 +192,9 @@ class CheckpointManager:
             # the mismatch error is the correct outcome.)
             if saved.get("pallas", False) is False:
                 saved["pallas"] = "off"
+            # Checkpoints written before the precond field existed can only
+            # have come from the scalar-Jacobi path.
+            saved.setdefault("precond", "jacobi")
             want = _fingerprint(solver)
             if saved != want:
                 diffs = {k: (saved.get(k), want[k]) for k in want

@@ -1,0 +1,160 @@
+"""Implicit Newmark-beta dynamics (solver/newmark.py) vs an independent
+dense-matrix reference integrator, plus precision/preconditioner/backends.
+
+The reference has no implicit integrator (its dynamics era was explicit-
+only); this capability is BASELINE.json config 5."""
+
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.config import RunConfig, SolverConfig
+from pcg_mpi_solver_tpu.models import make_cube_model
+from pcg_mpi_solver_tpu.models.octree import make_octree_model
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+from pcg_mpi_solver_tpu.solver.newmark import NewmarkSolver
+
+
+def dense_newmark(model, dt, deltas, beta=0.25, gamma=0.5, cm=0.0):
+    """Independent numpy Newmark integrator on the dense assembled K."""
+    K = np.asarray(model.assemble_csr().todense())
+    M = model.diag_M.copy()
+    n = model.n_dof
+    fixed = np.zeros(n, bool)
+    fixed[model.fixed_dof] = True
+    free = ~fixed
+    a0 = 1.0 / (beta * dt * dt)
+    a1 = gamma / (beta * dt)
+    a2 = 1.0 / (beta * dt)
+    a3 = 1.0 / (2 * beta) - 1.0
+    a4 = gamma / beta - 1.0
+    a5 = dt * (gamma / (2 * beta) - 1.0)
+    A = K + (a0 + a1 * cm) * np.diag(M)
+    u = np.zeros(n)
+    v = np.zeros(n)
+    w = np.zeros(n)
+    for d in deltas:
+        rhs = model.F * d + M * (a0 * u + a2 * v + a3 * w) \
+            + cm * M * (a1 * u + a4 * v + a5 * w)
+        u2 = np.zeros(n)
+        u2[fixed] = model.Ud[fixed] * d
+        u2[free] = np.linalg.solve(A[np.ix_(free, free)],
+                                   (rhs - A @ u2)[free])
+        w2 = a0 * (u2 - u) - a2 * v - a3 * w
+        v2 = v + dt * ((1 - gamma) * w + gamma * w2)
+        v2[fixed] = model.Vd[fixed] * d
+        u, v, w = u2, v2, w2
+    return u, v, w
+
+
+def _cfg(mode="direct", precond="jacobi", tol=1e-12):
+    return RunConfig(solver=SolverConfig(tol=tol, max_iter=3000,
+                                         precision_mode=mode,
+                                         precond=precond))
+
+
+DELTAS = [0.5, 1.0, 1.0, 0.7, 0.3]
+
+
+def test_newmark_matches_dense_reference():
+    model = make_cube_model(4, 3, 3, h=0.5, nu=0.3, heterogeneous=True,
+                            seed=0)
+    dt = 0.2
+    s = NewmarkSolver(model, _cfg(), mesh=make_mesh(4), n_parts=4, dt=dt,
+                      damping=0.1)
+    results = s.run(DELTAS)
+    assert all(r.flag == 0 for r in results)
+    u_ref, v_ref, w_ref = dense_newmark(model, dt, DELTAS, cm=0.1)
+    u, v, w = s.state_global()
+    scale = np.abs(u_ref).max()
+    np.testing.assert_allclose(u, u_ref, atol=1e-8 * scale, rtol=1e-7)
+    np.testing.assert_allclose(v, v_ref, atol=1e-7 * scale / dt, rtol=1e-6)
+    np.testing.assert_allclose(w, w_ref, atol=1e-6 * scale / dt**2, rtol=1e-6)
+
+
+def test_newmark_dirichlet_driven_matches_dense():
+    model = make_cube_model(3, 3, 3, load="dirichlet", load_value=0.01)
+    dt = 0.1
+    s = NewmarkSolver(model, _cfg(), mesh=make_mesh(2), n_parts=2, dt=dt)
+    for r in s.run(DELTAS):
+        assert r.flag == 0
+    u_ref, _, _ = dense_newmark(model, dt, DELTAS)
+    u, _, _ = s.state_global()
+    np.testing.assert_allclose(u, u_ref, atol=1e-8 * np.abs(u_ref).max(),
+                               rtol=1e-7)
+
+
+def test_newmark_static_limit():
+    """dt -> inf: inertial terms vanish and one step is the static solve."""
+    import scipy.sparse.linalg as spla
+
+    model = make_cube_model(4, 3, 3, heterogeneous=True)
+    s = NewmarkSolver(model, _cfg(), mesh=make_mesh(4), n_parts=4, dt=1e8)
+    res = s.step(1.0)
+    assert res.flag == 0
+    K = model.assemble_csr().tocsc()
+    free = np.setdiff1d(np.arange(model.n_dof), model.fixed_dof)
+    u_stat = np.zeros(model.n_dof)
+    u_stat[free] = spla.spsolve(K[np.ix_(free, free)], model.F[free])
+    u = s.displacement_global()
+    np.testing.assert_allclose(u, u_stat, rtol=1e-6,
+                               atol=1e-9 * np.abs(u_stat).max())
+
+
+def test_newmark_partition_count_parity():
+    model = make_cube_model(4, 4, 4, heterogeneous=True)
+    runs = {}
+    for n_parts in (1, 8):
+        s = NewmarkSolver(model, _cfg(), mesh=make_mesh(n_parts),
+                          n_parts=n_parts, dt=0.2)
+        s.run(DELTAS)
+        runs[n_parts] = s.state_global()[0]
+    np.testing.assert_allclose(runs[8], runs[1], rtol=1e-8,
+                               atol=1e-11 * np.abs(runs[1]).max())
+
+
+@pytest.mark.parametrize("mode,precond", [("mixed", "jacobi"),
+                                          ("direct", "block3"),
+                                          ("mixed", "block3")])
+def test_newmark_modes(mode, precond):
+    model = make_cube_model(4, 3, 3, heterogeneous=True)
+    dt = 0.2
+    tol = 1e-10 if mode == "mixed" else 1e-12
+    s = NewmarkSolver(model, _cfg(mode, precond, tol), mesh=make_mesh(4),
+                      n_parts=4, dt=dt)
+    for r in s.run(DELTAS):
+        assert r.flag == 0
+    u_ref, _, _ = dense_newmark(model, dt, DELTAS)
+    u, _, _ = s.state_global()
+    np.testing.assert_allclose(u, u_ref, rtol=1e-5,
+                               atol=1e-7 * np.abs(u_ref).max())
+
+
+def test_newmark_hybrid_octree():
+    model = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3,
+                              load="traction", load_value=1.0)
+    dt = 0.1
+    s = NewmarkSolver(model, _cfg(), mesh=make_mesh(2), n_parts=2, dt=dt)
+    assert s.backend == "hybrid"
+    for r in s.run(DELTAS):
+        assert r.flag == 0
+    u_ref, _, _ = dense_newmark(model, dt, DELTAS)
+    u, _, _ = s.state_global()
+    np.testing.assert_allclose(u, u_ref, rtol=1e-6,
+                               atol=1e-8 * np.abs(u_ref).max())
+
+
+def test_newmark_unconditional_stability():
+    """Average-acceleration Newmark at 50x the explicit CFL dt: bounded
+    response (the explicit integrator diverges immediately at this dt)."""
+    from pcg_mpi_solver_tpu.solver.dynamics import stable_dt
+
+    model = make_cube_model(3, 3, 3)
+    dt = 50.0 * stable_dt(model)
+    s = NewmarkSolver(model, _cfg(tol=1e-10), mesh=make_mesh(2), n_parts=2,
+                      dt=dt)
+    results = s.run([1.0] * 20)
+    assert all(r.flag == 0 for r in results)
+    u, v, w = s.state_global()
+    # static displacement scale for this load
+    assert np.abs(u).max() < 1e3 * (np.abs(model.F).max() / model.ck.min())
+    assert np.isfinite(v).all() and np.isfinite(w).all()
