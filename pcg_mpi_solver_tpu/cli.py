@@ -143,7 +143,18 @@ def cmd_demo(args):
     cfg = _load_settings(args.settings, args)
     cfg.scratch_path = args.scratch
     cfg.time_history.export_vars = "U D ES PS PE"
-    if args.octree:
+    vtk_vars, vtk_mode = ["U", "PS1", "PS3", "ES"], "Full"
+    if getattr(args, "poisson", False):
+        from pcg_mpi_solver_tpu.models.synthetic import make_poisson_model
+
+        cfg.model_name = "demo_poisson"
+        cfg.time_history.export_vars = "U"      # scalar class: U only
+        vtk_vars, vtk_mode = ["U"], "Boundary"
+        model = make_poisson_model(args.nx, args.ny or 0, args.nz or 0,
+                                   heterogeneous=True, seed=1)
+        print(f">demo poisson: {model.n_elem} elems / {model.n_dof} dofs "
+              "(scalar diffusion)")
+    elif args.octree:
         from pcg_mpi_solver_tpu.models.octree import make_octree_model
 
         cfg.model_name = "demo_octree"
@@ -165,7 +176,7 @@ def cmd_demo(args):
     for t, r in enumerate(res, 1):
         print(f">step {t}: flag={r.flag} iters={r.iters} relres={r.relres:.3e} "
               f"wall={r.wall_s:.2f}s  [{s.backend} backend]")
-    files = export_vtk(model, store, ["U", "PS1", "PS3", "ES"], "Full")
+    files = export_vtk(model, store, vtk_vars, vtk_mode)
     print(f">wrote {len(files)} vtu files to {store.vtk_path}")
     print(">success!")
 
@@ -239,12 +250,16 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default="mixed")
+    p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
     p.add_argument("--octree", action="store_true",
                    help="graded octree model with transition pattern types "
                         "(nx/ny/nz = base cells; solved on the hybrid "
                         "level-grid backend)")
     p.add_argument("--max-level", type=int, default=2,
                    help="octree refinement levels (with --octree)")
+    p.add_argument("--poisson", action="store_true",
+                   help="scalar Poisson/diffusion model (1 dof per node, "
+                        "heterogeneous conductivity)")
     p.set_defaults(fn=cmd_demo)
 
     p = sub.add_parser("bench", help="benchmark harness (prints one JSON line)")

@@ -1,4 +1,4 @@
 from pcg_mpi_solver_tpu.models.model_data import ModelData
-from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model, make_poisson_model
 
-__all__ = ["ModelData", "make_cube_model"]
+__all__ = ["ModelData", "make_cube_model", "make_poisson_model"]

@@ -20,6 +20,28 @@ from pcg_mpi_solver_tpu.models.element import unit_element_library
 from pcg_mpi_solver_tpu.models.model_data import ModelData
 
 
+def _structured_hex_mesh(nx, ny, nz, h):
+    """Structured-grid nodes + VTK-hex connectivity, shared by the cube
+    (elasticity) and Poisson generators: returns (nid, coords (n_node, 3),
+    conn (n_elem, 8)); node id = ix + nnx*(iy + nny*iz), x fastest."""
+    nnx, nny = nx + 1, ny + 1
+    n_node = nnx * nny * (nz + 1)
+    nid = np.arange(n_node)
+    cx = (nid % nnx) * h
+    cy = ((nid // nnx) % nny) * h
+    cz = (nid // (nnx * nny)) * h
+    coords = np.stack([cx, cy, cz], axis=1)
+    ex, ey, ez = np.meshgrid(np.arange(nx), np.arange(ny), np.arange(nz),
+                             indexing="ij")
+    ex = ex.ravel(order="F"); ey = ey.ravel(order="F"); ez = ez.ravel(order="F")
+    n0 = ex + nnx * (ey + nny * ez)
+    conn = np.stack(
+        [n0, n0 + 1, n0 + 1 + nnx, n0 + nnx,
+         n0 + nnx * nny, n0 + 1 + nnx * nny,
+         n0 + 1 + nnx + nnx * nny, n0 + nnx + nnx * nny], axis=1)
+    return nid, coords, conn
+
+
 def make_cube_model(
     nx: int,
     ny: int = 0,
@@ -52,30 +74,8 @@ def make_cube_model(
     n_node = nnx * nny * nnz
     n_dof = 3 * n_node
 
-    # Node coordinates, x fastest (node id = ix + nnx*(iy + nny*iz)).
-    nid = np.arange(n_node)
-    cx = (nid % nnx) * h
-    cy = ((nid // nnx) % nny) * h
-    cz = (nid // (nnx * nny)) * h
-    coords = np.stack([cx, cy, cz], axis=1)
-
-    # Connectivity in VTK hex order.
-    ex, ey, ez = np.meshgrid(np.arange(nx), np.arange(ny), np.arange(nz), indexing="ij")
-    ex = ex.ravel(order="F"); ey = ey.ravel(order="F"); ez = ez.ravel(order="F")
-    n0 = ex + nnx * (ey + nny * ez)
-    conn = np.stack(
-        [
-            n0,
-            n0 + 1,
-            n0 + 1 + nnx,
-            n0 + nnx,
-            n0 + nnx * nny,
-            n0 + 1 + nnx * nny,
-            n0 + 1 + nnx + nnx * nny,
-            n0 + nnx + nnx * nny,
-        ],
-        axis=1,
-    )  # (n_elem, 8)
+    nid, coords, conn = _structured_hex_mesh(nx, ny, nz, h)
+    cx = coords[:, 0]
 
     dofs = (3 * conn[:, :, None] + np.arange(3)[None, None, :]).reshape(n_elem, 24)
 
@@ -319,3 +319,119 @@ def _boundary_quads(nx, ny, nz, nnx, nny) -> np.ndarray:
         quads.append(np.stack([grid_id(I, J, k), grid_id(I + 1, J, k),
                                grid_id(I + 1, J + 1, k), grid_id(I, J + 1, k)], axis=1))
     return np.concatenate(quads, axis=0)
+
+
+def make_poisson_model(
+    nx: int,
+    ny: int = 0,
+    nz: int = 0,
+    h: float = 1.0,
+    k: float = 1.0,
+    source: float = 1.0,
+    load: str = "source",
+    load_value: float = 1.0,
+    heterogeneous: bool = False,
+    seed: int = 0,
+) -> ModelData:
+    """Structured hex mesh of a SCALAR diffusion (Poisson) problem —
+    the framework's second problem class (BASELINE.json config 2: "3D
+    Poisson ... on structured cube, Jacobi-PCG"): 1 dof per node, d=8
+    trilinear elements, same pattern-type machinery (Ck = k*h).
+
+    - u = 0 on the x=0 face.
+    - ``load='source'``: uniform volumetric source f (consistent nodal
+      load F_i = f * sum_e h^3 (Me_unit . 1)_i).
+    - ``load='dirichlet'``: u = load_value prescribed on the x=L face.
+    - ``heterogeneous``: two-phase conductivity (10x k, seeded).
+
+    Runs on the general matvec backend (the flat-scatter path: the
+    node-ELL/structured/hybrid fast paths assume 3 dofs per node).
+    """
+    from pcg_mpi_solver_tpu.models.element import scalar_element_library
+
+    ny = ny or nx
+    nz = nz or nx
+    n_elem = nx * ny * nz
+    nnx, nny, nnz = nx + 1, ny + 1, nz + 1
+    n_node = nnx * nny * nnz
+    n_dof = n_node                      # 1 dof per node
+
+    nid, coords, conn = _structured_hex_mesh(nx, ny, nz, h)
+    cx = coords[:, 0]
+    centers = coords[conn].mean(axis=1)
+
+    if heterogeneous:
+        rng = np.random.default_rng(seed)
+        phase = rng.random(n_elem) < 0.2
+        k_elem = np.where(phase, 10.0 * k, k)
+        mat = phase.astype(np.int32)
+        mat_prop = [
+            {"E": k, "Pos": 0.0, "Rho": 1.0,
+             "NonLocStressParam": {"Lc": 2.0 * h}},
+            {"E": 10.0 * k, "Pos": 0.0, "Rho": 1.0,
+             "NonLocStressParam": {"Lc": 2.0 * h}},
+        ]
+    else:
+        k_elem = np.full(n_elem, k)
+        mat = np.zeros(n_elem, dtype=np.int32)
+        mat_prop = [{"E": k, "Pos": 0.0, "Rho": 1.0,
+                     "NonLocStressParam": {"Lc": 2.0 * h}}]
+
+    lib0 = scalar_element_library()
+    me_rowsum = lib0["Me"].sum(axis=1)  # ∫ N_i dV on the unit cell
+
+    ck = k_elem * h
+    cm = np.full(n_elem, h**3)
+    ce = np.full(n_elem, 1.0 / h)
+
+    diag_M = np.bincount(conn.ravel(),
+                         weights=(cm[:, None] * me_rowsum[None, :]).ravel(),
+                         minlength=n_dof)
+
+    F = np.zeros(n_dof)
+    Ud = np.zeros(n_dof)
+    fixed = nid[cx == 0.0]
+    if load == "source":
+        F = source * diag_M.copy()      # f * ∫ N_i dV (same row sums)
+    elif load == "dirichlet":
+        xL = nid[cx == nx * h]
+        Ud[xL] = load_value
+        fixed = np.concatenate([fixed, xL])
+    else:
+        raise ValueError(f"unknown load mode {load!r}")
+    fixed = np.unique(fixed)
+    F[fixed] = 0.0
+    dof_eff = np.setdiff1d(np.arange(n_dof), fixed, assume_unique=True)
+
+    faces = _boundary_quads(nx, ny, nz, nnx, nny)
+
+    return ModelData(
+        n_elem=n_elem,
+        n_node=n_node,
+        n_dof=n_dof,
+        node_coords=coords,
+        F=F,
+        Ud=Ud,
+        Vd=np.zeros(n_dof),
+        diag_M=diag_M,
+        fixed_dof=fixed,
+        dof_eff=dof_eff,
+        elem_type=np.zeros(n_elem, dtype=np.int32),
+        elem_nodes_flat=conn.ravel(),
+        elem_nodes_offset=np.arange(n_elem + 1) * 8,
+        elem_dofs_flat=conn.ravel().copy(),
+        elem_dofs_offset=np.arange(n_elem + 1) * 8,
+        elem_sign_flat=np.zeros(n_elem * 8, dtype=bool),
+        ck=ck,
+        cm=cm,
+        ce=ce,
+        level=np.full(n_elem, h),
+        poly_mat=mat,
+        sctrs=centers,
+        elem_lib={0: lib0},
+        mat_prop=mat_prop,
+        dt=1.0,
+        faces_flat=faces.ravel(),
+        faces_offset=np.arange(len(faces) + 1) * 4,
+        grid=None,
+    )

@@ -360,6 +360,10 @@ class Ops:
         component-major grid layout)."""
         return v.reshape(v.shape[0], self.n_node_loc, 3)
 
+    def _from_node3(self, z3: jnp.ndarray) -> jnp.ndarray:
+        """Inverse of :meth:`_as_node3`: (P, n_node_loc, 3) -> (P, n_loc)."""
+        return z3.reshape(z3.shape[0], self.n_loc)
+
     def block_precond(self, data: dict) -> jnp.ndarray:
         """Inverted eff-masked node blocks, ready for ``apply_prec``."""
         from pcg_mpi_solver_tpu.ops.precond import invert_node_blocks
@@ -369,12 +373,13 @@ class Ops:
 
     def apply_prec(self, m: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
         """z = M^-1 r: elementwise for the scalar Jacobi inverse (ndim 2),
-        batched 3x3 block multiply for the block-Jacobi inverse (ndim 4)."""
+        batched 3x3 block multiply for the block-Jacobi inverse (ndim 4);
+        backend dof layouts differ only through _as_node3/_from_node3."""
         if m.ndim == 2:
             return m * r
         z3 = jnp.einsum("pnij,pnj->pni", m, self._as_node3(r),
                         precision=self.precision)
-        return z3.reshape(r.shape)
+        return self._from_node3(z3)
 
     def _scatter(self, data: dict, flat: jnp.ndarray) -> jnp.ndarray:
         """(P, NC) element-dof values -> (P, n_loc) via sorted segment_sum."""

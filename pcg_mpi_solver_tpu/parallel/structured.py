@@ -357,12 +357,8 @@ class StructuredOps(Ops):
         # structured dof layout is component-major: (P, 3, nodes)
         return v.reshape(v.shape[0], 3, self.n_node_loc).transpose(0, 2, 1)
 
-    def apply_prec(self, m, r):
-        if m.ndim == 2:
-            return m * r
-        z3 = jnp.einsum("pnij,pnj->pni", m, self._as_node3(r),
-                        precision=self.precision)
-        return z3.transpose(0, 2, 1).reshape(r.shape)
+    def _from_node3(self, z3):
+        return z3.transpose(0, 2, 1).reshape(z3.shape[0], self.n_loc)
 
     def iface_assemble(self, data, y):
         return self._halo(self._grid(y)).reshape(y.shape)

@@ -601,6 +601,15 @@ class Solver:
         deltas = th.time_step_delta
         do_export = store is not None and th.export_flag and not self.config.speed_test
         do_plot = store is not None and th.plot_flag and not self.config.speed_test
+        if do_export and self._model.n_dof == self._model.n_node:
+            bad = self._nodal_vars()            # includes NS
+            if bad:
+                # Scalar (Poisson) class: the strain/stress/nonlocal export
+                # pipelines statically unpack 6 Voigt components — fail
+                # loudly up front, not mid-solve with a shape error.
+                raise ValueError(
+                    f"export vars {bad} (strain/stress nodal fields) are "
+                    "not available for the scalar problem class; export 'U'")
 
         ckpt_mgr = None
         t_start = 1
@@ -750,6 +759,13 @@ class Solver:
             from pcg_mpi_solver_tpu.ops.stress import nodal_export_fields
 
             nodal = tuple(v for v in self._nodal_vars() if v != "NS")
+            if self._model.n_dof == self._model.n_node:
+                # Scalar (Poisson) class: the strain/stress pipeline
+                # statically unpacks 6 Voigt components — fail loudly like
+                # the block3 layout guard, not with an IndexError at trace.
+                raise ValueError(
+                    f"export vars {nodal} (strain/stress nodal fields) are "
+                    "not available for the scalar problem class; export 'U'")
 
             def _fields(data, un):
                 data64 = data["f64"] if self.mixed else data
@@ -926,9 +942,10 @@ class Solver:
 
     def displacement_global(self) -> np.ndarray:
         """Full global solution vector (n_dof,), assembled on host."""
-        out = np.zeros(self.pm.glob_n_dof, dtype=np.dtype(self.dtype))
-        out[self.export_dof_map()] = self.displacement_owned()
-        return out
+        from pcg_mpi_solver_tpu.parallel.distributed import gather_owned_global
+
+        return gather_owned_global(self.pm, self.un, self.mesh,
+                                   np.dtype(self.dtype))
 
 
 _REPLICATED_KEYS = frozenset(

@@ -30,9 +30,7 @@ import numpy as np
 
 from pcg_mpi_solver_tpu.config import RunConfig
 from pcg_mpi_solver_tpu.models.model_data import ModelData
-from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
 from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
-from pcg_mpi_solver_tpu.parallel.partition import partition_model
 from pcg_mpi_solver_tpu.solver.driver import _data_specs
 
 
@@ -86,38 +84,18 @@ class DynamicsSolver:
 
         # Backend: the hybrid level-grid path serves octree models' matvec
         # (the per-step hot op) exactly as in the quasi-static driver;
-        # everything else stays on the general path.
-        from pcg_mpi_solver_tpu.parallel.hybrid import can_hybrid
+        # everything else stays on the general path.  Pallas only ever
+        # dispatches on f32 matvecs; dynamics has no mixed-precision f32
+        # shadow, so the probe is skipped in f64 runs.
+        from pcg_mpi_solver_tpu.solver.backends import select_time_backend
 
-        if backend not in ("auto", "hybrid", "general"):
-            raise ValueError(f"backend must be 'auto'|'hybrid'|'general', "
-                             f"got {backend!r}")
-        if backend == "hybrid" and not can_hybrid(model):
-            raise ValueError("hybrid backend requested but model has no "
-                             "octree/brick metadata")
-        if backend in ("auto", "hybrid") and can_hybrid(model):
-            from pcg_mpi_solver_tpu.parallel.hybrid import (
-                HybridOps, device_data_hybrid, hybrid_pallas_enabled,
-                partition_hybrid)
-
-            self.backend = "hybrid"
-            self.pm = partition_hybrid(model, n_parts,
-                                       method=self.config.partition_method)
-            # Pallas only ever dispatches on f32 matvecs; dynamics has no
-            # mixed-precision f32 shadow, so skip the probe in f64 runs.
-            use_pallas = (dtype == jnp.float32 and hybrid_pallas_enabled(
-                self.pm, self.config.solver.pallas, self.mesh))
-            self.ops = HybridOps.from_hybrid(self.pm, dot_dtype=dtype,
-                                             axis_name=PARTS_AXIS,
-                                             use_pallas=use_pallas)
-            data = device_data_hybrid(self.pm, dtype)
-        else:
-            self.backend = "general"
-            self.pm = partition_model(model, n_parts,
-                                      method=self.config.partition_method)
-            self.ops = Ops.from_model(self.pm, dot_dtype=dtype,
-                                      axis_name=PARTS_AXIS)
-            data = device_data(self.pm, dtype)
+        self.backend, self.pm, mk_ops, mk_data = select_time_backend(
+            model, n_parts,
+            partition_method=self.config.partition_method,
+            pallas_mode=self.config.solver.pallas, mesh=self.mesh,
+            kernels_f32=dtype == jnp.float32, backend=backend)
+        self.ops = mk_ops(dtype)
+        data = mk_data(dtype)
         # Assembled lumped-mass diagonal: model.diag_M is already the global
         # assembled diagonal, sliced per part (partition extract_NodalVectors
         # analogue) — no cross-part assembly needed.

@@ -40,9 +40,8 @@ import numpy as np
 
 from pcg_mpi_solver_tpu.config import RunConfig
 from pcg_mpi_solver_tpu.models.model_data import ModelData
-from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+from pcg_mpi_solver_tpu.ops.matvec import Ops
 from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
-from pcg_mpi_solver_tpu.parallel.partition import partition_model
 from pcg_mpi_solver_tpu.solver.driver import StepResult, _data_specs
 from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_mixed
 
@@ -144,36 +143,15 @@ class NewmarkSolver:
                 jax.config.update("jax_enable_x64", True)
         self.dtype = dtype
 
-        from pcg_mpi_solver_tpu.parallel.hybrid import can_hybrid
+        from pcg_mpi_solver_tpu.solver.backends import select_time_backend
 
-        if backend not in ("auto", "hybrid", "general"):
-            raise ValueError(f"backend must be 'auto'|'hybrid'|'general', "
-                             f"got {backend!r}")
-        if backend == "hybrid" and not can_hybrid(model):
-            raise ValueError("hybrid backend requested but model has no "
-                             "octree/brick metadata")
-        if backend in ("auto", "hybrid") and can_hybrid(model):
-            from pcg_mpi_solver_tpu.parallel.hybrid import (
-                HybridOps, device_data_hybrid, hybrid_pallas_enabled,
-                partition_hybrid)
-
-            self.backend = "hybrid"
-            self.pm = partition_hybrid(model, n_parts,
-                                       method=self.config.partition_method)
-            use_pallas = ((self.mixed or dtype == jnp.float32)
-                          and hybrid_pallas_enabled(self.pm, scfg.pallas,
-                                                    self.mesh))
-            mk_ops = lambda dd: HybridOps.from_hybrid(
-                self.pm, dot_dtype=dd, axis_name=PARTS_AXIS,
-                use_pallas=use_pallas)
-            data = device_data_hybrid(self.pm, dtype)
-        else:
-            self.backend = "general"
-            self.pm = partition_model(model, n_parts,
-                                      method=self.config.partition_method)
-            mk_ops = lambda dd: Ops.from_model(self.pm, dot_dtype=dd,
-                                               axis_name=PARTS_AXIS)
-            data = device_data(self.pm, dtype)
+        self.backend, self.pm, mk_ops, mk_data = select_time_backend(
+            model, n_parts,
+            partition_method=self.config.partition_method,
+            pallas_mode=scfg.pallas, mesh=self.mesh,
+            kernels_f32=self.mixed or dtype == jnp.float32,
+            backend=backend)
+        data = mk_data(dtype)
 
         # Newmark coefficients (a-form)
         dt_, b, g = self.dt, self.beta, self.gamma
@@ -189,12 +167,11 @@ class NewmarkSolver:
         self.ops = MassShiftedOps(base_ops, cshift)
 
         # Assembled lumped-mass diagonal, per-part (reference DiagM,
-        # partition_mesh.py:324-330); reconstructed from the stored inverse
-        # (zero-mass dofs stay 0: A = K there, still SPD).
-        inv_m = self.pm.inv_diag_M
-        diag_m = np.where(inv_m > 0, 1.0 / np.where(inv_m > 0, inv_m, 1.0), 0.0)
-        data["diag_M"] = jnp.asarray(diag_m, dtype)
+        # partition_mesh.py:324-330), gathered exactly — bitwise equal to
+        # the model's M (zero-mass dofs stay 0: A = K there, still SPD).
         gid = self.pm.dof_gid
+        data["diag_M"] = jnp.asarray(
+            np.where(gid >= 0, model.diag_M[np.maximum(gid, 0)], 0.0), dtype)
         data["Vd"] = jnp.asarray(
             np.where(gid >= 0, model.Vd[np.maximum(gid, 0)], 0.0), dtype)
 

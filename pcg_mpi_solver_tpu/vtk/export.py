@@ -144,9 +144,13 @@ def _write_frame(args):
     for var in export_vars:
         if var == "U":
             a = global_dof_frame(store, model, i, dof_map)
-            point_data["U"] = (np.ascontiguousarray(a[0::3]),
-                               np.ascontiguousarray(a[1::3]),
-                               np.ascontiguousarray(a[2::3]))
+            if model.n_dof == model.n_node:
+                # scalar problem class (Poisson): U is one value per node
+                point_data["U"] = a
+            else:
+                point_data["U"] = (np.ascontiguousarray(a[0::3]),
+                                   np.ascontiguousarray(a[1::3]),
+                                   np.ascontiguousarray(a[2::3]))
         elif var in SCALAR_VARS:
             point_data[var] = global_nodal_frame(store, model, var, i,
                                                  node_map)

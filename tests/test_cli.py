@@ -45,6 +45,13 @@ def test_cli_demo(tmp_path, capsys):
     assert ">success!" in out and "flag=0" in out
 
 
+def test_cli_poisson_demo(tmp_path, capsys):
+    main(["demo", "--poisson", "--nx", "4", "--scratch", str(tmp_path / "s"),
+          "--tol", "1e-8", "--precision", "direct"])
+    out = capsys.readouterr().out
+    assert ">success!" in out and "flag=0" in out and "scalar" in out
+
+
 def test_cli_speed_test_no_exports(tmp_path, capsys):
     model = make_cube_model(4, 4, 4)
     src = tmp_path / "src"

@@ -1,0 +1,155 @@
+"""Scalar Poisson/diffusion problem class (BASELINE.json config 2): the
+general matvec/PCG machinery at 1 dof per node, d=8 type blocks — proving
+the framework is not hardwired to 3-dof elasticity."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.config import RunConfig, SolverConfig, TimeHistoryConfig
+from pcg_mpi_solver_tpu.models.synthetic import make_poisson_model
+from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+from pcg_mpi_solver_tpu.parallel.partition import partition_model
+from pcg_mpi_solver_tpu.solver.driver import Solver
+
+from tests.test_matvec import global_to_parts, parts_to_global
+
+
+def test_laplacian_element_matrix():
+    """Rigid (constant) mode is in the kernel; row sums vanish; SPD on the
+    complement."""
+    from pcg_mpi_solver_tpu.models.element import hex_laplacian
+
+    Ke = hex_laplacian(h=1.0, k=1.0)
+    assert Ke.shape == (8, 8)
+    np.testing.assert_allclose(Ke @ np.ones(8), 0.0, atol=1e-14)
+    np.testing.assert_allclose(Ke, Ke.T, atol=1e-14)
+    w = np.linalg.eigvalsh(Ke)
+    assert w[0] > -1e-14 and w[1] > 1e-6      # one zero mode, rest positive
+
+
+@pytest.mark.parametrize("n_parts,hetero", [(1, False), (4, True)])
+def test_poisson_matvec_vs_dense(n_parts, hetero):
+    model = make_poisson_model(4, 3, 3, h=0.5, heterogeneous=hetero, seed=2)
+    pm = partition_model(model, n_parts)
+    assert pm.ell is None                     # 1 dof/node -> flat path
+    data = device_data(pm)
+    ops = Ops.from_model(pm)
+    x = np.random.default_rng(1).normal(size=model.n_dof)
+    y = ops.matvec(data, jnp.asarray(global_to_parts(pm, x)))
+    np.testing.assert_allclose(parts_to_global(pm, y),
+                               model.assemble_csr() @ x,
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_poisson_pcg_vs_scipy():
+    import scipy.sparse.linalg as spla
+
+    model = make_poisson_model(5, 4, 4, heterogeneous=True, seed=3)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-10, max_iter=2000),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(4), n_parts=4)
+    assert s.backend == "general"
+    res = s.step(1.0)
+    assert res.flag == 0 and res.relres <= 1e-10
+    u = s.displacement_global()
+
+    K = model.assemble_csr().tocsc()
+    free = model.dof_eff
+    u_ref = np.zeros(model.n_dof)
+    u_ref[free] = spla.spsolve(K[np.ix_(free, free)], model.F[free])
+    np.testing.assert_allclose(u, u_ref, rtol=1e-7,
+                               atol=1e-10 * np.abs(u_ref).max())
+
+
+def test_poisson_partition_count_parity():
+    model = make_poisson_model(4, 4, 4, heterogeneous=True, seed=1)
+    runs = {}
+    for n_parts in (1, 8):
+        cfg = RunConfig(
+            solver=SolverConfig(tol=1e-9, max_iter=2000),
+            time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+        )
+        s = Solver(model, cfg, mesh=make_mesh(n_parts), n_parts=n_parts)
+        res = s.step(1.0)
+        assert res.flag == 0
+        runs[n_parts] = (res.iters, s.displacement_global())
+    assert abs(runs[8][0] - runs[1][0]) <= 1
+    np.testing.assert_allclose(runs[8][1], runs[1][1], rtol=1e-7,
+                               atol=1e-10 * np.abs(runs[1][1]).max())
+
+
+def test_poisson_dirichlet_physics():
+    """k uniform, u(0)=0, u(L)=1, no source: the solution is the linear
+    ramp u = x/L (exact for trilinear elements)."""
+    model = make_poisson_model(5, 3, 3, h=1.0, load="dirichlet",
+                               load_value=1.0, source=0.0)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-12, max_iter=2000),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(2), n_parts=2)
+    res = s.step(1.0)
+    assert res.flag == 0
+    u = s.displacement_global()
+    np.testing.assert_allclose(u, model.node_coords[:, 0] / 5.0, atol=1e-9)
+
+
+def test_poisson_solve_and_vtk_export(tmp_path):
+    """Full pipeline on the scalar class: solve with frame exports, then
+    write .vtu files (U exported as a scalar point field)."""
+    from pcg_mpi_solver_tpu.utils.io import RunStore
+    from pcg_mpi_solver_tpu.vtk.export import export_vtk
+    from pcg_mpi_solver_tpu.vtk.writer import read_vtu_arrays
+
+    model = make_poisson_model(4, 3, 3, heterogeneous=True, seed=5)
+    cfg = RunConfig(
+        scratch_path=str(tmp_path), run_id="poisson",
+        solver=SolverConfig(tol=1e-9, max_iter=2000),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(2), n_parts=2)
+    store = RunStore(cfg.result_path, cfg.model_name)
+    results = s.solve(store=store)
+    assert results[0].flag == 0
+    files = export_vtk(model, store, export_vars=("U",), mode="Boundary")
+    assert files
+    arrays = read_vtu_arrays(files[-1])
+    assert arrays["U"].shape == (model.n_node,)
+    np.testing.assert_allclose(
+        np.sort(arrays["U"]), np.sort(s.displacement_global()), atol=1e-12)
+
+
+def test_poisson_strain_export_rejected(tmp_path):
+    """Strain/stress export vars statically unpack 6 Voigt components;
+    the scalar class must fail loudly, like the block3 layout guard."""
+    from pcg_mpi_solver_tpu.utils.io import RunStore
+
+    model = make_poisson_model(3, 3, 3)
+    for bad_vars in ("U ES", "U NS"):
+        cfg = RunConfig(
+            scratch_path=str(tmp_path), run_id="p2",
+            solver=SolverConfig(tol=1e-8, max_iter=500),
+            time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0],
+                                           export_vars=bad_vars),
+        )
+        s = Solver(model, cfg, mesh=make_mesh(2), n_parts=2)
+        store = RunStore(cfg.result_path, cfg.model_name)
+        with pytest.raises(ValueError, match="scalar problem class"):
+            s.solve(store=store)
+
+
+def test_poisson_block3_rejected():
+    """block3 needs the 3-dof node layout; the scalar class must fail
+    loudly, not silently misapply a 3x3 block structure."""
+    model = make_poisson_model(3, 3, 3)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-8, precond="block3"),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(2), n_parts=2)
+    with pytest.raises(ValueError, match="node-contiguous"):
+        s.step(1.0)
