@@ -211,6 +211,14 @@ def read_mdf(mdf_path: str) -> ModelData:
 
 def write_mdf(model: ModelData, mdf_path: str) -> str:
     """Write a ModelData in the reference's MDF schema."""
+    if model.n_dof != 3 * model.n_node:
+        # The MDF schema is the reference's 3-dof elasticity format
+        # (NodeCoordVec etc. interleave 3 components per node,
+        # partition_mesh.py:172-175) — it cannot carry the scalar class.
+        raise ValueError(
+            "the MDF schema is 3-dof-per-node (reference elasticity "
+            "format); scalar (Poisson) models cannot be written — keep "
+            "them as in-memory/synthetic models")
     os.makedirs(mdf_path, exist_ok=True)
     p = lambda name: os.path.join(mdf_path, name)
 

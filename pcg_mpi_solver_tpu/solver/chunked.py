@@ -1,0 +1,225 @@
+"""Dispatch-chunked PCG execution, shared by solvers.
+
+A single device dispatch that runs for minutes can trip execution
+watchdogs on remote/tunneled TPUs (docs/RUNBOOK.md); above ~4M dofs the
+solvers split a solve into host-driven dispatches of at most ``cap``
+Krylov iterations, with all state resident on device between calls.  The
+Krylov recurrence is resumable (solver/pcg.py ``carry_in``), so N capped
+dispatches are iteration-for-iteration identical to one long solve in
+direct mode, and chunk boundaries align with refinement cycles in mixed
+mode.
+
+This module owns everything AFTER the per-solver start step (which
+differs: Dirichlet lifting for the quasi-static driver, the Newmark
+history term for the implicit dynamics solver): the jitted cycle/refine/
+finalize programs and the host-side budget loop.  Used by
+``solver/driver.py`` and ``solver/newmark.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from pcg_mpi_solver_tpu.solver.pcg import (
+    carry_part_specs, cold_carry, pcg, refine_tol, select_best)
+
+
+class ChunkedEngine:
+    """Capped-dispatch budget loop over a resumable PCG.
+
+    ``ops``/``ops32`` follow the Ops protocol (the Newmark solver passes
+    mass-shifted wrappers).  In mixed mode ``data`` is the
+    ``{"f64": ..., "f32": ...}`` pytree and the preconditioner inverse is
+    f32; in direct mode ``data`` is the flat pytree and the inverse
+    matches the solve dtype.  The preconditioner is built once per step
+    by the caller and passed into :meth:`run`.
+    """
+
+    def __init__(self, *, mesh, data_specs, part_spec, rep_spec, ops,
+                 scfg, glob_n_dof_eff: int, cap: int, mixed: bool,
+                 ops32=None):
+        self.mixed = mixed
+        self.scfg = scfg
+        cap = int(cap)
+        P, R = part_spec, rep_spec
+        carry_specs = carry_part_specs(P, R)
+
+        def smap(f, in_specs, out_specs):
+            return jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False))
+
+        if mixed:
+            # Three jitted pieces so the f32 Krylov state survives dispatch
+            # boundaries WITHIN a refinement cycle (restarting CG at every
+            # dispatch loses superlinear convergence):
+            #   inner_start: normalize the f64 residual, cold f32 carry +
+            #                adaptive cycle tolerance;
+            #   inner_cycle: resumable capped f32 PCG dispatch;
+            #   refine:      f64 solution update + true-residual recompute.
+            dd32 = jnp.float32
+
+            def _inner_start(data, r, normr, n2b):
+                tol_cycle = refine_tol(scfg.tol * n2b, normr, scfg.inner_tol)
+                rhat32 = (r / normr).astype(dd32)
+                # ||rhat||_w = ||r||_w / normr = 1 exactly; no matvec needed.
+                one = jnp.asarray(1.0, ops32.dot_dtype)
+                carry0 = cold_carry(jnp.zeros_like(rhat32), rhat32, one,
+                                    ops32.dot_dtype)
+                return rhat32, tol_cycle, carry0
+
+            self._inner_start_fn = smap(
+                _inner_start, (data_specs, P, R, R), (P, R, carry_specs))
+
+            def _inner_cycle(data, rhat32, prec32, tol_cycle, carry32,
+                             budget):
+                res, carry2 = pcg(
+                    ops32, data["f32"], rhat32, carry32["x"], prec32,
+                    tol=tol_cycle,
+                    max_iter=jnp.minimum(cap, budget),
+                    glob_n_dof_eff=glob_n_dof_eff,
+                    max_stag_steps=scfg.max_stag_steps,
+                    max_iter_nominal=scfg.max_iter,
+                    carry_in=carry32, return_carry=True)
+                return res.x, carry2, res.flag
+
+            self._inner_cycle_fn = smap(
+                _inner_cycle, (data_specs, P, P, R, carry_specs, R),
+                (P, carry_specs, R))
+
+            def _refine(data, fext, x, xinc32, scale):
+                data64 = data["f64"]
+                eff = data64["eff"]
+                w = data64["weight"] * eff
+                x2 = x + xinc32.astype(x.dtype) * scale
+                r2 = fext - eff * ops.matvec(data64, x2)
+                normr2 = jnp.sqrt(ops.wdot(w, r2, r2))
+                return x2, r2, normr2
+
+            self._refine_fn = smap(
+                _refine, (data_specs, P, P, P, R), (P, P, R))
+
+            def _final32(data, rhat32, carry32):
+                """f32 min-residual selection when an inner solve fails
+                (matches the one-shot pcg_mixed's finalize_bad)."""
+                x, _ = select_best(ops32, data["f32"], rhat32, carry32)
+                return x
+
+            self._final32_fn = smap(
+                _final32, (data_specs, P, carry_specs), P)
+        else:
+            def _cycle(data, fext, inv_diag, carry, budget):
+                # Resumable call: the Krylov recurrence continues across
+                # dispatch boundaries, so N capped dispatches are iteration-
+                # for-iteration identical to one long solve.
+                res, carry2 = pcg(
+                    ops, data, fext, carry["x"], inv_diag,
+                    tol=scfg.tol,
+                    max_iter=jnp.minimum(cap, budget),
+                    glob_n_dof_eff=glob_n_dof_eff,
+                    max_stag_steps=scfg.max_stag_steps,
+                    max_iter_nominal=scfg.max_iter,
+                    carry_in=carry, return_carry=True)
+                return res.x, carry2, res.flag, res.relres
+
+            self._cycle_fn = smap(
+                _cycle, (data_specs, P, P, carry_specs, R),
+                (P, carry_specs, R, R))
+
+            def _final(data, fext, carry):
+                """Min-residual selection at terminal failure (once/step)."""
+                return select_best(ops, data, fext, carry)
+
+            self._final_fn = smap(
+                _final, (data_specs, P, carry_specs), (P, R))
+
+    def run(self, data, fext, carry, normr0, n2b, prec,
+            vlog: Optional[Callable[[str], None]] = None):
+        """Budget loop from a prepared start state to termination.
+
+        ``carry``: cold carry at the start iterate (``cold_carry``);
+        ``prec``: preconditioner inverse (f32 in mixed mode, solve dtype in
+        direct mode).  Returns ``(x_fin, flag, relres, total_iters)``.
+        The caller handles the ``n2b == 0`` and already-converged early
+        exits (they need no dispatches).
+        """
+        scfg = self.scfg
+        vlog = vlog or (lambda s: None)
+        n2b_f = float(n2b)
+        tolb = scfg.tol * n2b_f
+        total, flag = 0, 1
+        cur = float(normr0)
+        relres = cur / n2b_f
+        x_fin = carry["x"]
+        if cur <= tolb:
+            return x_fin, 0, relres, 0
+        if self.mixed:
+            x, r, normr = carry["x"], carry["r"], normr0
+            stall = 0
+            while flag == 1 and total < scfg.max_iter:
+                prev = cur
+                # One refinement cycle: run the f32 inner solve to ITS
+                # convergence via resumable capped dispatches, then refine.
+                vlog(f"inner_start dispatch (normr={float(normr):.3e})")
+                rhat32, tol_cycle, c32 = self._inner_start_fn(
+                    data, r, normr, n2b)
+                inner_flag, xin = 1, None
+                while inner_flag == 1 and total < scfg.max_iter:
+                    budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
+                    vlog(f"inner_cycle dispatch (total={total})")
+                    xin, c32, iflag = self._inner_cycle_fn(
+                        data, rhat32, prec, tol_cycle, c32, budget)
+                    total += int(c32["exec"])
+                    inner_flag = int(iflag)
+                    vlog(f"inner_cycle done: +{int(c32['exec'])} iters "
+                         f"flag={inner_flag}")
+                if inner_flag != 0:
+                    # Failed/exhausted inner solve: min-residual selection
+                    # (the resumable path defers it; matches one-shot
+                    # pcg_mixed's inner finalize_bad).
+                    xin = self._final32_fn(data, rhat32, c32)
+                vlog("refine dispatch (f64 true-residual matvec)")
+                x, r, normr = self._refine_fn(data, fext, x, xin, normr)
+                cur = float(normr)
+                vlog(f"refine done: relres={cur / n2b_f:.3e} total={total}")
+                if cur <= tolb:
+                    flag = 0
+                elif inner_flag == 2:
+                    flag = 2
+                elif cur > 0.9 * prev:
+                    # no meaningful contraction over a whole refinement cycle
+                    stall += 1
+                    if stall >= 2:
+                        flag = 3
+                else:
+                    stall = 0
+            x_fin, relres = x, cur / n2b_f
+        else:
+            while flag == 1 and total < scfg.max_iter:
+                budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
+                x_fin, carry, cflag, crelres = self._cycle_fn(
+                    data, fext, prec, carry, budget)
+                total += int(carry["exec"])
+                flag = int(cflag)
+                relres = float(crelres)
+            if flag != 0:
+                # Terminal failure: the resumable path defers MATLAB pcg's
+                # min-residual fallback to here (once per step).
+                x_fin, relres_dev = self._final_fn(data, fext, carry)
+                relres = float(relres_dev)
+        return x_fin, flag, relres, total
+
+
+def auto_dispatch_cap(scfg, glob_n_dof: int, n_loc_dev: int) -> int:
+    """Resolve SolverConfig.iters_per_dispatch (-1 = auto: engage on large
+    problems, sized so one dispatch stays well under a minute)."""
+    cap = scfg.iters_per_dispatch
+    if cap < 0:
+        if glob_n_dof < 4_000_000:
+            cap = 0
+        else:
+            cap = max(200, int(45.0 / (4e-9 * max(n_loc_dev, 1))))
+    return int(cap)

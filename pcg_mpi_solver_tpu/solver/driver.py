@@ -290,18 +290,12 @@ class Solver:
         self._step_fn = jax.jit(shard_step)
 
         # ---- dispatch-chunked solve path (large problems) -----------------
-        # A single device dispatch that runs for minutes can trip execution
-        # watchdogs on remote/tunneled TPUs; above ~4M dofs the solve is
-        # split into host-driven dispatches of at most `cap` Krylov
-        # iterations, with all state resident on device between calls.
-        cap = solver_cfg.iters_per_dispatch
-        if cap < 0:
-            n_loc_dev = self.pm.n_loc * (self.pm.n_parts // n_dev)
-            if self.pm.glob_n_dof < 4_000_000:
-                cap = 0
-            else:
-                cap = max(200, int(45.0 / (4e-9 * max(n_loc_dev, 1))))
-        self._dispatch_cap = int(cap)
+        # (solver/chunked.py; auto-engaged above ~4M dofs)
+        from pcg_mpi_solver_tpu.solver.chunked import auto_dispatch_cap
+
+        self._dispatch_cap = auto_dispatch_cap(
+            solver_cfg, self.pm.glob_n_dof,
+            self.pm.n_loc * (self.pm.n_parts // n_dev))
         if self._dispatch_cap > 0:
             self._build_chunked(solver_cfg, glob_n_eff)
 
@@ -341,12 +335,11 @@ class Solver:
 
     # ------------------------------------------------------------------
     def _build_chunked(self, scfg, glob_n_eff):
-        """Jitted pieces of the dispatch-chunked solve (see __init__)."""
-        cap = self._dispatch_cap
+        """Jitted start step + the shared ChunkedEngine (see __init__)."""
         mixed = self.mixed
 
-        from pcg_mpi_solver_tpu.solver.pcg import (
-            carry_part_specs, cold_carry, refine_tol, select_best)
+        from pcg_mpi_solver_tpu.solver.chunked import ChunkedEngine
+        from pcg_mpi_solver_tpu.solver.pcg import carry_part_specs, cold_carry
 
         P, R = self._part_spec, self._rep_spec
         carry_specs = carry_part_specs(P, R)
@@ -362,197 +355,43 @@ class Solver:
             n2b = jnp.sqrt(self.ops.wdot(w, fext, fext))
             normr0 = jnp.sqrt(self.ops.wdot(w, r0, r0))
             carry0 = cold_carry(x0, r0, normr0, self.ops.dot_dtype)
+            # preconditioner rebuild once per step (not per dispatch /
+            # refinement cycle): f32 for the mixed inner solves.
             if mixed:
-                return udi, fext, carry0, normr0, n2b
-            # preconditioner rebuild once per step (not per dispatch).
-            inv_diag = self._make_prec(self.ops, data64)
-            return udi, fext, carry0, normr0, n2b, inv_diag
+                prec = self._make_prec(self.ops32, data["f32"])
+            else:
+                prec = self._make_prec(self.ops, data64)
+            return udi, fext, carry0, normr0, n2b, prec
 
-        start_out_specs = ((P, P, carry_specs, R, R) if mixed
-                           else (P, P, carry_specs, R, R, P))
         self._start_fn = jax.jit(jax.shard_map(
             _start, mesh=self.mesh,
             in_specs=(self._specs, P, R),
-            out_specs=start_out_specs, check_vma=False))
+            out_specs=(P, P, carry_specs, R, R, P), check_vma=False))
 
-        if not mixed:
-            def _final(data, fext, carry):
-                """Min-residual selection at terminal failure (once/step)."""
-                return select_best(self.ops, data, fext, carry)
-
-            self._final_fn = jax.jit(jax.shard_map(
-                _final, mesh=self.mesh,
-                in_specs=(self._specs, P, carry_specs),
-                out_specs=(P, R), check_vma=False))
-
-        if mixed:
-            # Three jitted pieces so the f32 Krylov state survives dispatch
-            # boundaries WITHIN a refinement cycle (restarting CG at every
-            # dispatch loses superlinear convergence):
-            #   inner_start: normalize the f64 residual, build a cold f32
-            #                carry + Jacobi inverse + adaptive cycle tol;
-            #   inner_cycle: resumable capped f32 PCG dispatch;
-            #   refine:      f64 solution update + true-residual recompute.
-            dd32 = jnp.float32
-
-            def _inner_start(data, r, normr, n2b):
-                data32 = data["f32"]
-                inv32 = self._make_prec(self.ops32, data32)
-                tol_cycle = refine_tol(scfg.tol * n2b, normr, scfg.inner_tol)
-                rhat32 = (r / normr).astype(dd32)
-                # ||rhat||_w = ||r||_w / normr = 1 exactly; no matvec needed.
-                one = jnp.asarray(1.0, self.ops32.dot_dtype)
-                carry0 = cold_carry(jnp.zeros_like(rhat32), rhat32, one,
-                                    self.ops32.dot_dtype)
-                return rhat32, inv32, tol_cycle, carry0
-
-            self._inner_start_fn = jax.jit(jax.shard_map(
-                _inner_start, mesh=self.mesh,
-                in_specs=(self._specs, P, R, R),
-                out_specs=(P, P, R, carry_specs), check_vma=False))
-
-            def _inner_cycle(data, rhat32, inv32, tol_cycle, carry32, budget):
-                res, carry2 = pcg(
-                    self.ops32, data["f32"], rhat32, carry32["x"], inv32,
-                    tol=tol_cycle,
-                    max_iter=jnp.minimum(cap, budget),
-                    glob_n_dof_eff=glob_n_eff,
-                    max_stag_steps=scfg.max_stag_steps,
-                    max_iter_nominal=scfg.max_iter,
-                    carry_in=carry32, return_carry=True)
-                return res.x, carry2, res.flag
-
-            self._inner_cycle_fn = jax.jit(jax.shard_map(
-                _inner_cycle, mesh=self.mesh,
-                in_specs=(self._specs, P, P, R, carry_specs, R),
-                out_specs=(P, carry_specs, R), check_vma=False))
-
-            def _refine(data, fext, x, xinc32, scale):
-                data64 = data["f64"]
-                eff = data64["eff"]
-                w = data64["weight"] * eff
-                x2 = x + xinc32.astype(x.dtype) * scale
-                r2 = fext - eff * self.ops.matvec(data64, x2)
-                normr2 = jnp.sqrt(self.ops.wdot(w, r2, r2))
-                return x2, r2, normr2
-
-            self._refine_fn = jax.jit(jax.shard_map(
-                _refine, mesh=self.mesh,
-                in_specs=(self._specs, P, P, P, R),
-                out_specs=(P, P, R), check_vma=False))
-
-            def _final32(data, rhat32, carry32):
-                """f32 min-residual selection when an inner solve fails
-                (matches the one-shot pcg_mixed's finalize_bad)."""
-                x, _ = select_best(self.ops32, data["f32"], rhat32, carry32)
-                return x
-
-            self._final32_fn = jax.jit(jax.shard_map(
-                _final32, mesh=self.mesh,
-                in_specs=(self._specs, P, carry_specs),
-                out_specs=P, check_vma=False))
-        else:
-            def _cycle(data, fext, inv_diag, carry, budget):
-                # Resumable call: the Krylov recurrence continues across
-                # dispatch boundaries, so N capped dispatches are iteration-
-                # for-iteration identical to one long solve.
-                res, carry2 = pcg(
-                    self.ops, data, fext, carry["x"], inv_diag,
-                    tol=scfg.tol,
-                    max_iter=jnp.minimum(cap, budget),
-                    glob_n_dof_eff=glob_n_eff,
-                    max_stag_steps=scfg.max_stag_steps,
-                    max_iter_nominal=scfg.max_iter,
-                    carry_in=carry, return_carry=True)
-                return res.x, carry2, res.flag, res.relres
-
-            self._cycle_fn = jax.jit(jax.shard_map(
-                _cycle, mesh=self.mesh,
-                in_specs=(self._specs, P, P, carry_specs, R),
-                out_specs=(P, carry_specs, R, R), check_vma=False))
-
+        self._engine = ChunkedEngine(
+            mesh=self.mesh, data_specs=self._specs, part_spec=P,
+            rep_spec=R, ops=self.ops, scfg=scfg,
+            glob_n_dof_eff=glob_n_eff, cap=self._dispatch_cap,
+            mixed=mixed, ops32=self.ops32 if mixed else None)
         self._finish_fn = jax.jit(lambda x, udi: x + udi)
 
     def _step_chunked(self, delta):
         """Host-driven solve: repeated capped-iteration dispatches.
 
         Semantics match the one-shot path (same fext/lifting, same inner
-        PCG); chunk boundaries restart the Krylov space in direct mode
-        (slightly more iterations) and align with refinement cycles in
-        mixed mode."""
-        scfg = self.config.solver
+        PCG); the resumable carry makes direct-mode dispatches iteration-
+        for-iteration identical to one long solve, and chunk boundaries
+        align with refinement cycles in mixed mode."""
         _vlog("start_fn dispatch (lifting + r0; first call pays compile)")
-        out = self._start_fn(self.data, self.un, jnp.asarray(delta, self.dtype))
-        if self.mixed:
-            udi, fext, carry, normr0, n2b = out
-        else:
-            udi, fext, carry, normr0, n2b, inv_diag = out
+        udi, fext, carry, normr0, n2b, prec = self._start_fn(
+            self.data, self.un, jnp.asarray(delta, self.dtype))
         n2b_f = float(n2b)
         _vlog(f"start_fn done; ||b||={n2b_f:.3e}")
         if n2b_f == 0.0:
             self.un = self._finish_fn(jnp.zeros_like(carry["x"]), udi)
             return 0, 0.0, 0
-        tolb = scfg.tol * n2b_f
-        total, flag = 0, 1
-        cur = float(normr0)
-        relres = cur / n2b_f
-        x_fin = carry["x"]
-        if cur <= tolb:
-            flag = 0
-        elif self.mixed:
-            x, r, normr = carry["x"], carry["r"], normr0
-            stall = 0
-            while flag == 1 and total < scfg.max_iter:
-                prev = cur
-                # One refinement cycle: run the f32 inner solve to ITS
-                # convergence via resumable capped dispatches, then refine.
-                _vlog(f"inner_start dispatch (normr={float(normr):.3e})")
-                rhat32, inv32, tol_cycle, c32 = self._inner_start_fn(
-                    self.data, r, normr, n2b)
-                inner_flag, xin = 1, None
-                while inner_flag == 1 and total < scfg.max_iter:
-                    budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
-                    _vlog(f"inner_cycle dispatch (total={total})")
-                    xin, c32, iflag = self._inner_cycle_fn(
-                        self.data, rhat32, inv32, tol_cycle, c32, budget)
-                    total += int(c32["exec"])
-                    inner_flag = int(iflag)
-                    _vlog(f"inner_cycle done: +{int(c32['exec'])} iters "
-                          f"flag={inner_flag}")
-                if inner_flag != 0:
-                    # Failed/exhausted inner solve: min-residual selection
-                    # (the resumable path defers it; matches one-shot
-                    # pcg_mixed's inner finalize_bad).
-                    xin = self._final32_fn(self.data, rhat32, c32)
-                _vlog("refine dispatch (f64 true-residual matvec)")
-                x, r, normr = self._refine_fn(self.data, fext, x, xin, normr)
-                cur = float(normr)
-                _vlog(f"refine done: relres={cur / n2b_f:.3e} total={total}")
-                if cur <= tolb:
-                    flag = 0
-                elif inner_flag == 2:
-                    flag = 2
-                elif cur > 0.9 * prev:
-                    # no meaningful contraction over a whole refinement cycle
-                    stall += 1
-                    if stall >= 2:
-                        flag = 3
-                else:
-                    stall = 0
-            x_fin, relres = x, cur / n2b_f
-        else:
-            while flag == 1 and total < scfg.max_iter:
-                budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
-                x_fin, carry, cflag, crelres = self._cycle_fn(
-                    self.data, fext, inv_diag, carry, budget)
-                total += int(carry["exec"])
-                flag = int(cflag)
-                relres = float(crelres)
-            if flag != 0:
-                # Terminal failure: the resumable path defers MATLAB pcg's
-                # min-residual fallback to here (once per step).
-                x_fin, relres_dev = self._final_fn(self.data, fext, carry)
-                relres = float(relres_dev)
+        x_fin, flag, relres, total = self._engine.run(
+            self.data, fext, carry, normr0, n2b, prec, vlog=_vlog)
         self.un = self._finish_fn(x_fin, udi)
         return flag, relres, total
 

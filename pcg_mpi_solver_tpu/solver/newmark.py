@@ -89,9 +89,11 @@ class NewmarkSolver:
     """Implicit Newmark-beta on the SPMD-partitioned model.
 
     Shares the quasi-static driver's backend selection (general node-ELL or
-    hybrid level-grid; the structured slab path has no mass data) and its
+    hybrid level-grid; the structured slab path has no mass data), its
     precision/preconditioner config (``config.solver.precision_mode``,
-    ``config.solver.precond``)."""
+    ``config.solver.precond``), and its dispatch-chunked solve machinery
+    (``config.solver.iters_per_dispatch``, auto-engaged above ~4M dofs —
+    solver/chunked.py)."""
 
     def __init__(
         self,
@@ -123,13 +125,6 @@ class NewmarkSolver:
                              "the explicit path: solver/dynamics.py)")
         if dt <= 0:
             raise ValueError(f"NewmarkSolver requires dt > 0, got {dt}")
-        if scfg.iters_per_dispatch > 0:
-            import warnings
-
-            warnings.warn(
-                "SolverConfig.iters_per_dispatch is not supported by "
-                "NewmarkSolver (each step runs one device dispatch); "
-                "the setting is ignored")
         self.dt, self.beta, self.gamma = float(dt), float(beta), float(gamma)
         self.damping = float(damping)
 
@@ -200,20 +195,37 @@ class NewmarkSolver:
         a1_, a4_, a5_ = self.a1, self.a4, self.a5
         cm = self.damping
 
-        def _step(data, prec, u, v, w, delta_next):
-            data64 = data["f64"] if self.mixed else data
+        def _effective_force(data64, u, v, w, delta_next):
+            """History term + Dirichlet lifting at t_{n+1} (the quasi-static
+            driver's updateBC shape, pcg_solver.py:226-238, with A in place
+            of K) — the ONE copy of the Newmark rhs physics, shared by the
+            one-shot and chunked paths."""
             eff = data64["eff"]
             fix = 1.0 - eff
             M = data64["diag_M"]
-            # effective force from the previous state (free + fixed dofs —
-            # the fixed-dof terms are lifted out below)
             hist = M * ((a0 * u + a2_ * v + a3_ * w)
                         + cm * (a1_ * u + a4_ * v + a5_ * w))
             rhs = data64["F"] * delta_next + hist
-            # Dirichlet lifting at t_{n+1} (same shape as the quasi-static
-            # driver's updateBC, pcg_solver.py:226-238, with A in place of K)
             udi = fix * data64["Ud"] * delta_next
             fext = eff * (rhs - self.ops.matvec(data64, udi))
+            return udi, fext
+
+        def _kinematics(data64, x, udi, u, v, w, delta_next):
+            """u/v/w updates from the solved increment; on fixed dofs u2
+            carries the prescribed motion, so w2 is its finite-difference-
+            consistent acceleration.  Shared by both paths."""
+            eff = data64["eff"]
+            fix = 1.0 - eff
+            u2 = x + udi
+            w2 = a0 * (u2 - u) - a2_ * v - a3_ * w
+            v2 = v + dt_ * ((1.0 - g) * w + g * w2)
+            v2 = eff * v2 + fix * data64["Vd"] * delta_next
+            return u2, v2, w2
+
+        def _step(data, prec, u, v, w, delta_next):
+            data64 = data["f64"] if self.mixed else data
+            eff = data64["eff"]
+            udi, fext = _effective_force(data64, u, v, w, delta_next)
             x0 = eff * u
             if self.mixed:
                 res = pcg_mixed(
@@ -229,12 +241,7 @@ class NewmarkSolver:
                     tol=scfg.tol, max_iter=scfg.max_iter,
                     glob_n_dof_eff=glob_n_eff,
                     max_stag_steps=scfg.max_stag_steps)
-            u2 = res.x + udi
-            # kinematic updates; on fixed dofs u2 carries the prescribed
-            # motion, so w2 is its finite-difference-consistent acceleration
-            w2 = a0 * (u2 - u) - a2_ * v - a3_ * w
-            v2 = v + dt_ * ((1.0 - g) * w + g * w2)
-            v2 = eff * v2 + fix * data64["Vd"] * delta_next
+            u2, v2, w2 = _kinematics(data64, res.x, udi, u, v, w, delta_next)
             return u2, v2, w2, res.flag, res.relres, res.iters
 
         P_, R_ = self._part_spec, self._rep_spec
@@ -242,6 +249,55 @@ class NewmarkSolver:
             _step, mesh=self.mesh,
             in_specs=(self._specs, P_, P_, P_, P_, R_),
             out_specs=(P_, P_, P_, R_, R_, R_), check_vma=False))
+
+        # ---- dispatch-chunked step path (large problems) ------------------
+        # Same machinery as the quasi-static driver (solver/chunked.py):
+        # the Newmark start step swaps Dirichlet lifting for the history
+        # term; the engine's cycles are untouched.
+        from pcg_mpi_solver_tpu.solver.chunked import (
+            ChunkedEngine, auto_dispatch_cap)
+
+        self._dispatch_cap = auto_dispatch_cap(
+            scfg, self.pm.glob_n_dof,
+            self.pm.n_loc * (self.pm.n_parts // n_dev))
+        if self._dispatch_cap > 0:
+            from pcg_mpi_solver_tpu.solver.pcg import (
+                carry_part_specs, cold_carry)
+
+            carry_specs = carry_part_specs(P_, R_)
+
+            def _start_ch(data, u, v, w, delta_next):
+                data64 = data["f64"] if self.mixed else data
+                eff = data64["eff"]
+                wts = data64["weight"] * eff
+                udi, fext = _effective_force(data64, u, v, w, delta_next)
+                x0 = eff * u
+                r0 = fext - eff * self.ops.matvec(data64, x0)
+                n2b = jnp.sqrt(self.ops.wdot(wts, fext, fext))
+                normr0 = jnp.sqrt(self.ops.wdot(wts, r0, r0))
+                carry0 = cold_carry(x0, r0, normr0, self.ops.dot_dtype)
+                return udi, fext, carry0, normr0, n2b
+
+            self._start_ch_fn = jax.jit(jax.shard_map(
+                _start_ch, mesh=self.mesh,
+                in_specs=(self._specs, P_, P_, P_, R_),
+                out_specs=(P_, P_, carry_specs, R_, R_), check_vma=False))
+
+            def _finish_ch(data, x, udi, u, v, w, delta_next):
+                data64 = data["f64"] if self.mixed else data
+                return _kinematics(data64, x, udi, u, v, w, delta_next)
+
+            self._finish_ch_fn = jax.jit(jax.shard_map(
+                _finish_ch, mesh=self.mesh,
+                in_specs=(self._specs, P_, P_, P_, P_, P_, R_),
+                out_specs=(P_, P_, P_), check_vma=False))
+
+            self._engine = ChunkedEngine(
+                mesh=self.mesh, data_specs=self._specs, part_spec=P_,
+                rep_spec=R_, ops=self.ops, scfg=scfg,
+                glob_n_dof_eff=glob_n_eff, cap=self._dispatch_cap,
+                mixed=self.mixed,
+                ops32=self.ops32 if self.mixed else None)
 
         # A = K + c*M is CONSTANT over the run (unlike the quasi-static
         # driver, whose per-step Jacobi rebuild is reference parity):
@@ -277,14 +333,30 @@ class NewmarkSolver:
         self.relres: List[float] = []
         self.iters: List[int] = []
 
+    def _step_chunked(self, delta_next):
+        d = jnp.asarray(delta_next, self.dtype)
+        udi, fext, carry, normr0, n2b = self._start_ch_fn(
+            self.data, self.u, self.v, self.w, d)
+        if float(n2b) == 0.0:
+            x_fin, flag, relres, total = jnp.zeros_like(carry["x"]), 0, 0.0, 0
+        else:
+            x_fin, flag, relres, total = self._engine.run(
+                self.data, fext, carry, normr0, n2b, self._prec)
+        self.u, self.v, self.w = self._finish_ch_fn(
+            self.data, x_fin, udi, self.u, self.v, self.w, d)
+        return flag, relres, total
+
     def step(self, delta_next: float) -> StepResult:
         import time
 
         t0 = time.perf_counter()
-        u, v, w, flag, relres, iters = self._step_fn(
-            self.data, self._prec, self.u, self.v, self.w,
-            jnp.asarray(delta_next, self.dtype))
-        self.u, self.v, self.w = u, v, w
+        if self._dispatch_cap > 0:
+            flag, relres, iters = self._step_chunked(delta_next)
+        else:
+            u, v, w, flag, relres, iters = self._step_fn(
+                self.data, self._prec, self.u, self.v, self.w,
+                jnp.asarray(delta_next, self.dtype))
+            self.u, self.v, self.w = u, v, w
         res = StepResult(int(flag), float(relres), int(iters),
                          time.perf_counter() - t0)
         self.flags.append(res.flag)

@@ -65,6 +65,36 @@ def test_poisson_pcg_vs_scipy():
                                atol=1e-10 * np.abs(u_ref).max())
 
 
+# Poisson 5x4x4 heterogeneous (seed 3), tol=1e-10, Jacobi, 4 parts on 4
+# devices.  Pinned at round 2 (same role as the cube goldens,
+# tests/test_goldens.py).
+GOLDEN_POISSON = {"iters": 30, "checksum": 725.442452128879}
+
+
+def test_poisson_golden():
+    model = make_poisson_model(5, 4, 4, heterogeneous=True, seed=3)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-10, max_iter=2000),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(4), n_parts=4)
+    res = s.step(1.0)
+    assert res.flag == 0
+    assert abs(res.iters - GOLDEN_POISSON["iters"]) <= 1, res.iters
+    checksum = float(np.abs(s.displacement_global()).sum())
+    assert np.isclose(checksum, GOLDEN_POISSON["checksum"], rtol=1e-8), checksum
+
+
+def test_mdf_rejects_scalar_models(tmp_path):
+    """The MDF schema is the reference's 3-dof elasticity format; writing
+    a scalar model must fail loudly, not corrupt the 3-component layout."""
+    from pcg_mpi_solver_tpu.models.mdf import write_mdf
+
+    model = make_poisson_model(3, 3, 3)
+    with pytest.raises(ValueError, match="3-dof-per-node"):
+        write_mdf(model, str(tmp_path / "mdf"))
+
+
 def test_poisson_partition_count_parity():
     model = make_poisson_model(4, 4, 4, heterogeneous=True, seed=1)
     runs = {}
