@@ -19,6 +19,14 @@ baseline) and divided by 8 for idealized perfect 8-rank scaling — also
 favoring the baseline, since the real 8-rank demo spent 1.0 of 12.6 s in
 comm-wait (BASELINE.md, notebook cell 12).
 
+The stand-in is VALIDATED against the reference's own code: the full
+reference pipeline runs single-rank under tools/mpi_shim
+(tools/run_reference_baseline.py).  Measured 2026-07-30 on this host at
+823,875 dofs: reference 232.8 ns/dof-iter vs NumpyRefSolver 235.2
+ns/dof-iter (within 1%), with EXACT PCG iteration parity between the
+reference and this framework on the same MDF model (see
+docs/BENCH_LOG.md and tests/test_reference_parity.py).
+
 Default model: 150^3 cells ~= 10.3M dofs — the BASELINE.json north-star
 scale ("=>20x vs 8-rank mpi4py at 10M dofs").
 

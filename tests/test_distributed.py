@@ -86,7 +86,6 @@ assert jax.process_count() == N_PROCS, jax.process_count()
 assert jax.device_count() == 8, jax.device_count()
 
 from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
-from pcg_mpi_solver_tpu.models import make_cube_model
 from pcg_mpi_solver_tpu.solver import Solver
 from pcg_mpi_solver_tpu.utils.io import RunStore
 
@@ -130,11 +129,11 @@ if pid == 0:
 """
 
 
-@pytest.mark.skipif(os.environ.get("PCG_TPU_SKIP_MULTIPROC") == "1",
-                    reason="multi-process test disabled")
-@pytest.mark.parametrize("n_procs,backend", [(2, "general"), (4, "general"),
-                                             (2, "hybrid")])
-def test_multi_process_solve(tmp_path, n_procs, backend):
+def _run_multiproc(tmp_path, child_source, n_procs, extra_argv):
+    """Launch n_procs jax.distributed children of ``child_source`` (argv:
+    coordinator, process id, *extra_argv) and return their RESULT lines.
+    Children are killed on timeout so a hung collective cannot leak
+    processes past the test."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -142,28 +141,43 @@ def test_multi_process_solve(tmp_path, n_procs, backend):
     import inspect
 
     script = tmp_path / "child.py"
-    script.write_text(inspect.getsource(make_mh_test_model) + _CHILD)
+    script.write_text(inspect.getsource(make_mh_test_model) + child_source)
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = os.pathsep.join(
         [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
         + env.get("PYTHONPATH", "").split(os.pathsep))
-    scratch = tmp_path / "scratch"
     procs = [subprocess.Popen(
-                 [sys.executable, str(script), coord, str(i), str(scratch),
-                  str(n_procs), backend],
+                 [sys.executable, str(script), coord, str(i)] + extra_argv,
                  stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                  text=True, env=env)
              for i in range(n_procs)]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
     results = [l for out in outs for l in out.splitlines()
                if l.startswith("RESULT")]
     assert len(results) == n_procs
+    return results
+
+
+@pytest.mark.skipif(os.environ.get("PCG_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multi-process test disabled")
+@pytest.mark.parametrize("n_procs,backend", [(2, "general"), (4, "general"),
+                                             (2, "hybrid")])
+def test_multi_process_solve(tmp_path, n_procs, backend):
+    scratch = tmp_path / "scratch"
+    results = _run_multiproc(tmp_path, _CHILD, n_procs,
+                             [str(scratch), str(n_procs), backend])
     # both controllers observed the identical converged state
     for r in results[1:]:
         assert r.split(" ", 2)[2] == results[0].split(" ", 2)[2]
@@ -171,6 +185,67 @@ def test_multi_process_solve(tmp_path, n_procs, backend):
     # and it matches a single-process 8-part solve
     iters_multi = int(results[0].split("iters=")[1].split()[0])
     assert abs(_reference_iters(backend) - iters_multi) <= 1
+
+
+_CHILD_NEWMARK = r"""
+import os, sys
+N_PROCS = int(sys.argv[3])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={8 // N_PROCS}")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from pcg_mpi_solver_tpu.parallel.distributed import (
+    init_distributed, make_global_mesh)
+
+pid = init_distributed(coordinator_address=sys.argv[1],
+                       num_processes=N_PROCS, process_id=int(sys.argv[2]))
+assert jax.process_count() == N_PROCS, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+import numpy as np
+from pcg_mpi_solver_tpu import RunConfig, SolverConfig
+from pcg_mpi_solver_tpu.solver import NewmarkSolver
+
+model = make_mh_test_model("general")
+cfg = RunConfig(solver=SolverConfig(tol=1e-10, max_iter=1000,
+                                    precond="block3"))
+nm = NewmarkSolver(model, cfg, mesh=make_global_mesh(), n_parts=8,
+                   dt=0.2, damping=0.1)
+res = nm.run([0.5, 1.0, 1.0])
+u = nm.state_global()[0]          # collective fetch on every process
+cs = float(np.abs(u).sum())
+print(f"RESULT {pid} flags={[r.flag for r in res]} "
+      f"iters={[r.iters for r in res]} cs={cs:.12e}", flush=True)
+assert all(r.flag == 0 for r in res)
+"""
+
+
+@pytest.mark.skipif(os.environ.get("PCG_TPU_SKIP_MULTIPROC") == "1",
+                    reason="multi-process test disabled")
+def test_multi_process_newmark(tmp_path):
+    """Implicit Newmark (block3 precond) under REAL 2-process
+    jax.distributed: both controllers integrate the same trajectory, and
+    it matches a single-process 8-part run."""
+    results = _run_multiproc(tmp_path, _CHILD_NEWMARK, 2, ["2"])
+    assert results[0].split(" ", 2)[2] == results[1].split(" ", 2)[2]
+
+    # single-process 8-part reference trajectory
+    from pcg_mpi_solver_tpu import RunConfig, SolverConfig
+    from pcg_mpi_solver_tpu.solver import NewmarkSolver
+
+    model = make_mh_test_model("general")
+    cfg = RunConfig(solver=SolverConfig(tol=1e-10, max_iter=1000,
+                                        precond="block3"))
+    nm = NewmarkSolver(model, cfg, mesh=make_mesh(8), n_parts=8,
+                       dt=0.2, damping=0.1)
+    nm.run([0.5, 1.0, 1.0])
+    cs_ref = float(np.abs(nm.state_global()[0]).sum())
+    cs_multi = float(results[0].split("cs=")[1])
+    assert np.isclose(cs_multi, cs_ref, rtol=1e-9), (cs_multi, cs_ref)
 
 
 _REF_ITERS = {}

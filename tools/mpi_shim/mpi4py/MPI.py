@@ -1,0 +1,154 @@
+"""The MPI module of the single-rank shim (see package docstring).
+
+API surface (everything the reference calls at 1 rank):
+COMM_WORLD/COMM_SELF with Get_rank/Get_size/barrier/allreduce/gather/
+scatter/bcast/Allgather/Split_type/Isend/Recv/isend/recv; SUM;
+LONG/DOUBLE/BOOL datatypes; Request.Waitall; Win.Allocate_shared +
+Shared_query; File.Open with MODE_* + Write_at/Read_at/Read/Close.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+
+import numpy as np
+
+COMM_TYPE_SHARED = 1
+MODE_WRONLY = 1
+MODE_CREATE = 2
+MODE_RDONLY = 4
+SUM = "MPI_SUM"
+
+
+class _Datatype:
+    def __init__(self, size):
+        self._size = size
+
+    def Get_size(self):
+        return self._size
+
+
+LONG = _Datatype(8)
+DOUBLE = _Datatype(8)
+BOOL = _Datatype(1)
+
+
+class _Request:
+    def Wait(self):
+        return None
+
+
+class Request:
+    @staticmethod
+    def Waitall(requests):
+        return None
+
+
+class _Win:
+    def __init__(self, nbytes, itemsize):
+        self._buf = bytearray(nbytes)
+        self._itemsize = itemsize
+
+    def Shared_query(self, rank):
+        return self._buf, self._itemsize
+
+
+class Win:
+    @staticmethod
+    def Allocate_shared(nbytes, itemsize, comm=None):
+        return _Win(int(nbytes), int(itemsize))
+
+
+class File:
+    def __init__(self, fh):
+        self._fh = fh
+
+    @staticmethod
+    def Open(comm, name, amode):
+        if amode & MODE_WRONLY:
+            # MPI semantics: create if needed, do NOT truncate existing
+            fh = open(name, "r+b" if os.path.exists(name) else "w+b")
+        else:
+            fh = open(name, "rb")
+        return File(fh)
+
+    def Write_at(self, offset, buf):
+        self._fh.seek(int(offset))
+        self._fh.write(np.ascontiguousarray(buf).tobytes())
+
+    def Read_at(self, offset, buf):
+        self._fh.seek(int(offset))
+        raw = self._fh.read(buf.nbytes)
+        buf[...] = np.frombuffer(raw, dtype=buf.dtype).reshape(buf.shape)
+
+    def Write(self, buf):
+        self._fh.write(np.ascontiguousarray(buf).tobytes())
+
+    def Read(self, buf):
+        raw = self._fh.read(buf.nbytes)
+        buf[...] = np.frombuffer(raw, dtype=buf.dtype).reshape(buf.shape)
+
+    def Close(self):
+        self._fh.close()
+
+
+class _Comm:
+    """Rank 0 of 1.  Collectives are identities; point-to-point is a
+    tag-keyed in-process mailbox (any send at 1 rank is a self-send)."""
+
+    def __init__(self):
+        self._mail = {}
+
+    # -- topology ------------------------------------------------------
+    def Get_rank(self):
+        return 0
+
+    def Get_size(self):
+        return 1
+
+    def Split_type(self, split_type, key=0):
+        return self
+
+    # -- sync / collectives -------------------------------------------
+    def barrier(self):
+        return None
+
+    Barrier = barrier
+
+    def allreduce(self, x, op=None):
+        return np.copy(x) if isinstance(x, np.ndarray) else x
+
+    def gather(self, x, root=0):
+        return [x]
+
+    def scatter(self, xs, root=0):
+        return xs[0]
+
+    def bcast(self, x, root=0):
+        return x
+
+    def Allgather(self, sendbuf, recvbuf):
+        a = np.ascontiguousarray(sendbuf).ravel()
+        np.asarray(recvbuf).ravel()[: a.size] = a
+
+    # -- point-to-point (self-sends only at 1 rank) --------------------
+    def Isend(self, buf, dest=0, tag=0):
+        self._mail.setdefault(tag, []).append(np.array(buf, copy=True))
+        return _Request()
+
+    def Recv(self, buf, source=0, tag=0):
+        data = self._mail[tag].pop(0)
+        b = np.asarray(buf)
+        b[...] = data.reshape(b.shape)
+
+    def isend(self, obj, dest=0, tag=0):
+        self._mail.setdefault(tag, []).append(copy.deepcopy(obj))
+        return _Request()
+
+    def recv(self, source=0, tag=0):
+        return self._mail[tag].pop(0)
+
+
+COMM_WORLD = _Comm()
+COMM_SELF = _Comm()

@@ -1,0 +1,18 @@
+"""Single-rank in-process stand-in for the slice of the mpi4py API the
+reference implementation exercises.
+
+Purpose: OpenMPI/mpi4py cannot be installed in this image, so the
+reference cannot run multi-rank — but its per-rank hot loop (the thing
+the benchmark baseline models) CAN run single-rank if `import mpi4py`
+resolves.  This package provides exactly that: rank 0 of 1, in-process
+"collectives" (identity), a bytes-backed shared-memory window, plain-file
+MPI-IO, and a tag-keyed mailbox for the (self-)send paths.  It is used
+ONLY by tools/run_reference_baseline.py to measure the reference's own
+code for an honest `vs_baseline`; the framework itself never imports it.
+
+This is original code written against mpi4py's public API signatures as
+called by the reference (pcg_solver.py, partition_mesh.py,
+file_operations.py) — no mpi4py source is used.
+"""
+
+from . import MPI  # noqa: F401  (`from mpi4py import MPI` support)

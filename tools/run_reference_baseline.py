@@ -1,0 +1,187 @@
+"""Run the REFERENCE implementation single-rank on a synthetic model and
+measure its per-(dof x iteration) cost — the honest benchmark baseline.
+
+OpenMPI/mpi4py cannot be installed here, so the reference cannot run
+8-rank; instead its own code runs rank-0-of-1 under tools/mpi_shim (a
+single-rank mpi4py stand-in) through its full pipeline:
+
+    read_input_model.py -> run_metis.py 1 (N=1 shortcut, no METIS)
+    -> partition_mesh.py 1 0 -> pcg_solver.py <run> <speedtest>
+
+on an MDF archive written by this framework's write_mdf (the schema
+round-trips both ways).  The reference repo is never written to: a
+staging directory holds a `src` symlink and the `__pycache__` config
+files its CWD-relative paths expect.
+
+Prints ONE JSON line: the reference's iterations/relres/flag, wall-clock
+calc time, and ns per dof-iteration — plus, when --compare is given,
+this framework's CPU solve of the SAME MDF model at the same tolerance
+(cross-implementation parity: iteration counts should agree to ~1).
+
+Usage:
+    python tools/run_reference_baseline.py [--n 24] [--tol 1e-7]
+        [--scratch DIR] [--compare]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+import zlib
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = os.environ.get("PCG_REFERENCE_PATH", "/root/reference")
+SHIM = os.path.join(REPO, "tools", "mpi_shim")
+
+
+def _run(stage, argv, env):
+    t0 = time.perf_counter()
+    proc = subprocess.run([sys.executable] + argv, cwd=stage, env=env,
+                          capture_output=True, text=True, timeout=3600)
+    dt = time.perf_counter() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"reference stage {argv[0]} failed (rc={proc.returncode}):\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    return dt, proc.stdout
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=24,
+                    help="cells per edge of the cube model")
+    ap.add_argument("--tol", type=float, default=1e-7)
+    ap.add_argument("--scratch", default=None)
+    ap.add_argument("--speedtest", type=int, default=1,
+                    help="reference SpeedTestFlag (1 disables its exports "
+                         "for clean timing — the reference's own method)")
+    ap.add_argument("--compare", action="store_true",
+                    help="also solve the same MDF with this framework "
+                         "(CPU) and report iteration parity")
+    args = ap.parse_args()
+
+    import tempfile
+
+    scratch = args.scratch or tempfile.mkdtemp(prefix="refbase_")
+    stage = os.path.join(scratch, "stage")
+    os.makedirs(stage, exist_ok=True)
+    link = os.path.join(stage, "src")
+    target = os.path.join(REFERENCE, "src")
+    if os.path.lexists(link):
+        if os.path.islink(link) and os.readlink(link) != target:
+            os.unlink(link)        # stale link from an earlier reference
+    if not os.path.lexists(link):
+        os.symlink(target, link)
+
+    sys.path.insert(0, REPO)
+    from pcg_mpi_solver_tpu.models import make_cube_model
+    from pcg_mpi_solver_tpu.models.mdf import write_mdf
+
+    n = args.n
+    t0 = time.perf_counter()
+    model = make_cube_model(n, n, n, E=30e9, nu=0.2, load="traction",
+                            load_value=1e6, heterogeneous=True)
+    mdf_dir = os.path.join(scratch, "mdf")
+    write_mdf(model, mdf_dir)
+    archive = shutil.make_archive(os.path.join(scratch, "cube"), "zip",
+                                  mdf_dir)
+    print(f"# model: {model.n_elem} elems / {model.n_dof} dofs "
+          f"(gen+mdf {time.perf_counter()-t0:.1f}s)", file=sys.stderr,
+          flush=True)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [SHIM, stage] + env.get("PYTHONPATH", "").split(os.pathsep))
+    env.pop("JAX_PLATFORMS", None)   # reference is numpy-only
+    ref_scratch = os.path.join(scratch, "ref_scratch")
+
+    stages = {}
+    stages["ingest"], _ = _run(stage, [
+        "src/data/read_input_model.py", stage, "cube", ref_scratch,
+        archive], env)
+    stages["metis"], _ = _run(stage, ["src/solver/run_metis.py", "1"], env)
+    stages["partition"], _ = _run(stage, [
+        "src/solver/partition_mesh.py", "1", "0"], env)
+
+    # GlobSettings in the reference's schema (run_basic_script.bash:30-49)
+    import pickle
+
+    settings = {
+        "TimeHistoryParam": {"ExportFlag": True, "ExportFrmRate": 1,
+                             "ExportFrms": [], "PlotFlag": False,
+                             "TimeStepDelta": [0, 1], "ExportVars": "U"},
+        "SolverParam": {"Tol": args.tol, "MaxIter": 10000},
+    }
+    with open(os.path.join(stage, "__pycache__", "GlobSettings.zpkl"),
+              "wb") as f:
+        f.write(zlib.compress(pickle.dumps(settings)))
+
+    stages["solve"], out = _run(stage, [
+        "src/solver/pcg_solver.py", "1", str(args.speedtest)], env)
+    print("# reference solver output tail:", file=sys.stderr)
+    for line in out.strip().splitlines()[-8:]:
+        print(f"#   {line}", file=sys.stderr)
+
+    # the reference appends _SpeedTest only for flag EXACTLY 1
+    # (pcg_solver.py:62 `if SpeedTestFlag == 1`)
+    suffix = "_SpeedTest" if args.speedtest == 1 else ""
+    pattern = os.path.join(ref_scratch, f"Results_Run1{suffix}",
+                           "PlotData", "*_TimeData.npz")
+    td_files = glob.glob(pattern)
+    if not td_files:
+        raise RuntimeError(f"reference produced no TimeData at {pattern}")
+    td = np.load(td_files[0], allow_pickle=True)["TimeData"].item()
+    iters = int(np.asarray(td["Iter"]).ravel()[-1])
+    relres = float(np.asarray(td["RelRes"]).ravel()[-1])
+    flag = int(np.asarray(td["Flag"]).ravel()[-1])
+    calc_s = float(td["Mean_CalcTime"])
+    ns_per_dof_iter = calc_s / (model.n_dof * max(iters, 1)) * 1e9
+
+    result = {
+        "reference": {
+            "n_dof": model.n_dof, "iters": iters, "relres": relres,
+            "flag": flag, "calc_s": round(calc_s, 3),
+            "comm_wait_s": round(float(td["Mean_CommWaitTime"]), 3),
+            "ns_per_dof_iter": round(ns_per_dof_iter, 3),
+            "stage_s": {k: round(v, 2) for k, v in stages.items()},
+            "ranks": 1,
+            "how": "reference code, single rank via tools/mpi_shim",
+        },
+    }
+
+    if args.compare:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from pcg_mpi_solver_tpu import (RunConfig, SolverConfig,
+                                        TimeHistoryConfig)
+        from pcg_mpi_solver_tpu.models.mdf import read_mdf
+        from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+        from pcg_mpi_solver_tpu.solver import Solver
+
+        m2 = read_mdf(os.path.join(ref_scratch, "ModelData", "MDF"))
+        cfg = RunConfig(solver=SolverConfig(tol=args.tol, max_iter=10000),
+                        time_history=TimeHistoryConfig(
+                            time_step_delta=[0.0, 1.0]))
+        s = Solver(m2, cfg, mesh=make_mesh(1), n_parts=1)
+        r = s.step(1.0)
+        result["this_framework_cpu"] = {
+            "iters": r.iters, "relres": r.relres, "flag": r.flag,
+            "backend": s.backend,
+            "iters_delta_vs_reference": r.iters - iters,
+        }
+
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()
