@@ -19,12 +19,20 @@ from pcg_mpi_solver_tpu.parallel.structured import (
     StructuredOps, device_data_structured, partition_structured)
 
 
+def _sync(y):
+    """Force a value transfer: on tunneled devices block_until_ready can
+    ack before execution finishes (same helper as examples/bench_matvec)."""
+    leaf = jax.tree.leaves(y)[0]
+    float(jnp.asarray(leaf).ravel()[0])
+
+
 def timeit(f, *args, reps=10):
-    y = jax.block_until_ready(f(*args))
+    y = f(*args)
+    _sync(y)
     t0 = time.perf_counter()
     for _ in range(reps):
         y = f(*args)
-    jax.block_until_ready(y)
+    _sync(y)
     return (time.perf_counter() - t0) / reps * 1e3
 
 

@@ -29,7 +29,8 @@ def test_reference_pipeline_iteration_parity(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools",
                                       "run_reference_baseline.py"),
-         "--n", "10", "--compare", "--scratch", str(tmp_path)],
+         "--n", "10", "--compare", "--speedtest", "0",
+         "--scratch", str(tmp_path)],
         capture_output=True, text=True, timeout=600, env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     result = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -39,3 +40,5 @@ def test_reference_pipeline_iteration_parity(tmp_path):
     # MATLAB-pcg-compatible semantics on both sides: same Krylov path
     assert abs(ours["iters"] - ref["iters"]) <= 1, (ours["iters"],
                                                     ref["iters"])
+    # and the same solution, via the reference's own exported U frame
+    assert ours["solution_max_rel_diff"] < 1e-5, ours

@@ -180,6 +180,33 @@ def main():
             "iters_delta_vs_reference": r.iters - iters,
         }
 
+        if args.speedtest != 1:
+            # Solution-vector parity via the reference's OWN export:
+            # global u from its final U frame + Dof map
+            # (pcg_solver.py:869,201).
+            rv = os.path.join(ref_scratch, "Results_Run1", "ResVecData")
+
+            def read_mpidat(name):
+                md = np.load(os.path.join(rv, name + "_metadat.npy"),
+                             allow_pickle=True).item()
+                # slice to the recorded element count (the shim's File.Open
+                # keeps MPI no-truncate semantics, so a reused scratch may
+                # leave stale tail bytes from a larger earlier run)
+                n = int(np.sum(md["NfData"]))
+                return np.fromfile(os.path.join(rv, name + ".mpidat"),
+                                   dtype=md["DTypeData"][0])[:n]
+
+            frames = sorted(
+                glob.glob(os.path.join(rv, "U_*.mpidat")),
+                key=lambda p: int(
+                    os.path.basename(p)[2:-len(".mpidat")]))
+            u_ref = np.zeros(m2.n_dof)
+            u_ref[read_mpidat("Dof")] = read_mpidat(
+                os.path.basename(frames[-1])[:-len(".mpidat")])
+            diff = np.abs(s.displacement_global() - u_ref).max()
+            result["this_framework_cpu"]["solution_max_rel_diff"] = float(
+                diff / np.abs(u_ref).max())
+
     print(json.dumps(result), flush=True)
 
 
