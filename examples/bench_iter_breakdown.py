@@ -21,7 +21,8 @@ from pcg_mpi_solver_tpu.parallel.structured import (
 
 def _sync(y):
     """Force a value transfer: on tunneled devices block_until_ready can
-    ack before execution finishes (same helper as examples/bench_matvec)."""
+    ack before execution finishes (same caveat examples/bench_matvec.py
+    works around with its inline float() reads)."""
     leaf = jax.tree.leaves(y)[0]
     float(jnp.asarray(leaf).ravel()[0])
 

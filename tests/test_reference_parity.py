@@ -23,13 +23,17 @@ REFERENCE = os.environ.get("PCG_REFERENCE_PATH", "/root/reference")
 @pytest.mark.skipif(
     not os.path.isdir(os.path.join(REFERENCE, "src", "solver")),
     reason="reference checkout not available")
-def test_reference_pipeline_iteration_parity(tmp_path):
+@pytest.mark.parametrize("model,n", [("cube", 10), ("octree", 2)])
+def test_reference_pipeline_iteration_parity(tmp_path, model, n):
+    """cube: the heterogeneous single-type path; octree: the reference's
+    actual problem class — multiple pattern types WITH sign vectors,
+    solved here on the hybrid level-grid backend."""
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools",
                                       "run_reference_baseline.py"),
-         "--n", "10", "--compare", "--speedtest", "0",
+         "--model", model, "--n", str(n), "--compare", "--speedtest", "0",
          "--scratch", str(tmp_path)],
         capture_output=True, text=True, timeout=600, env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
