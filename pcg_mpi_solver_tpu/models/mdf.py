@@ -114,7 +114,25 @@ def read_mdf(mdf_path: str) -> ModelData:
     fixed_dof = bin_("FixedDof", np.int32)[:n_fixed].astype(np.int64)
 
     if os.path.exists(p("nodes.bin")):
-        node_coords = bin_("nodes", np.float64).reshape(n_node, 3)
+        # column-major on disk: the reference reads (NNode, 3) with
+        # order='F' (export_vtk.py:70 via loadBinDataInSharedMem)
+        raw_nodes = bin_("nodes", np.float64)
+        node_coords = raw_nodes.reshape((n_node, 3), order="F")
+        if os.path.exists(p("NodeCoordVec.bin")):
+            # NodeCoordVec is dof-interleaved (= C-order ravel of the
+            # coords) in both layouts — use it to detect legacy bundles
+            # written row-major by pre-fix write_mdf, instead of silently
+            # scrambling their geometry.
+            ncv = bin_("NodeCoordVec", np.float64)[:n_dof]
+            if not np.array_equal(node_coords.ravel(), ncv):
+                legacy = raw_nodes.reshape(n_node, 3)
+                if np.array_equal(legacy.ravel(), ncv):
+                    node_coords = legacy
+                else:
+                    raise ValueError(
+                        "nodes.bin matches neither the reference's "
+                        "column-major layout nor the legacy row-major "
+                        "layout (cross-checked against NodeCoordVec.bin)")
     else:
         node_coords = bin_("NodeCoordVec", np.float64)[:n_dof].reshape(n_node, 3)
 
@@ -256,7 +274,9 @@ def write_mdf(model: ModelData, mdf_path: str) -> str:
     model.node_coords.astype(np.float64).ravel().tofile(p("NodeCoordVec.bin"))
     model.dof_eff.astype(np.int32).tofile(p("DofEff.bin"))
     model.fixed_dof.astype(np.int32).tofile(p("FixedDof.bin"))
-    model.node_coords.astype(np.float64).tofile(p("nodes.bin"))
+    # column-major to match the reference's order='F' read (see read_mdf)
+    model.node_coords.astype(np.float64).ravel(order="F").tofile(
+        p("nodes.bin"))
 
     type_ids = sorted(model.elem_lib.keys())
     ke_arr = np.empty(len(type_ids), dtype=object)

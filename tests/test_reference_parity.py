@@ -30,11 +30,12 @@ def test_reference_pipeline_iteration_parity(tmp_path, model, n):
     solved here on the hybrid level-grid backend."""
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
+    extra = ["--export-compare"] if model == "cube" else []
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools",
                                       "run_reference_baseline.py"),
          "--model", model, "--n", str(n), "--compare", "--speedtest", "0",
-         "--scratch", str(tmp_path)],
+         "--scratch", str(tmp_path)] + extra,
         capture_output=True, text=True, timeout=600, env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     result = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -46,3 +47,10 @@ def test_reference_pipeline_iteration_parity(tmp_path, model, n):
                                                     ref["iters"])
     # and the same solution, via the reference's own exported U frame
     assert ours["solution_max_rel_diff"] < 1e-5, ours
+    if extra:
+        # .vtu content parity: identical geometry, U to solver tolerance
+        vp = result["vtu_parity"]
+        assert vp["points_max_abs_diff"] == 0.0, vp
+        assert vp["connectivity_max_diff"] == 0, vp
+        assert vp["offsets_max_diff"] == 0, vp
+        assert vp["u_max_rel_diff"] < 1e-6, vp

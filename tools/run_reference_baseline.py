@@ -54,6 +54,71 @@ def _run(stage, argv, env):
     return dt, proc.stdout
 
 
+def _parse_vtu(path):
+    """Generic VTK-XML appended-raw parser: both the reference's vendored
+    evtk and this framework's writer use an 8-byte size prefix per block;
+    attribute order differs, so attrs are matched individually."""
+    import re
+
+    with open(path, "rb") as f:
+        raw = f.read()
+    head, _, tail = raw.partition(b'<AppendedData encoding="raw">')
+    data = tail.split(b"_", 1)[1]
+    dtypes = {"Float64": np.float64, "Float32": np.float32,
+              "Int64": np.int64, "Int32": np.int32, "UInt64": np.uint64,
+              "UInt32": np.uint32, "UInt8": np.uint8, "Int8": np.int8}
+    out = {}
+    for m in re.finditer(rb"<DataArray\b[^>]*>", head):
+        attrs = dict(re.findall(r'(\w+)="([^"]*)"', m.group(0).decode()))
+        if attrs.get("format") != "appended":
+            continue
+        off = int(attrs["offset"])
+        nbytes = int(np.frombuffer(data[off:off + 8], np.uint64)[0])
+        arr = np.frombuffer(data[off + 8:off + 8 + nbytes],
+                            dtypes[attrs["type"]])
+        ncomp = int(attrs.get("NumberOfComponents", 1))
+        out[attrs["Name"]] = arr.reshape(-1, ncomp) if ncomp > 1 else arr
+    return out
+
+
+def _compare_vtu_exports(stage, env, ref_scratch, model, store):
+    """Run the reference's export_vtk AND this framework's exporter (on
+    the already-written ``store`` of the --compare solve); compare the
+    .vtu geometry and the U point field.  Returns a dict of diffs."""
+    _run(stage, ["src/data/export_vtk.py", "1", "U", "Full"], env)
+    pattern = os.path.join(ref_scratch, "Results_Run1", "VTKs", "*.vtu")
+    ref_vtus = sorted(
+        glob.glob(pattern),
+        key=lambda p: int(p.rsplit("_", 1)[1][:-len(".vtu")]))
+    if not ref_vtus:
+        raise RuntimeError(f"reference export produced no .vtu at {pattern}")
+
+    from pcg_mpi_solver_tpu.vtk.export import export_vtk
+
+    our_vtus = export_vtk(model, store, ["U"], "Full")
+
+    ref = _parse_vtu(ref_vtus[-1])
+    ours = _parse_vtu(our_vtus[-1])
+    # evtk names the coordinates array "points"; this framework "Points"
+    ours["points"] = ours.get("points", ours.get("Points"))
+    pts_d = float(np.abs(np.asarray(ref["points"], float)
+                         - np.asarray(ours["points"], float)).max())
+    conn_d = int(np.abs(np.asarray(ref["connectivity"], np.int64)
+                        - np.asarray(ours["connectivity"], np.int64)).max())
+    offs_d = int(np.abs(np.asarray(ref["offsets"], np.int64)
+                        - np.asarray(ours["offsets"], np.int64)).max())
+    u_ref = np.asarray(ref["U"], float)
+    u_ours = np.asarray(ours["U"], float)
+    scale = max(np.abs(u_ref).max(), 1e-30)
+    return {
+        "ref_file": os.path.basename(ref_vtus[-1]),
+        "points_max_abs_diff": pts_d,
+        "connectivity_max_diff": conn_d,
+        "offsets_max_diff": offs_d,
+        "u_max_rel_diff": float(np.abs(u_ours - u_ref).max() / scale),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=24,
@@ -71,7 +136,16 @@ def main():
     ap.add_argument("--compare", action="store_true",
                     help="also solve the same MDF with this framework "
                          "(CPU) and report iteration parity")
+    ap.add_argument("--export-compare", action="store_true",
+                    help="additionally run the reference's export_vtk AND "
+                         "this framework's VTK exporter on their own solve "
+                         "results and compare the .vtu content (implies "
+                         "--compare; requires --speedtest 0)")
     args = ap.parse_args()
+    if args.export_compare:
+        args.compare = True
+        if args.speedtest == 1:
+            ap.error("--export-compare needs --speedtest 0 (exports on)")
 
     import tempfile
 
@@ -181,11 +255,21 @@ def main():
         from pcg_mpi_solver_tpu.solver import Solver
 
         m2 = read_mdf(os.path.join(ref_scratch, "ModelData", "MDF"))
-        cfg = RunConfig(solver=SolverConfig(tol=args.tol, max_iter=10000),
+        cfg = RunConfig(scratch_path=os.path.join(scratch, "ours"),
+                        solver=SolverConfig(tol=args.tol, max_iter=10000),
                         time_history=TimeHistoryConfig(
                             time_step_delta=[0.0, 1.0]))
         s = Solver(m2, cfg, mesh=make_mesh(1), n_parts=1)
-        r = s.step(1.0)
+        store = None
+        if args.export_compare:
+            # solve WITH frame exports so the VTU comparison reuses this
+            # solve instead of paying a second one
+            from pcg_mpi_solver_tpu.utils.io import RunStore
+
+            store = RunStore(cfg.result_path, cfg.model_name)
+            r = s.solve(store=store)[-1]
+        else:
+            r = s.step(1.0)
         result["this_framework_cpu"] = {
             "iters": r.iters, "relres": r.relres, "flag": r.flag,
             "backend": s.backend,
@@ -223,6 +307,10 @@ def main():
             rel = np.abs(s.displacement_global() - u_ref) / scale
             result["this_framework_cpu"]["solution_max_rel_diff"] = float(
                 rel.max())
+
+        if args.export_compare:
+            result["vtu_parity"] = _compare_vtu_exports(
+                stage, env, ref_scratch, m2, store)
 
     print(json.dumps(result), flush=True)
 
