@@ -309,10 +309,22 @@ def write_mdf(model: ModelData, mdf_path: str) -> str:
     if model.faces_flat is not None:
         model.faces_flat.astype(np.int32).tofile(p("FacesFlat.bin"))
         _csr_to_offsets(model.faces_offset).ravel(order="F").tofile(p("FacesOffset.bin"))
-        # PolysFlat holds per-cell face-id incidence; faces occurring once are
-        # boundary (reference export_vtk.py:112 bincounts |ids| 0-based).  Our
-        # stored faces are all boundary, so each id appears exactly once.
-        np.arange(n_faces, dtype=np.int32).tofile(p("PolysFlat.bin"))
+        # PolysFlat carries face-id incidence: the reference's Boundary mode
+        # keeps ids with bincount == 1 (export_vtk.py:112).  Our face list
+        # stores interior faces TWICE (one record per adjacent cell), so we
+        # emit each record's CANONICAL id (first record with the same node
+        # set): canonical interior ids then count 2, their duplicates 0,
+        # boundary ids 1 — exactly the reference's semantics.  For models
+        # that store only boundary faces this reduces to arange.
+        from pcg_mpi_solver_tpu.vtk.export import _face_table
+
+        canon = np.arange(n_faces, dtype=np.int64)
+        for idx, arr in _face_table(model.faces_flat, model.faces_offset):
+            key = np.sort(arr, axis=1)
+            _, first, inv = np.unique(key, axis=0, return_index=True,
+                                      return_inverse=True)
+            canon[idx] = idx[first[inv]]
+        canon.astype(np.int32).tofile(p("PolysFlat.bin"))
 
     for name, present in (("Grid.npz", model.grid is not None),
                           ("Octree.npz", model.octree is not None)):

@@ -81,11 +81,12 @@ def _parse_vtu(path):
     return out
 
 
-def _compare_vtu_exports(stage, env, ref_scratch, model, store):
+def _compare_vtu_exports(stage, env, ref_scratch, model, store,
+                         mode="Full"):
     """Run the reference's export_vtk AND this framework's exporter (on
     the already-written ``store`` of the --compare solve); compare the
     .vtu geometry and the U point field.  Returns a dict of diffs."""
-    _run(stage, ["src/data/export_vtk.py", "1", "U", "Full"], env)
+    _run(stage, ["src/data/export_vtk.py", "1", "U", mode], env)
     pattern = os.path.join(ref_scratch, "Results_Run1", "VTKs", "*.vtu")
     ref_vtus = sorted(
         glob.glob(pattern),
@@ -95,28 +96,71 @@ def _compare_vtu_exports(stage, env, ref_scratch, model, store):
 
     from pcg_mpi_solver_tpu.vtk.export import export_vtk
 
-    our_vtus = export_vtk(model, store, ["U"], "Full")
+    our_vtus = export_vtk(model, store, ["U"], mode)
 
-    ref = _parse_vtu(ref_vtus[-1])
-    ours = _parse_vtu(our_vtus[-1])
-    # evtk names the coordinates array "points"; this framework "Points"
-    ours["points"] = ours.get("points", ours.get("Points"))
-    pts_d = float(np.abs(np.asarray(ref["points"], float)
-                         - np.asarray(ours["points"], float)).max())
-    conn_d = int(np.abs(np.asarray(ref["connectivity"], np.int64)
-                        - np.asarray(ours["connectivity"], np.int64)).max())
-    offs_d = int(np.abs(np.asarray(ref["offsets"], np.int64)
-                        - np.asarray(ours["offsets"], np.int64)).max())
-    u_ref = np.asarray(ref["U"], float)
-    u_ours = np.asarray(ours["U"], float)
-    scale = max(np.abs(u_ref).max(), 1e-30)
-    return {
+    ref_raw = _parse_vtu(ref_vtus[-1])
+    our_raw = _parse_vtu(our_vtus[-1])
+    ref = _canon_vtu(ref_raw)
+    ours = _canon_vtu(our_raw)
+
+    # face sets keyed by node COORDINATES (the reference's Boundary mode
+    # renumbers points to the used subset; ours keeps all points — the
+    # geometry, not the numbering, must agree); raw cell counts catch
+    # duplicated-cell regressions the set comparison alone would dedup away
+    missing_pts = [p for p in ref["u_at"] if p not in ours["u_at"]]
+    u_d = 0.0
+    scale = max((abs(v) for rows in ref["u_at"].values()
+                 for u in rows for v in u), default=0.0) or 1e-30
+    for p, rows in ref["u_at"].items():
+        if p in ours["u_at"]:
+            # coincident duplicate nodes (cohesive interfaces) compare as
+            # sorted multisets of displacement rows
+            for a, b in zip(sorted(rows), sorted(ours["u_at"][p])):
+                u_d = max(u_d, max(abs(x - y) for x, y in zip(a, b)))
+    out = {
         "ref_file": os.path.basename(ref_vtus[-1]),
-        "points_max_abs_diff": pts_d,
-        "connectivity_max_diff": conn_d,
-        "offsets_max_diff": offs_d,
-        "u_max_rel_diff": float(np.abs(u_ours - u_ref).max() / scale),
+        "n_cells_ref": len(np.asarray(ref_raw["offsets"])),
+        "n_cells_ours": len(np.asarray(our_raw["offsets"])),
+        "n_faces_ref": len(ref["faces"]),
+        "n_faces_ours": len(ours["faces"]),
+        "faces_match": ref["faces"] == ours["faces"],
+        "points_missing_in_ours": len(missing_pts),
+        "u_max_rel_diff": u_d / scale,
     }
+    if mode == "Full":
+        # Full mode renumbers nothing on either side: the arrays must be
+        # BYTE-identical, not just geometry-equal
+        our_pts = our_raw.get("points", our_raw.get("Points"))
+        out["points_max_abs_diff"] = float(
+            np.abs(np.asarray(ref_raw["points"], float)
+                   - np.asarray(our_pts, float)).max())
+        out["connectivity_max_diff"] = int(
+            np.abs(np.asarray(ref_raw["connectivity"], np.int64)
+                   - np.asarray(our_raw["connectivity"], np.int64)).max())
+        out["offsets_max_diff"] = int(
+            np.abs(np.asarray(ref_raw["offsets"], np.int64)
+                   - np.asarray(our_raw["offsets"], np.int64)).max())
+    return out
+
+
+def _canon_vtu(arrays):
+    """Geometry-canonical view of a parsed VTU: faces as frozensets of
+    node-coordinate tuples, and the U field keyed by coordinates (a LIST
+    of rows per coordinate: cohesive-interface models carry coincident
+    duplicate nodes with distinct displacements)."""
+    pts = np.asarray(arrays.get("points", arrays.get("Points")), float)
+    conn = np.asarray(arrays["connectivity"], np.int64)
+    offs = np.asarray(arrays["offsets"], np.int64)
+    u = np.asarray(arrays["U"], float)
+    faces = set()
+    start = 0
+    for end in offs:
+        faces.add(frozenset(map(tuple, pts[conn[start:int(end)]])))
+        start = int(end)
+    u_at = {}
+    for p, row in zip(pts, u):
+        u_at.setdefault(tuple(p), []).append(tuple(row))
+    return {"faces": faces, "u_at": u_at}
 
 
 def main():
@@ -141,6 +185,12 @@ def main():
                          "this framework's VTK exporter on their own solve "
                          "results and compare the .vtu content (implies "
                          "--compare; requires --speedtest 0)")
+    ap.add_argument("--export-mode", choices=["Full", "Boundary"],
+                    default="Full",
+                    help="export mode for --export-compare (Boundary "
+                         "exercises the reference's PolysFlat incidence "
+                         "selection vs this framework's face-incidence "
+                         "counting)")
     args = ap.parse_args()
     if args.export_compare:
         args.compare = True
@@ -310,7 +360,8 @@ def main():
 
         if args.export_compare:
             result["vtu_parity"] = _compare_vtu_exports(
-                stage, env, ref_scratch, m2, store)
+                stage, env, ref_scratch, m2, store, args.export_mode)
+            result["vtu_parity"]["mode"] = args.export_mode
 
     print(json.dumps(result), flush=True)
 
