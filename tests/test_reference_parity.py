@@ -23,23 +23,24 @@ REFERENCE = os.environ.get("PCG_REFERENCE_PATH", "/root/reference")
 @pytest.mark.skipif(
     not os.path.isdir(os.path.join(REFERENCE, "src", "solver")),
     reason="reference checkout not available")
-@pytest.mark.parametrize("model,n", [("cube", 10), ("octree", 2)])
-def test_reference_pipeline_iteration_parity(tmp_path, model, n):
-    """cube: the heterogeneous single-type path; octree: the reference's
-    actual problem class — multiple pattern types WITH sign vectors,
-    solved here on the hybrid level-grid backend."""
+@pytest.mark.parametrize("model,n,modes", [
+    ("cube", 10, ["Full"]),
+    ("octree", 2, ["Boundary", "MidSlices"]),
+])
+def test_reference_pipeline_iteration_parity(tmp_path, model, n, modes):
+    """cube: the heterogeneous single-type path with Full-mode export;
+    octree: the reference's actual problem class — multiple pattern types
+    WITH sign vectors, solved here on the hybrid level-grid backend —
+    with its Boundary (PolysFlat incidence) and MidSlices (plane
+    selection) export modes, both served from the one solve."""
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
-    # cube: Full-mode export parity; octree: Boundary mode, exercising the
-    # reference's PolysFlat-incidence face selection vs our face-incidence
-    # counting on a mesh with genuine interior faces
-    mode = "Boundary" if model == "octree" else "Full"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools",
                                       "run_reference_baseline.py"),
          "--model", model, "--n", str(n), "--compare", "--speedtest", "0",
-         "--export-compare", "--export-mode", mode,
-         "--scratch", str(tmp_path)],
+         "--export-compare", "--export-mode"] + modes
+        + ["--scratch", str(tmp_path)],
         capture_output=True, text=True, timeout=600, env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     result = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -52,13 +53,14 @@ def test_reference_pipeline_iteration_parity(tmp_path, model, n):
     # and the same solution, via the reference's own exported U frame
     assert ours["solution_max_rel_diff"] < 1e-5, ours
     # .vtu content parity: identical face geometry, U to solver tolerance
-    vp = result["vtu_parity"]
-    assert vp["faces_match"], vp
-    assert vp["n_cells_ref"] == vp["n_cells_ours"], vp
-    assert vp["points_missing_in_ours"] == 0, vp
-    assert vp["u_max_rel_diff"] < 1e-6, vp
-    if mode == "Full":
-        # Full mode: arrays byte-identical, not just geometry-equal
-        assert vp["points_max_abs_diff"] == 0.0, vp
-        assert vp["connectivity_max_diff"] == 0, vp
-        assert vp["offsets_max_diff"] == 0, vp
+    for mode in modes:
+        vp = result["vtu_parity"][mode]
+        assert vp["faces_match"], vp
+        assert vp["n_cells_ref"] == vp["n_cells_ours"], vp
+        assert vp["points_missing_in_ours"] == 0, vp
+        assert vp["u_max_rel_diff"] < 1e-6, vp
+        if mode == "Full":
+            # Full mode: arrays byte-identical, not just geometry-equal
+            assert vp["points_max_abs_diff"] == 0.0, vp
+            assert vp["connectivity_max_diff"] == 0, vp
+            assert vp["offsets_max_diff"] == 0, vp

@@ -185,12 +185,14 @@ def main():
                          "this framework's VTK exporter on their own solve "
                          "results and compare the .vtu content (implies "
                          "--compare; requires --speedtest 0)")
-    ap.add_argument("--export-mode", choices=["Full", "Boundary"],
-                    default="Full",
-                    help="export mode for --export-compare (Boundary "
-                         "exercises the reference's PolysFlat incidence "
-                         "selection vs this framework's face-incidence "
-                         "counting)")
+    ap.add_argument("--export-mode", nargs="+",
+                    choices=["Full", "Boundary", "MidSlices"],
+                    default=["Full"],
+                    help="export mode(s) for --export-compare, all served "
+                         "from the ONE solve (Boundary exercises the "
+                         "reference's PolysFlat incidence selection, "
+                         "MidSlices its per-face plane loop, vs this "
+                         "framework's vectorized selections)")
     args = ap.parse_args()
     if args.export_compare:
         args.compare = True
@@ -359,9 +361,10 @@ def main():
                 rel.max())
 
         if args.export_compare:
-            result["vtu_parity"] = _compare_vtu_exports(
-                stage, env, ref_scratch, m2, store, args.export_mode)
-            result["vtu_parity"]["mode"] = args.export_mode
+            result["vtu_parity"] = {
+                mode: _compare_vtu_exports(stage, env, ref_scratch, m2,
+                                           store, mode)
+                for mode in args.export_mode}
 
     print(json.dumps(result), flush=True)
 
