@@ -53,6 +53,12 @@ def main():
         sys.exit(3)
 
     import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # honor an explicit CPU request even where a sitecustomize
+        # force-registers the accelerator plugin ahead of the env var
+        # (docs/RUNBOOK.md) — enables CPU smoke runs of the bench
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
