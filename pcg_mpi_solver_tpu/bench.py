@@ -1,6 +1,10 @@
 """Benchmark harness: TPU SPMD solve vs the reference's per-rank hot loop.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"}.
+A provisional copy of the line (computed with the pre-validated baseline
+constant) is written to stderr and to ``bench_provisional.json`` IMMEDIATELY
+after the timed solve, so the perf number survives even if the process dies
+before the final emit; stdout stays single-line for the driver's parser.
 
 Metric: sustained PCG iteration throughput (dof-iterations / second) of the
 full jitted solve on the available accelerator, measured on a converged
@@ -20,87 +24,223 @@ favoring the baseline, since the real 8-rank demo spent 1.0 of 12.6 s in
 comm-wait (BASELINE.md, notebook cell 12).
 
 The stand-in is VALIDATED against the reference's own code: the full
-reference pipeline runs single-rank under tools/mpi_shim
-(tools/run_reference_baseline.py).  Measured 2026-07-30 on this host at
-823,875 dofs: reference 232.8 ns/dof-iter vs NumpyRefSolver 235.2
-ns/dof-iter (within 1%), with EXACT PCG iteration parity between the
-reference and this framework on the same MDF model (see
-docs/BENCH_LOG.md and tests/test_reference_parity.py).
+reference pipeline runs under tools/mpi_shim (tools/run_reference_baseline.py).
+Measured 2026-07-30 on this host at 823,875 dofs: reference 232.8
+ns/dof-iter vs NumpyRefSolver 235.2 ns/dof-iter (within 1%), with EXACT
+PCG iteration parity between the reference and this framework on the same
+MDF model (see docs/BENCH_LOG.md and tests/test_reference_parity.py).
 
 Default model: 150^3 cells ~= 10.3M dofs — the BASELINE.json north-star
 scale ("=>20x vs 8-rank mpi4py at 10M dofs").
 
+Resilience posture (the round's BENCH artifact is captured by an external
+driver exactly once, in whatever infrastructure weather prevails):
+
+- the accelerator probe RETRIES with backoff for BENCH_PROBE_BUDGET_S
+  (default 2700 s) instead of giving up after one 3-minute attempt;
+- a size LADDER retries the solve at smaller models if the flagship size
+  fails to build/compile/converge (cube: BENCH_LADDER nx rungs, default
+  "150,128,96"; octree: BENCH_OT_LADDER n0 rungs, default "12,10,8");
+- the live numpy baseline runs in a crash-isolated SUBPROCESS with a
+  timeout; if it fails, the pre-validated constant is used instead;
+- if the accelerator never comes up, BENCH_CPU_FALLBACK=1 (default) runs
+  a small, clearly-labeled CPU measurement instead of exiting empty.
+
 Env knobs: BENCH_NX/NY/NZ (cells), BENCH_TOL, BENCH_PARTS, BENCH_DTYPE,
 BENCH_MODE (mixed|direct), BENCH_BACKEND (auto|structured|general),
-BENCH_REF_ITERS, BENCH_REF_MAX_DOFS.
+BENCH_REF_ITERS, BENCH_REF_MAX_DOFS, BENCH_MODEL (cube|octree),
+BENCH_OT_N, BENCH_OT_LEVEL, BENCH_PROBE_BUDGET_S, BENCH_LADDER,
+BENCH_OT_LADDER, BENCH_CPU_FALLBACK, BENCH_REF_TIMEOUT_S.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+# docs/BENCH_LOG.md 2026-07-30: the reference's OWN hot loop measured at
+# 232.8 ns/dof-iter on this host at 823,875 dofs; the NumpyRefSolver
+# stand-in at 235.2 (within 1%).  Used for the provisional line and
+# whenever the live baseline measurement fails.
+VALIDATED_REF_NS_PER_DOF_ITER = 235.2176
+_VALIDATED_NOTE = ("pre-validated constant (docs/BENCH_LOG.md: reference's "
+                   "own hot loop 232.8 ns/dof-iter at 823,875 dofs; "
+                   "stand-in within 1%)")
 
 
-def main():
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _cpu_only_env():
+    """Env for CPU-only subprocesses that must NEVER touch the accelerator
+    tunnel: with a wedged tunnel, the PJRT plugin's sitecustomize blocks
+    even CPU work at interpreter start (docs/RUNBOOK.md) — so the plugin's
+    site dir is dropped from PYTHONPATH, not just overridden by
+    JAX_PLATFORMS."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and "axon" not in p]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in pp:
+        pp.insert(0, repo)
+    env["PYTHONPATH"] = os.pathsep.join(pp)
+    return env
+
+
+def _probe_with_retry():
+    """Retry the backend probe with backoff across the round's budget.
+
+    r02 post-mortem: one 180 s probe attempt died on a transiently dead
+    tunnel and the whole round's perf artifact was lost.  The driver
+    gives the bench far more wall than 3 minutes — spend it."""
     from pcg_mpi_solver_tpu.utils.backend_probe import probe_backend
 
-    ok, detail = probe_backend()
-    if not ok:
-        print(f"# FATAL: {detail}\n# No perf number can be produced from "
-              "this host.", file=sys.stderr, flush=True)
-        sys.exit(3)
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", 2700))
+    t0 = time.monotonic()
+    attempt = 0
+    hard_fails = 0
+    while True:
+        attempt += 1
+        ok, detail = probe_backend()
+        if ok:
+            if attempt > 1:
+                _log(f"# backend probe ok on attempt {attempt} "
+                     f"({time.monotonic() - t0:.0f}s in)")
+            return True, detail
+        elapsed = time.monotonic() - t0
+        _log(f"# backend probe attempt {attempt} failed "
+             f"({elapsed:.0f}/{budget:.0f}s): {detail}")
+        # a timeout or connection error is transient tunnel weather worth
+        # waiting out; a missing/broken plugin is deterministic — two
+        # strikes and move on to the fallback instead of burning the
+        # whole budget on it
+        deterministic = any(sig in detail for sig in (
+            "ModuleNotFoundError", "ImportError",
+            "not in the list of known backends"))
+        if deterministic:
+            hard_fails += 1
+            if hard_fails >= 2:
+                return False, detail
+        if elapsed >= budget:
+            return False, detail
+        # short sleeps early (transient relay restarts recover fast),
+        # longer later (wedged-session reaping takes minutes)
+        time.sleep(min(30.0 + 15.0 * attempt, 120.0))
 
-    import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        # honor an explicit CPU request even where a sitecustomize
-        # force-registers the accelerator plugin ahead of the env var
-        # (docs/RUNBOOK.md) — enables CPU smoke runs of the bench
-        jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-
-    from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
-    from pcg_mpi_solver_tpu.models import make_cube_model
-    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
-    from pcg_mpi_solver_tpu.solver import Solver
-    from pcg_mpi_solver_tpu.solver.numpy_ref import NumpyRefSolver
-
-    # Dispatch breadcrumbs on by default: a wedged remote compile/execute
-    # must be localizable from the driver's captured stderr.
-    os.environ.setdefault("PCG_TPU_VERBOSE", "1")
-    kind = os.environ.get("BENCH_MODEL", "cube")   # cube | octree
-    nx = int(os.environ.get("BENCH_NX", 150))
-    ny = int(os.environ.get("BENCH_NY", 150))
-    nz = int(os.environ.get("BENCH_NZ", 150))
-    tol = float(os.environ.get("BENCH_TOL", 1e-7))
-    mode = os.environ.get("BENCH_MODE", "mixed")   # mixed | direct
-    backend = os.environ.get("BENCH_BACKEND", "auto")
-    dtype = os.environ.get("BENCH_DTYPE", "float32")
-    n_dev = len(jax.devices())
-    n_parts = int(os.environ.get("BENCH_PARTS", n_dev))
-
-    def gen_octree(n, level):
+def _build_model(kind, nx, ny, nz, ot_n, ot_level):
+    if kind == "octree":
         from pcg_mpi_solver_tpu.models.octree import make_octree_model
 
-        return make_octree_model(n, n, n, max_level=level, n_incl=6,
-                                 seed=2, E=30e9, nu=0.2,
+        return make_octree_model(ot_n, ot_n, ot_n, max_level=ot_level,
+                                 n_incl=6, seed=2, E=30e9, nu=0.2,
                                  load="traction", load_value=1e6)
+    from pcg_mpi_solver_tpu.models import make_cube_model
 
-    t_gen0 = time.perf_counter()
-    if kind == "octree":
-        # graded octree with real transition pattern types: the reference's
-        # problem class, solved on the hybrid level-grid backend
-        model = gen_octree(int(os.environ.get("BENCH_OT_N", 12)),
-                           int(os.environ.get("BENCH_OT_LEVEL", 4)))
+    return make_cube_model(nx, ny, nz, E=30e9, nu=0.2, load="traction",
+                           load_value=1e6, heterogeneous=True)
+
+
+def measure_ref_ns(kind, n_dof, ref_max_dofs, n_ref_iters,
+                   nx, ny, nz, ot_n, ot_level):
+    """Measure the numpy reference hot-loop cost; prints ONE line
+    ``REF_NS <ns> <note>`` on stdout.  Runs in a subprocess so an OOM or
+    hang here cannot take down the bench after its timed solve."""
+    from pcg_mpi_solver_tpu.solver.numpy_ref import NumpyRefSolver
+
+    if n_dof <= ref_max_dofs:
+        ref_model = _build_model(kind, nx, ny, nz, ot_n, ot_level)
+        note = "same model"
+    elif kind == "octree":
+        ref_model = _build_model(kind, 0, 0, 0, 8, 3)
+        note = f"scaled per-dof from a {ref_model.n_dof}-dof octree"
     else:
-        model = make_cube_model(nx, ny, nz, E=30e9, nu=0.2, load="traction",
-                                load_value=1e6, heterogeneous=True)
-    print(f"# model: {model.n_elem} elems / {model.n_dof} dofs "
-          f"(gen {time.perf_counter()-t_gen0:.1f}s); devices={n_dev} "
-          f"parts={n_parts} dtype={dtype} mode={mode} backend={backend}",
-          file=sys.stderr, flush=True)
+        rn = max(8, int(round((ref_max_dofs / 3.1) ** (1 / 3))) - 1)
+        ref_model = _build_model("cube", rn, rn, rn, 0, 0)
+        note = f"scaled per-dof from {ref_model.n_dof} dofs"
+    ref_per_iter = NumpyRefSolver(ref_model).time_per_iter(n_iters=n_ref_iters)
+    print(f"REF_NS {ref_per_iter / ref_model.n_dof * 1e9:.4f} {note}",
+          flush=True)
+
+
+def _live_baseline(kind, n_dof, nx, ny, nz, ot_n, ot_level):
+    """Subprocess-isolated live baseline; (ref_ns, note) or None."""
+    ref_max_dofs = int(os.environ.get("BENCH_REF_MAX_DOFS", 800_000))
+    n_ref_iters = int(os.environ.get("BENCH_REF_ITERS", 10))
+    timeout_s = float(os.environ.get("BENCH_REF_TIMEOUT_S", 600))
+    code = (
+        "from pcg_mpi_solver_tpu.bench import measure_ref_ns\n"
+        f"measure_ref_ns({kind!r}, {n_dof}, {ref_max_dofs}, {n_ref_iters}, "
+        f"{nx}, {ny}, {nz}, {ot_n}, {ot_level})\n")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              env=_cpu_only_env(),
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _log(f"# live baseline timed out after {timeout_s:.0f}s")
+        return None
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("REF_NS "):
+            _, ns, note = line.split(" ", 2)
+            return float(ns), note
+    tail = (proc.stderr or "").strip().splitlines()[-4:]
+    _log(f"# live baseline failed (rc={proc.returncode}): "
+         + " | ".join(tail))
+    return None
+
+
+def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
+    dof_iters_per_sec = model.n_dof * iters / r1.wall_s
+    # idealized 8-rank reference: perfect 8x scaling of the measured hot loop
+    baseline = 8.0 / (ref_ns * 1e-9)
+    detail = {
+        "n_dof": model.n_dof,
+        "model": kind,
+        "iters": int(iters),
+        "flag": int(r1.flag),
+        "relres": float(r1.relres),
+        "solve_wall_s": round(r1.wall_s, 4),
+        # wall to CONVERGED-at-tol; null when the solve did not converge
+        "time_to_tol_s": round(r1.wall_s, 4) if r1.flag == 0 else None,
+        "tpu_ms_per_iter": round(r1.wall_s / iters * 1e3, 4),
+        "numpy_ref_ns_per_dof_iter": round(ref_ns, 4),
+        "baseline_model": (
+            "measured numpy re-impl of the reference per-rank hot loop "
+            "/ 8 (ideal scaling; real mpi4py+OpenMPI not installable in "
+            "this image)"),
+        "ref_measured_on": ref_note,
+    }
+    detail.update(extra)
+    return json.dumps({
+        "metric": "pcg_dof_iterations_per_second",
+        "value": round(dof_iters_per_sec, 1),
+        "unit": "dof*iter/s",
+        "vs_baseline": round(dof_iters_per_sec / baseline, 3),
+        "detail": detail,
+    })
+
+
+def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
+                mode, dtype):
+    """Build the model/solver, warm-solve (compile), timed solve.
+
+    Returns (model, solver, r1, iters, t_part, pallas_on) where pallas_on
+    reports whether the fused Pallas matvec path stayed engaged."""
+    import jax
+
+    from pcg_mpi_solver_tpu import RunConfig, SolverConfig, TimeHistoryConfig
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+    from pcg_mpi_solver_tpu.solver import Solver
+
+    n_dev = len(jax.devices())
+    t_gen0 = time.perf_counter()
+    model = _build_model(kind, nx, ny, nz, ot_n, ot_level)
+    _log(f"# model: {model.n_elem} elems / {model.n_dof} dofs "
+         f"(gen {time.perf_counter()-t_gen0:.1f}s); devices={n_dev} "
+         f"parts={n_parts} dtype={dtype} mode={mode} backend={backend}")
 
     cfg = RunConfig(
         solver=SolverConfig(tol=tol, max_iter=20000, dtype=dtype,
@@ -111,16 +251,16 @@ def main():
     t_part0 = time.perf_counter()
     s = Solver(model, cfg, mesh=make_mesh(), n_parts=n_parts, backend=backend)
     t_part = time.perf_counter() - t_part0
-    print(f"# partition+upload: {t_part:.2f}s (backend={s.backend}, "
-          f"dispatch_cap={s._dispatch_cap})", file=sys.stderr, flush=True)
+    _log(f"# partition+upload: {t_part:.2f}s (backend={s.backend}, "
+         f"dispatch_cap={s._dispatch_cap}, "
+         f"pallas={getattr(s.ops, 'use_pallas', False)})")
 
     # Warm-up: compile + first solve.  If the Pallas kernel fails at bench
-    # scale (the init probe only validates a tiny compile), fall back to
-    # the XLA matvec rather than losing the round's perf number.
+    # scale (the init probe only validates lowering, not runtime), fall
+    # back to the XLA matvec rather than losing the round's perf number.
     def pallas_fallback(why):
         nonlocal s
-        print(f"# pallas path {why}; retrying with pallas=off",
-              file=sys.stderr, flush=True)
+        _log(f"# pallas path {why}; retrying with pallas=off")
         cfg.solver.pallas = "off"
         del s   # free the failed solver's device buffers before re-upload
         s = Solver(model, cfg, mesh=make_mesh(), n_parts=n_parts,
@@ -134,79 +274,173 @@ def main():
         if not pallas_on:
             raise
         r0 = pallas_fallback(f"failed at scale ({type(e).__name__}: {e})")
+        pallas_on = False
     else:
         if r0.flag != 0 and pallas_on:
             # a mis-lowered kernel cannot fake convergence (the f64 true
             # residual is computed on the XLA path) — a failed solve with
             # pallas on warrants one XLA retry before reporting failure
             r0 = pallas_fallback(f"solve flag={r0.flag}")
-    print(f"# warm solve: flag={r0.flag} iters={r0.iters} "
-          f"relres={r0.relres:.3e} wall={r0.wall_s:.2f}s (incl. compile)",
-          file=sys.stderr, flush=True)
+            pallas_on = False
+    _log(f"# warm solve: flag={r0.flag} iters={r0.iters} "
+         f"relres={r0.relres:.3e} wall={r0.wall_s:.2f}s (incl. compile)")
 
     # Measured solve from scratch state (compile cached).
     s.reset_state()
     r1 = s.step(1.0)
     iters = max(r1.iters, 1)
-    tpu_per_iter = r1.wall_s / iters
-    print(f"# timed solve: flag={r1.flag} iters={iters} "
-          f"relres={r1.relres:.3e} wall={r1.wall_s:.3f}s "
-          f"-> {tpu_per_iter*1e3:.3f} ms/iter", file=sys.stderr, flush=True)
+    _log(f"# timed solve: flag={r1.flag} iters={iters} "
+         f"relres={r1.relres:.3e} wall={r1.wall_s:.3f}s "
+         f"-> {r1.wall_s/iters*1e3:.3f} ms/iter")
+    return model, s, r1, iters, t_part, pallas_on
 
-    # Baseline: the reference's hot loop in numpy, measured on this host.
-    # For huge bench models, measure on a capped-size model and scale
-    # per-dof (conservative: small models cache better).
-    ref_max_dofs = int(os.environ.get("BENCH_REF_MAX_DOFS", 800_000))
-    if model.n_dof <= ref_max_dofs:
-        ref_model, ref_note = model, "same model"
-    elif kind == "octree":
-        ref_model = gen_octree(8, 3)
-        ref_note = f"scaled per-dof from a {ref_model.n_dof}-dof octree"
+
+def _ladder(kind, cpu_fallback):
+    """Rungs of (nx, ny, nz, ot_n, ot_level), flagship first."""
+    def ints(s):
+        vals = [int(t) for t in (x.strip() for x in s.split(",")) if t]
+        if not vals:
+            raise ValueError(f"no sizes in ladder spec {s!r}")
+        return vals
+
+    ot_level = int(os.environ.get("BENCH_OT_LEVEL", 4))
+    if kind == "octree":
+        if cpu_fallback:
+            rungs = os.environ.get("BENCH_CPU_OT_N", "6")
+        elif "BENCH_OT_N" in os.environ:     # explicit pin wins, like BENCH_NX
+            rungs = os.environ["BENCH_OT_N"]
+        else:
+            rungs = os.environ.get("BENCH_OT_LADDER", "12,10,8")
+        return [(0, 0, 0, n, ot_level) for n in ints(rungs)]
+    if cpu_fallback:
+        n = int(os.environ.get("BENCH_CPU_NX", 48))
+        return [(n, n, n, 0, 0)]
+    if any(k in os.environ for k in ("BENCH_NX", "BENCH_NY", "BENCH_NZ")):
+        n = int(os.environ.get("BENCH_NX", 150))
+        return [(n, int(os.environ.get("BENCH_NY", n)),
+                 int(os.environ.get("BENCH_NZ", n)), 0, 0)]
+    return [(n, n, n, 0, 0)
+            for n in ints(os.environ.get("BENCH_LADDER", "150,128,96"))]
+
+
+def _reexec_cpu_fallback(why):
+    """Re-run this bench in a CPU-pinned subprocess (fresh interpreter —
+    the in-process backend cannot be switched after init) and forward its
+    one stdout JSON line.  Last resort when the accelerator failed AFTER
+    a successful probe (e.g. tunnel death mid-compile)."""
+    _log(f"# accelerator path failed ({why}); re-running on CPU")
+    env = _cpu_only_env()
+    env["BENCH_FORCE_CPU"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pcg_mpi_solver_tpu.bench"], env=env)
+    sys.exit(proc.returncode)
+
+
+def main():
+    cpu_fallback = os.environ.get("BENCH_FORCE_CPU") == "1"
+    if cpu_fallback:
+        os.environ["JAX_PLATFORMS"] = "cpu"   # must hold before import jax
     else:
-        rn = max(8, int(round((ref_max_dofs / 3.1) ** (1 / 3))) - 1)
-        ref_model = make_cube_model(rn, rn, rn, E=30e9, nu=0.2,
-                                    load="traction", load_value=1e6,
-                                    heterogeneous=True)
-        ref_note = f"scaled per-dof from {ref_model.n_dof} dofs"
-    ref = NumpyRefSolver(ref_model)
-    n_ref_iters = int(os.environ.get("BENCH_REF_ITERS", 10))
-    ref_per_iter = ref.time_per_iter(n_iters=n_ref_iters)
-    ref_per_dof_iter = ref_per_iter / ref_model.n_dof
-    print(f"# numpy ref ({ref_note}): {ref_per_iter*1e3:.3f} ms/iter "
-          f"({ref_per_dof_iter*1e9:.3f} ns/dof-iter)",
-          file=sys.stderr, flush=True)
+        ok, detail = _probe_with_retry()
+        if not ok:
+            if os.environ.get("BENCH_CPU_FALLBACK", "1") != "1":
+                _log(f"# FATAL: {detail}\n# No perf number can be produced "
+                     "from this host.")
+                sys.exit(3)
+            _log(f"# accelerator unreachable after probe budget: {detail}\n"
+                 "# falling back to a CPU measurement (clearly labeled; NOT "
+                 "the TPU north-star number)")
+            cpu_fallback = True
+            os.environ["JAX_PLATFORMS"] = "cpu"
 
-    dof_iters_per_sec = model.n_dof * iters / r1.wall_s
-    # idealized 8-rank reference: perfect 8x scaling of the measured hot loop
-    baseline_dof_iters_per_sec = 8.0 / ref_per_dof_iter
-    vs_baseline = dof_iters_per_sec / baseline_dof_iters_per_sec
+    import jax
 
-    print(json.dumps({
-        "metric": "pcg_dof_iterations_per_second",
-        "value": round(dof_iters_per_sec, 1),
-        "unit": "dof*iter/s",
-        "vs_baseline": round(vs_baseline, 3),
-        "detail": {
-            "n_dof": model.n_dof,
-            "model": kind,
-            "iters": int(iters),
-            "flag": int(r1.flag),
-            "relres": float(r1.relres),
-            "solve_wall_s": round(r1.wall_s, 4),
-            "tpu_ms_per_iter": round(tpu_per_iter * 1e3, 4),
-            "numpy_ref_ns_per_dof_iter": round(ref_per_dof_iter * 1e9, 4),
-            "baseline_model": (
-                "measured numpy re-impl of the reference per-rank hot loop "
-                "/ 8 (ideal scaling; real mpi4py+OpenMPI not installable in "
-                "this image)"),
-            "ref_measured_on": ref_note,
-            "dtype": dtype,
-            "mode": mode,
-            "backend": s.backend,
-            "n_parts": n_parts,
-            "partition_s": round(t_part, 2),
-        },
-    }), flush=True)
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # honor an explicit CPU request even where a sitecustomize
+        # force-registers the accelerator plugin ahead of the env var
+        # (docs/RUNBOOK.md) — enables CPU smoke runs of the bench
+        jax.config.update("jax_platforms", "cpu")
+
+    # Dispatch breadcrumbs on by default: a wedged remote compile/execute
+    # must be localizable from the driver's captured stderr.
+    os.environ.setdefault("PCG_TPU_VERBOSE", "1")
+    kind = os.environ.get("BENCH_MODEL", "cube")   # cube | octree
+    tol = float(os.environ.get("BENCH_TOL", 1e-7))
+    mode = os.environ.get("BENCH_MODE", "mixed")   # mixed | direct
+    backend = os.environ.get("BENCH_BACKEND", "auto")
+    dtype = os.environ.get("BENCH_DTYPE", "float32")
+    n_parts = int(os.environ.get("BENCH_PARTS", len(jax.devices())))
+
+    ladder = _ladder(kind, cpu_fallback)
+    # loop invariant: reaching the emit below implies the LAST iteration
+    # assigned all of these (every failure path raises or re-execs)
+    for rung_i, (nx, ny, nz, ot_n, ot_level) in enumerate(ladder):
+        last = rung_i == len(ladder) - 1
+        rung = ladder[rung_i]
+        failed = None
+        try:
+            model, solver, r1, iters, t_part, pallas_on = _solve_once(
+                kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
+                mode, dtype)
+        except Exception as e:                      # noqa: BLE001
+            if last:
+                # every rung failed on the accelerator — a labeled CPU
+                # number still beats an empty round artifact
+                if (not cpu_fallback
+                        and os.environ.get("BENCH_CPU_FALLBACK", "1") == "1"):
+                    _reexec_cpu_fallback(f"{type(e).__name__}: {e}")
+                raise
+            failed = f"{type(e).__name__}: {e}"
+            model = solver = r1 = None
+        # a non-converged timed solve is also a failed rung (a smaller
+        # model that converges beats a flagship number at flag!=0)
+        if failed is None and r1.flag != 0 and not last:
+            failed = f"flag={r1.flag} after {iters} iters"
+            model = solver = r1 = None
+        if failed is None:
+            break
+        _log(f"# ladder rung {rung_i} failed ({failed}); stepping down")
+        import gc
+
+        gc.collect()                                # free device buffers
+
+    extra = {
+        "dtype": dtype,
+        "mode": mode,
+        "backend": solver.backend,
+        "pallas": bool(pallas_on),
+        "n_parts": n_parts,
+        "partition_s": round(t_part, 2),
+        "platform": jax.devices()[0].platform + (
+            " (CPU FALLBACK — accelerator unreachable; not the TPU "
+            "north-star number)" if cpu_fallback else ""),
+    }
+
+    # Provisional record FIRST (stderr + file, NOT stdout — the driver
+    # parses stdout and must see exactly one JSON line): the perf number
+    # must survive anything that follows.
+    provisional = _result_json(
+        model, kind, r1, iters, VALIDATED_REF_NS_PER_DOF_ITER,
+        _VALIDATED_NOTE, dict(extra, baseline_source="validated-constant"))
+    _log("# provisional (validated-constant baseline): " + provisional)
+    try:
+        with open("bench_provisional.json", "w") as f:
+            f.write(provisional + "\n")
+    except OSError:
+        pass
+
+    # Live baseline in a crash-isolated subprocess (numpy-only, CPU).
+    live = _live_baseline(kind, model.n_dof, rung[0], rung[1], rung[2],
+                          rung[3], rung[4])
+    if live is not None:
+        ref_ns, ref_note = live
+        _log(f"# numpy ref ({ref_note}): {ref_ns:.3f} ns/dof-iter")
+        print(_result_json(model, kind, r1, iters, ref_ns, ref_note,
+                           dict(extra, baseline_source="measured-live")),
+              flush=True)
+    else:
+        _log("# live baseline unavailable; emitting validated-constant line")
+        print(provisional, flush=True)
 
 
 if __name__ == "__main__":
