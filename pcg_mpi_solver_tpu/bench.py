@@ -169,7 +169,9 @@ def _live_baseline(kind, n_dof, nx, ny, nz, ot_n, ot_level):
     """Subprocess-isolated live baseline; (ref_ns, note) or None."""
     ref_max_dofs = int(os.environ.get("BENCH_REF_MAX_DOFS", 800_000))
     n_ref_iters = int(os.environ.get("BENCH_REF_ITERS", 10))
-    timeout_s = float(os.environ.get("BENCH_REF_TIMEOUT_S", 600))
+    # the timeout covers model REGENERATION in the subprocess too (crash
+    # isolation means the in-memory model cannot be reused), hence roomy
+    timeout_s = float(os.environ.get("BENCH_REF_TIMEOUT_S", 900))
     code = (
         "from pcg_mpi_solver_tpu.bench import measure_ref_ns\n"
         f"measure_ref_ns({kind!r}, {n_dof}, {ref_max_dofs}, {n_ref_iters}, "
@@ -337,6 +339,12 @@ def _reexec_cpu_fallback(why):
 
 
 def main():
+    # a stale provisional file from a previous crashed run must not be
+    # salvageable as THIS run's number
+    try:
+        os.remove("bench_provisional.json")
+    except OSError:
+        pass
     cpu_fallback = os.environ.get("BENCH_FORCE_CPU") == "1"
     if cpu_fallback:
         os.environ["JAX_PLATFORMS"] = "cpu"   # must hold before import jax

@@ -38,9 +38,20 @@ def invert_node_blocks(B: jnp.ndarray, eff3: jnp.ndarray) -> jnp.ndarray:
     cube would overflow f32).  Blocks with a vanishing determinant fall
     back to their scalar-Jacobi diagonal inverse.
     """
-    dt = B.dtype
+    import jax
+
+    out_dt = B.dtype
+    # Compute the whole inversion in f64 when available: the adjugate det
+    # of an ill-conditioned block is pure cancellation in f32 (absolute
+    # noise ~eps32 on O(1) normalized entries, i.e. any det below ~1e-7
+    # is unmeasurable — it can even come out sign-flipped), while this
+    # runs once per preconditioner rebuild, far off the hot loop.  In f64
+    # the det of the STORED block is exact to ~1e-16, so the fallback
+    # cutoff below is a genuine conditioning policy, not a noise guard.
+    dt = jnp.dtype(jnp.float64) if jax.config.jax_enable_x64 else out_dt
     e = eff3.astype(dt)
     eye = jnp.eye(3, dtype=dt)
+    B = B.astype(dt)
     Bm = B * e[..., :, None] * e[..., None, :] + (1.0 - e)[..., :, None] * eye
 
     # normalize: s ~ the block's diagonal scale (>= 1 on fixed/padded rows)
@@ -70,9 +81,20 @@ def invert_node_blocks(B: jnp.ndarray, eff3: jnp.ndarray) -> jnp.ndarray:
                   axis=-1),
     ], axis=-2)
 
-    # a is diagonal-normalized, so a healthy SPD block has det >> eps;
-    # below that the adjugate inverse is numerically meaningless.
-    tiny = jnp.asarray(np.finfo(np.dtype(dt)).eps, dt)
+    # a is diagonal-normalized so det = prod of its eigenvalue ratios in
+    # (0, 1].  The adjugate inverse degrades gracefully as det shrinks,
+    # and an ill-conditioned but valid SPD block (e.g. two stiffness
+    # ratios of ~3e-4: det ~1e-7, the stiff heterogeneous cases block3
+    # targets) must NOT silently fall back to scalar Jacobi.  With f64
+    # compute the det is trustworthy far below f32 eps, so the cutoff
+    # drops to eps32^1.5 (~4e-11); without x64 the f32 arithmetic noise
+    # floor (~eps32 of cancelling O(1) cofactor terms) forces the old
+    # conservative cutoff.
+    if out_dt == jnp.dtype(jnp.float32) and dt == jnp.dtype(jnp.float64):
+        cutoff = float(np.finfo(np.float32).eps) ** 1.5   # ~4e-11
+    else:
+        cutoff = float(np.finfo(np.dtype(dt)).eps)        # old behavior
+    tiny = jnp.asarray(cutoff, dt)
     ok = jnp.abs(det) > tiny
     dinv = jnp.where(ok, 1.0 / jnp.where(ok, det, 1.0), 0.0)
     inv = adj * (dinv / s)[..., None, None]
@@ -86,7 +108,7 @@ def invert_node_blocks(B: jnp.ndarray, eff3: jnp.ndarray) -> jnp.ndarray:
     dvals = jnp.where(d != 0, 1.0 / dsafe, jnp.inf)
     # embed on the diagonal by select, not multiply (inf * 0 would NaN)
     scalar = jnp.where(eye > 0, dvals[..., :, None], jnp.zeros((), dt))
-    return jnp.where(ok[..., None, None], inv, scalar)
+    return jnp.where(ok[..., None, None], inv, scalar).astype(out_dt)
 
 
 VALID_PRECONDS = ("jacobi", "block3")

@@ -65,6 +65,16 @@ class MassShiftedOps:
         raise NotImplementedError("MassShiftedOps only exposes the "
                                   "assembled matvec")
 
+    def diag_local(self, data):
+        # same double-count trap as matvec_local: partial K sums must not
+        # carry the assembled mass term
+        raise NotImplementedError("MassShiftedOps only exposes the "
+                                  "assembled diag")
+
+    def _node_block_local(self, data):
+        raise NotImplementedError("MassShiftedOps only exposes the "
+                                  "assembled node_block_diag")
+
     def diag(self, data):
         return self.base.diag(data) + self.c * data["diag_M"]
 
@@ -125,6 +135,25 @@ class NewmarkSolver:
                              "the explicit path: solver/dynamics.py)")
         if dt <= 0:
             raise ValueError(f"NewmarkSolver requires dt > 0, got {dt}")
+        if gamma <= 0:
+            raise ValueError(f"NewmarkSolver requires gamma > 0, got {gamma}")
+        if gamma < 0.5:
+            import warnings
+
+            # gamma < 1/2 gives NEGATIVE algorithmic damping: each step
+            # returns flag=0 while the integration grows without bound
+            warnings.warn(
+                f"Newmark gamma={gamma} < 0.5 is numerically unstable "
+                "(negative algorithmic damping); unconditional stability "
+                "requires gamma >= 1/2 with beta >= gamma/2", stacklevel=2)
+        elif 2.0 * beta < gamma:
+            import warnings
+
+            warnings.warn(
+                f"Newmark beta={beta} < gamma/2={gamma/2}: only "
+                "conditionally stable — the integration diverges for dt "
+                "above the stability bound while each step reports flag=0",
+                stacklevel=2)
         self.dt, self.beta, self.gamma = float(dt), float(beta), float(gamma)
         self.damping = float(damping)
 

@@ -204,3 +204,32 @@ def test_newmark_unconditional_stability():
     # static displacement scale for this load
     assert np.abs(u).max() < 1e3 * (np.abs(model.F).max() / model.ck.min())
     assert np.isfinite(v).all() and np.isfinite(w).all()
+
+
+def test_newmark_gamma_validation():
+    """gamma <= 0 is rejected; gamma < 1/2 (negative algorithmic damping,
+    unbounded growth at flag=0 per step) warns loudly (ADVICE r2)."""
+    model = make_cube_model(2, 2, 2)
+    with pytest.raises(ValueError, match="gamma"):
+        NewmarkSolver(model, _cfg(), mesh=make_mesh(1), n_parts=1, gamma=0.0)
+    with pytest.warns(UserWarning, match="unstable"):
+        NewmarkSolver(model, _cfg(), mesh=make_mesh(1), n_parts=1, gamma=0.4)
+
+
+def test_mass_shifted_ops_blocks_partial_assembly():
+    """The K+a0*M wrapper must refuse every *_local partial-assembly entry
+    point: delegating them silently would return K-only values without the
+    mass shift (ADVICE r2)."""
+    from pcg_mpi_solver_tpu.solver.newmark import MassShiftedOps
+
+    model = make_cube_model(2, 2, 2)
+    s = NewmarkSolver(model, _cfg(), mesh=make_mesh(1), n_parts=1)
+    # test the solver's OWN wrapped ops, not a fresh wrapper
+    w = s.ops
+    assert isinstance(w, MassShiftedOps)
+    for name in ("matvec_local", "diag_local", "_node_block_local"):
+        with pytest.raises(NotImplementedError):
+            getattr(w, name)(s.data) if name != "matvec_local" \
+                else w.matvec_local(s.data, None)
+    # shift-invariant members still delegate to the unshifted base
+    assert w.wdot == w.base.wdot
