@@ -113,6 +113,21 @@ def batched_structured_matvec(xg, ck, Ke):
     return jnp.stack([fn(xg[p], ck[p], Ke) for p in range(xg.shape[0])])
 
 
+def _v3_env(xg, ck, Ke, *, interpret=False):
+    """v3 with the chunk size from PCG_TPU_PALLAS_PLANES (default 8 —
+    the smallest Mosaic-legal block, see structured_matvec_pallas_v3)."""
+    import os
+
+    planes = int(os.environ.get("PCG_TPU_PALLAS_PLANES", "8"))
+    if planes % 8 != 0:
+        # a typo'd knob would otherwise fail Mosaic lowering and silently
+        # degrade pallas='auto' to the XLA path
+        raise ValueError(
+            f"PCG_TPU_PALLAS_PLANES must be a multiple of 8, got {planes}")
+    return structured_matvec_pallas_v3(xg, ck, Ke, interpret=interpret,
+                                       planes=planes)
+
+
 def selected_variant():
     """(name, fn) of the kernel variant the PCG_TPU_PALLAS_V env knob
     selects — the single source of truth for dispatch AND probing.  Read
@@ -127,7 +142,7 @@ def selected_variant():
         return "v2", structured_matvec_pallas_v2
     if v != "3":
         raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3, got {v!r}")
-    return "v3", structured_matvec_pallas_v3
+    return "v3", _v3_env
 
 
 def probe_shapes(shapes, dtype=jnp.float32) -> None:
@@ -137,6 +152,7 @@ def probe_shapes(shapes, dtype=jnp.float32) -> None:
     init instead of crashing the first jitted step.  Probes the SAME
     variant batched_structured_matvec dispatches to."""
     fn = selected_variant()[1]
+    fn = fn if hasattr(fn, "lower") else jax.jit(fn)
     for xg_shape, ck_shape in shapes:
         fn.lower(
             jax.ShapeDtypeStruct(xg_shape, dtype),
@@ -233,15 +249,17 @@ def _matvec_kernel_v2(ke_ref, x_hbm, ck_hbm, y_ref,
         v = jax.lax.dot_general(
             ke_ref[...], u, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (24, m) on the MXU
+        # corner placement as zero-padded adds (pads with static widths
+        # are pure concatenates — Mosaic has no scatter-add lowering)
         lo = jnp.zeros((3, mp), u.dtype)
         hi = jnp.zeros((3, mp), u.dtype)
         for a, (dx, dy, dz) in enumerate(_CORNERS):
             off = dy * sy + dz
-            for c in range(3):
-                if dx == 0:
-                    lo = lo.at[c, off:off + m].add(v[3 * a + c])
-                else:
-                    hi = hi.at[c, off:off + m].add(v[3 * a + c])
+            pad = jnp.pad(v[3 * a:3 * a + 3], ((0, 0), (off, mp - off - m)))
+            if dx == 0:
+                lo = lo + pad
+            else:
+                hi = hi + pad
         for c in range(3):
             y_ref[c, 0] = (carry[c] + lo[c])[:m]
             carry[c] = hi[c]
@@ -380,10 +398,15 @@ def _matvec_kernel_v3(ke_ref, x_hbm, ck_hbm, y_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "planes"))
-def structured_matvec_pallas_v3(xg, ck, Ke, *, interpret=False, planes=4):
+def structured_matvec_pallas_v3(xg, ck, Ke, *, interpret=False, planes=8):
     """Chunked double-buffered variant of :func:`structured_matvec_pallas_v2`.
 
-    Same signature/semantics; ``planes`` = cell planes per grid step."""
+    Same signature/semantics; ``planes`` = cell planes per grid step.
+    Default 8: the deployed Mosaic toolchain requires the last two dims
+    of the output BlockSpec — (planes, m) here — to be (8, 128)-divisible
+    or equal to the full array dims (docs/RUNBOOK.md "Mosaic lowering
+    constraints"); m is the full lane axis, so planes must be a multiple
+    of 8.  Override with PCG_TPU_PALLAS_PLANES (multiples of 8)."""
     _, nxn, nyn, nzn = xg.shape
     nx, ny, nz = nxn - 1, nyn - 1, nzn - 1
     m = nyn * nzn

@@ -24,15 +24,18 @@ REFERENCE = os.environ.get("PCG_REFERENCE_PATH", "/root/reference")
     not os.path.isdir(os.path.join(REFERENCE, "src", "solver")),
     reason="reference checkout not available")
 @pytest.mark.parametrize("model,n,modes", [
-    ("cube", 10, ["Full"]),
+    ("cube", 10, ["Full", "Delaunay"]),
     ("octree", 2, ["Boundary", "MidSlices"]),
 ])
 def test_reference_pipeline_iteration_parity(tmp_path, model, n, modes):
-    """cube: the heterogeneous single-type path with Full-mode export;
+    """cube: the heterogeneous single-type path with Full-mode export and
+    Delaunay (the reference's point-cloud tetrahedralization,
+    export_vtk.py:178-215 — byte-identical arrays expected since both
+    sides run the same deterministic qhull on the same coordinates);
     octree: the reference's actual problem class — multiple pattern types
     WITH sign vectors, solved here on the hybrid level-grid backend —
     with its Boundary (PolysFlat incidence) and MidSlices (plane
-    selection) export modes, both served from the one solve."""
+    selection) export modes, all served from the one solve."""
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
@@ -59,8 +62,46 @@ def test_reference_pipeline_iteration_parity(tmp_path, model, n, modes):
         assert vp["n_cells_ref"] == vp["n_cells_ours"], vp
         assert vp["points_missing_in_ours"] == 0, vp
         assert vp["u_max_rel_diff"] < 1e-6, vp
-        if mode == "Full":
-            # Full mode: arrays byte-identical, not just geometry-equal
+        if mode in ("Full", "Delaunay"):
+            # arrays byte-identical, not just geometry-equal
             assert vp["points_max_abs_diff"] == 0.0, vp
             assert vp["connectivity_max_diff"] == 0, vp
             assert vp["offsets_max_diff"] == 0, vp
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REFERENCE, "src", "solver")),
+    reason="reference checkout not available")
+@pytest.mark.parametrize("model,n", [("cube", 10), ("octree", 4)])
+def test_reference_multirank_iteration_parity(tmp_path, model, n):
+    """The reference at 8 REAL ranks (tools/mpi_shim multi-rank: router-
+    backed p2p/collectives, mmap shared windows, concurrent MPI-IO):
+    run_metis builds a genuine k-way dual-graph partition (mgmetis
+    stand-in over the framework's C++ partitioner), partition_mesh runs
+    its AABB-Allgather neighbor discovery + Isend/Recv halo construction
+    at 4 workers (partition_mesh.py:674-921), and pcg_solver exchanges
+    halos across 8 processes per iteration (pcg_solver.py:317-334).
+    Iteration counts, residuals and the exported solution must match
+    this framework on the same model."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "run_reference_baseline.py"),
+         "--model", model, "--n", str(n), "--ranks", "8", "--compare",
+         "--speedtest", "0", "--scratch", str(tmp_path)],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    ref, ours = result["reference"], result["this_framework_cpu"]
+    assert ref["ranks"] == 8
+    assert ref["flag"] == 0 and ours["flag"] == 0
+    assert ref["relres"] <= 1e-7 and ours["relres"] <= 1e-7
+    assert abs(ours["iters"] - ref["iters"]) <= 1, (ours["iters"],
+                                                    ref["iters"])
+    # solution via the reference's own 8-rank parallel MPI-IO export.
+    # Looser than the single-rank bound: at 8 ranks the reference's
+    # reduction order differs, so two solves that EACH satisfy
+    # relres <= 1e-7 can differ ~1e-5 per dof on near-zero dofs under
+    # the elementwise-relative metric (observed 1.6e-5 on the octree).
+    assert ours["solution_max_rel_diff"] < 1e-4, ours

@@ -1,0 +1,9 @@
+"""mgmetis stand-in for the reference oracle (see metis.py).
+
+The real mgmetis (a METIS binding) is not installable in this image;
+this package exposes the one call the reference makes
+(``mgmetis.metis.part_mesh_dual``, run_metis.py:88) backed by this
+framework's own first-party C++ multilevel dual-graph partitioner
+(native/src/partition.cpp) — so the reference's unmodified run_metis.py
+produces a genuine k-way dual-graph partition at N > 1.
+"""

@@ -1,10 +1,18 @@
-"""The MPI module of the single-rank shim (see package docstring).
+"""The MPI module of the mpi4py shim (see package docstring).
 
-API surface (everything the reference calls at 1 rank):
+API surface (everything the reference calls):
 COMM_WORLD/COMM_SELF with Get_rank/Get_size/barrier/allreduce/gather/
 scatter/bcast/Allgather/Split_type/Isend/Recv/isend/recv; SUM;
 LONG/DOUBLE/BOOL datatypes; Request.Waitall; Win.Allocate_shared +
 Shared_query; File.Open with MODE_* + Write_at/Read_at/Read/Close.
+
+Two transports behind the same surface:
+
+- default: rank 0 of 1 — collectives are identities, point-to-point is
+  an in-process mailbox (any send at 1 rank is a self-send);
+- MPI_SHIM_SIZE > 1 in the environment (set by tools/mpi_shim/mpiexec.py
+  for each spawned rank): real N-process semantics through the router —
+  see _multirank.py.
 """
 
 from __future__ import annotations
@@ -57,6 +65,9 @@ class _Win:
 class Win:
     @staticmethod
     def Allocate_shared(nbytes, itemsize, comm=None):
+        if _MULTI and isinstance(comm, _multirank.MultiComm):
+            return _multirank.MultiWin.allocate(int(nbytes), int(itemsize),
+                                                comm)
         return _Win(int(nbytes), int(itemsize))
 
 
@@ -67,8 +78,11 @@ class File:
     @staticmethod
     def Open(comm, name, amode):
         if amode & MODE_WRONLY:
-            # MPI semantics: create if needed, do NOT truncate existing
-            fh = open(name, "r+b" if os.path.exists(name) else "w+b")
+            # MPI semantics: create if needed, do NOT truncate existing.
+            # O_CREAT without O_TRUNC is race-free under concurrent Opens
+            # from N ranks (an exists()-then-"w+b" check would truncate a
+            # file another rank is already writing).
+            fh = os.fdopen(os.open(name, os.O_RDWR | os.O_CREAT), "r+b")
         else:
             fh = open(name, "rb")
         return File(fh)
@@ -150,5 +164,14 @@ class _Comm:
         return self._mail[tag].pop(0)
 
 
-COMM_WORLD = _Comm()
-COMM_SELF = _Comm()
+_MULTI = int(os.environ.get("MPI_SHIM_SIZE", "1")) > 1
+if _MULTI:
+    from . import _multirank
+
+    _rank = int(os.environ["MPI_SHIM_RANK"])
+    _size = int(os.environ["MPI_SHIM_SIZE"])
+    COMM_WORLD = _multirank.MultiComm(_rank, _size)
+    COMM_SELF = _Comm()
+else:
+    COMM_WORLD = _Comm()
+    COMM_SELF = _Comm()

@@ -1,14 +1,23 @@
-"""Single-rank in-process stand-in for the slice of the mpi4py API the
-reference implementation exercises.
+"""Stand-in for the slice of the mpi4py API the reference exercises,
+with TWO transports behind one surface.
 
 Purpose: OpenMPI/mpi4py cannot be installed in this image, so the
-reference cannot run multi-rank — but its per-rank hot loop (the thing
-the benchmark baseline models) CAN run single-rank if `import mpi4py`
-resolves.  This package provides exactly that: rank 0 of 1, in-process
-"collectives" (identity), a bytes-backed shared-memory window, plain-file
-MPI-IO, and a tag-keyed mailbox for the (self-)send paths.  It is used
-ONLY by tools/run_reference_baseline.py to measure the reference's own
-code for an honest `vs_baseline`; the framework itself never imports it.
+reference cannot run under a real MPI — but its unmodified code CAN if
+`import mpi4py` resolves to this package:
+
+- single-rank (default): rank 0 of 1, in-process "collectives"
+  (identity), a bytes-backed shared-memory window, plain-file MPI-IO,
+  and a tag-keyed mailbox for the (self-)send paths — used to measure
+  the reference's per-rank hot loop for an honest `vs_baseline`;
+- multi-rank (MPI_SHIM_SIZE > 1, set by tools/mpi_shim/mpiexec.py):
+  N real processes with router-backed tagged point-to-point and
+  collectives, mmap'd contiguous shared-memory windows, and concurrent
+  POSIX MPI-IO — see _multirank.py — used to run the reference's
+  multi-rank partitioning/halo-exchange/parallel-IO code paths as a
+  parity ORACLE (tests/test_reference_parity.py).
+
+Used ONLY by tools/run_reference_baseline.py and its tests; the
+framework itself never imports it.
 
 This is original code written against mpi4py's public API signatures as
 called by the reference (pcg_solver.py, partition_mesh.py,

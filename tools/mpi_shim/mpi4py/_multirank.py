@@ -1,0 +1,340 @@
+"""Multi-rank transport for the mpi4py shim: N real processes, a router.
+
+The reference is an mpiexec-launched SPMD program; this module gives its
+unmodified code real N-process semantics without OpenMPI:
+
+- every rank holds one persistent unix-socket connection to a ROUTER
+  (a thread in the launcher, tools/mpi_shim/mpiexec.py);
+- point-to-point (Isend/Recv/isend/recv) routes pickled payloads through
+  per-(comm, dst, src, tag) mailboxes on the router — tagged, FIFO,
+  source-explicit, exactly the discipline the reference uses
+  (pcg_solver.py:317-334: Isend tag=Rank, Recv tag=NbrMP_Id);
+- collectives (barrier/bcast/gather/scatter/allreduce/Allgather) are
+  built client-side over p2p on a separate channel keyed by a per-comm
+  collective sequence number (all ranks issue collectives in the same
+  order — SPMD — so the sequence agrees without negotiation);
+- MPI.Win.Allocate_shared maps one mmap'd file per window (created by
+  comm-rank 0, fully truncated to the summed per-rank sizes); like real
+  MPI shared windows the memory is CONTIGUOUS in rank order, so
+  Shared_query(r) returns the window from rank r's offset to the end —
+  both idioms in the reference (query(0) at partition_mesh.py:101,
+  query(LoadingRank) at file_operations.py:322) resolve to the loading
+  rank's bytes because all other ranks allocate 0;
+- MPI.File keeps plain POSIX pread/pwrite-at-offset semantics (the
+  reference writes disjoint offset ranges per rank).
+
+Wire format: 8-byte big-endian length + pickle.  Performance is a non-
+goal — this is a parity ORACLE for test-scale models, not a runtime.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import socket
+import struct
+import threading
+from collections import deque
+
+import numpy as np
+
+_LEN = struct.Struct(">Q")
+
+
+def send_frame(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_frame(sock):
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+def _recv_exact(sock, n):
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            return None
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+# ----------------------------------------------------------------------
+# Router (runs in the LAUNCHER, one thread per rank connection)
+# ----------------------------------------------------------------------
+
+
+class Router:
+    """Tag-keyed mailboxes + barrier counting for N ranks.
+
+    One handler thread per rank connection (threads, not select: a
+    handler blocks only on ITS rank's socket; shared state is behind one
+    lock; parked Recv/barrier replies are delivered by whichever handler
+    completes the match)."""
+
+    def __init__(self, n_ranks: int, sock_path: str):
+        self.n = n_ranks
+        self.path = sock_path
+        self._lock = threading.Lock()
+        self._mail = {}          # key -> deque of payloads
+        self._waiting = {}       # key -> conn of the blocked receiver
+        self._bar = {}           # comm_id -> [count, [conns]]
+        self._conns = []
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        if os.path.exists(sock_path):
+            os.unlink(sock_path)
+        self._srv.bind(sock_path)
+        self._srv.listen(n_ranks)
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept(self):
+        for _ in range(self.n):
+            conn, _ = self._srv.accept()
+            with self._lock:
+                self._conns.append(conn)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = recv_frame(conn)
+                if msg is None:
+                    return
+                kind = msg[0]
+                if kind == "snd":
+                    _, key, payload = msg
+                    with self._lock:
+                        waiter = self._waiting.pop(key, None)
+                        if waiter is None:
+                            self._mail.setdefault(
+                                key, deque()).append(payload)
+                    if waiter is not None:
+                        send_frame(waiter, payload)
+                elif kind == "rcv":
+                    _, key = msg
+                    with self._lock:
+                        box = self._mail.get(key)
+                        if box:
+                            payload = box.popleft()
+                            have = True
+                        else:
+                            self._waiting[key] = conn
+                            have = False
+                    if have:
+                        send_frame(conn, payload)
+                elif kind == "bar":
+                    _, cid = msg
+                    with self._lock:
+                        count, conns = self._bar.setdefault(cid, [0, []])
+                        self._bar[cid][0] += 1
+                        conns.append(conn)
+                        done = self._bar[cid][0] == self.n
+                        if done:
+                            release = list(conns)
+                            self._bar[cid] = [0, []]
+                    if done:
+                        for c in release:
+                            send_frame(c, ("ok",))
+        except (OSError, EOFError):
+            return
+
+    def close(self):
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        try:
+            self._srv.close()
+        finally:
+            if os.path.exists(self.path):
+                os.unlink(self.path)
+
+
+# ----------------------------------------------------------------------
+# Client side
+# ----------------------------------------------------------------------
+
+
+class _Request:
+    def Wait(self):
+        return None
+
+
+class MultiComm:
+    """An N-rank communicator backed by the router connection.
+
+    Each comm has a stable id agreed WITHOUT negotiation: comms are only
+    created collectively (COMM_WORLD at import; Split_type calls in
+    program order), so a per-process creation counter matches across
+    ranks."""
+
+    _next_cid = [0]
+    _sock = None
+    _sock_lock = threading.Lock()
+
+    def __init__(self, rank: int, size: int):
+        self.rank = rank
+        self.size = size
+        self.cid = MultiComm._next_cid[0]
+        MultiComm._next_cid[0] += 1
+        self._coll_seq = 0
+        self._win_seq = 0
+        if MultiComm._sock is None:
+            path = os.environ["MPI_SHIM_SOCK"]
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(path)
+            MultiComm._sock = s
+
+    # -- plumbing ------------------------------------------------------
+    def _snd(self, chan, dst, src, tag, payload):
+        key = (self.cid, chan, dst, src, tag)
+        with MultiComm._sock_lock:
+            send_frame(MultiComm._sock, ("snd", key, payload))
+
+    def _rcv(self, chan, src, tag):
+        key = (self.cid, chan, self.rank, src, tag)
+        with MultiComm._sock_lock:
+            send_frame(MultiComm._sock, ("rcv", key))
+            return recv_frame(MultiComm._sock)
+
+    def _coll(self):
+        self._coll_seq += 1
+        return self._coll_seq
+
+    # -- topology ------------------------------------------------------
+    def Get_rank(self):
+        return self.rank
+
+    def Get_size(self):
+        return self.size
+
+    def Split_type(self, split_type, key=0):
+        # single host: the shared-memory comm spans all ranks.  Creation
+        # is collective, so cids stay aligned.
+        return MultiComm(self.rank, self.size)
+
+    # -- sync / collectives -------------------------------------------
+    def barrier(self):
+        with MultiComm._sock_lock:
+            send_frame(MultiComm._sock, ("bar", self.cid))
+            recv_frame(MultiComm._sock)
+
+    Barrier = barrier
+
+    def bcast(self, x, root=0):
+        seq = self._coll()
+        if self.rank == root:
+            for r in range(self.size):
+                if r != root:
+                    self._snd("c", r, root, seq, x)
+            return x
+        return self._rcv("c", root, seq)
+
+    def gather(self, x, root=0):
+        seq = self._coll()
+        if self.rank == root:
+            out = [None] * self.size
+            out[root] = x
+            for r in range(self.size):
+                if r != root:
+                    out[r] = self._rcv("c", r, seq)
+            return out
+        self._snd("c", root, self.rank, seq, x)
+        return None
+
+    def scatter(self, xs, root=0):
+        seq = self._coll()
+        if self.rank == root:
+            for r in range(self.size):
+                if r != root:
+                    self._snd("c", r, root, seq, xs[r])
+            return xs[root]
+        return self._rcv("c", root, seq)
+
+    def allreduce(self, x, op=None):
+        if op is not None and op != "MPI_SUM":    # MPI.SUM sentinel
+            raise NotImplementedError(f"shim allreduce supports SUM, got {op}")
+        parts = self.gather(x, root=0)
+        if self.rank == 0:
+            total = parts[0]
+            for p in parts[1:]:
+                total = total + p
+        else:
+            total = None
+        return self.bcast(total, root=0)
+
+    def Allgather(self, sendbuf, recvbuf):
+        parts = self.gather(np.ascontiguousarray(sendbuf), root=0)
+        parts = self.bcast(parts, root=0)
+        r = np.asarray(recvbuf)
+        # assign through r itself (reshape of a non-contiguous recvbuf
+        # would be a throwaway copy and silently discard the result)
+        r[...] = np.stack([np.asarray(p).ravel() for p in parts]) \
+            .reshape(r.shape)
+
+    # -- point-to-point ------------------------------------------------
+    def Isend(self, buf, dest=0, tag=0):
+        # no defensive copy needed: _snd pickles synchronously, so the
+        # payload is fully snapshotted before Isend returns
+        self._snd("u", dest, self.rank, tag, np.asarray(buf))
+        return _Request()
+
+    def Recv(self, buf, source=0, tag=0):
+        data = self._rcv("u", source, tag)
+        b = np.asarray(buf)
+        b[...] = np.asarray(data).reshape(b.shape)
+
+    def isend(self, obj, dest=0, tag=0):
+        self._snd("u", dest, self.rank, tag, obj)
+        return _Request()
+
+    def recv(self, source=0, tag=0):
+        return self._rcv("u", source, tag)
+
+
+class MultiWin:
+    """Shared window over an mmap'd file, contiguous in rank order."""
+
+    def __init__(self, mm, sizes, itemsize):
+        self._mm = mm
+        self._sizes = sizes
+        self._itemsize = itemsize
+
+    def Shared_query(self, rank):
+        off = int(sum(self._sizes[:rank]))
+        return memoryview(self._mm)[off:], self._itemsize
+
+    @staticmethod
+    def allocate(nbytes, itemsize, comm: MultiComm):
+        sizes = comm.gather(int(nbytes), root=0)
+        sizes = comm.bcast(sizes, root=0)
+        comm._win_seq += 1
+        jobdir = os.environ["MPI_SHIM_JOBDIR"]
+        path = os.path.join(jobdir, f"win_{comm.cid}_{comm._win_seq}")
+        total = max(sum(sizes), 1)
+        if comm.rank == 0:
+            with open(path, "wb") as f:
+                f.truncate(total)
+        comm.barrier()
+        f = open(path, "r+b")
+        mm = mmap.mmap(f.fileno(), total)
+        f.close()
+        return MultiWin(mm, sizes, itemsize)

@@ -1,0 +1,116 @@
+"""Launcher for the multi-rank mpi4py shim: a minimal ``mpiexec``.
+
+Spawns N copies of a Python program with MPI_SHIM_RANK/SIZE set and a
+router thread (mpi4py/_multirank.Router) serving their unix-socket
+rendezvous, so the REFERENCE's unmodified mpiexec-launched programs
+(partition_mesh.py, pcg_solver.py, export_vtk.py) run with real
+N-process semantics in an image without OpenMPI.
+
+Usage (CLI):         python tools/mpi_shim/mpiexec.py -np 8 script.py args...
+Usage (programmatic) from tools/run_reference_baseline.py:
+
+    rc, outs = launch([sys.executable, "script.py", ...], ranks=8,
+                      cwd=stage, env=env)
+
+Per-rank stdout/stderr are captured to files in the job dir and returned.
+A rank failing (nonzero exit) terminates the others after a grace period.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def launch(argv, ranks: int, cwd=None, env=None, timeout=3600):
+    """Run ``argv`` as ``ranks`` SPMD processes.  Returns (rc, outputs)
+    where rc is 0 iff every rank exited 0 and outputs is a list of
+    per-rank captured stdout+stderr strings."""
+    shim_dir = os.path.dirname(os.path.abspath(__file__))
+    if shim_dir not in sys.path:
+        sys.path.insert(0, shim_dir)
+    from mpi4py._multirank import Router
+
+    env = dict(env if env is not None else os.environ)
+    jobdir = tempfile.mkdtemp(prefix="mpishim_")
+    sock = os.path.join(jobdir, "router.sock")
+    router = Router(ranks, sock)
+    env["MPI_SHIM_SIZE"] = str(ranks)
+    env["MPI_SHIM_SOCK"] = sock
+    env["MPI_SHIM_JOBDIR"] = jobdir
+
+    procs = []
+    logs = []
+    try:
+        for r in range(ranks):
+            renv = dict(env, MPI_SHIM_RANK=str(r))
+            log = open(os.path.join(jobdir, f"rank{r}.log"), "w+")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                argv, cwd=cwd, env=renv, stdout=log, stderr=log))
+        deadline = time.monotonic() + timeout
+        rcs = [None] * ranks
+        while any(rc is None for rc in rcs):
+            for i, p in enumerate(procs):
+                if rcs[i] is None:
+                    rcs[i] = p.poll()
+            # fail fast: one dead rank means the job cannot complete
+            if any(rc not in (None, 0) for rc in rcs):
+                time.sleep(2.0)          # let siblings flush/finish
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                rcs = [p.poll() for p in procs]
+                break
+            if time.monotonic() > deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                raise TimeoutError(
+                    f"mpi_shim job exceeded {timeout}s: {argv}")
+            time.sleep(0.05)
+    finally:
+        router.close()
+        outputs = []
+        for log in logs:
+            log.flush()
+            log.seek(0)
+            outputs.append(log.read())
+            log.close()
+        # the job dir holds the full mmap'd shared windows (the whole
+        # partitioned mesh) — leaking one per launch would grow /tmp
+        # without bound across parity-test runs
+        import shutil
+
+        shutil.rmtree(jobdir, ignore_errors=True)
+    rc = 0 if all(c == 0 for c in rcs) else next(
+        c for c in rcs if c not in (0, None))
+    return rc, outputs
+
+
+def main():
+    args = sys.argv[1:]
+    ranks = 1
+    if args and args[0] in ("-np", "-n"):
+        ranks = int(args[1])
+        args = args[2:]
+    if not args:
+        print("usage: mpiexec.py -np N script.py [args...]", file=sys.stderr)
+        sys.exit(2)
+    rc, outputs = launch([sys.executable] + args, ranks)
+    for r, out in enumerate(outputs):
+        for line in out.splitlines():
+            print(f"[rank {r}] {line}")
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,8 +42,26 @@ REFERENCE = os.environ.get("PCG_REFERENCE_PATH", "/root/reference")
 SHIM = os.path.join(REPO, "tools", "mpi_shim")
 
 
-def _run(stage, argv, env):
+def _run(stage, argv, env, ranks=1):
     t0 = time.perf_counter()
+    if ranks > 1:
+        # real N-process run through the multi-rank shim's mpiexec
+        tools_dir = os.path.normpath(os.path.join(SHIM, os.pardir))
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        from mpi_shim.mpiexec import launch
+
+        rc, outputs = launch([sys.executable] + argv, ranks=ranks,
+                             cwd=stage, env=env, timeout=3600)
+        dt = time.perf_counter() - t0
+        if rc != 0:
+            tails = "\n".join(f"[rank {r}] {line}"
+                              for r, out in enumerate(outputs)
+                              for line in out.strip().splitlines()[-12:])
+            raise RuntimeError(
+                f"reference stage {argv[0]} failed at {ranks} ranks "
+                f"(rc={rc}):\n{tails}")
+        return dt, outputs[0]
     proc = subprocess.run([sys.executable] + argv, cwd=stage, env=env,
                           capture_output=True, text=True, timeout=3600)
     dt = time.perf_counter() - t0
@@ -127,9 +145,10 @@ def _compare_vtu_exports(stage, env, ref_scratch, model, store,
         "points_missing_in_ours": len(missing_pts),
         "u_max_rel_diff": u_d / scale,
     }
-    if mode == "Full":
-        # Full mode renumbers nothing on either side: the arrays must be
-        # BYTE-identical, not just geometry-equal
+    if mode in ("Full", "Delaunay"):
+        # Full/Delaunay renumber nothing on either side (and Delaunay is
+        # the same deterministic qhull run on the same coordinates): the
+        # arrays must be BYTE-identical, not just geometry-equal
         our_pts = our_raw.get("points", our_raw.get("Points"))
         out["points_max_abs_diff"] = float(
             np.abs(np.asarray(ref_raw["points"], float)
@@ -177,6 +196,15 @@ def main():
     ap.add_argument("--speedtest", type=int, default=1,
                     help="reference SpeedTestFlag (1 disables its exports "
                          "for clean timing — the reference's own method)")
+    ap.add_argument("--ranks", type=int, default=1,
+                    help="run the reference MULTI-RANK: run_metis builds a "
+                         "real k-way dual-graph partition (mgmetis stand-in "
+                         "backed by the framework's C++ partitioner), "
+                         "partition_mesh runs at min(4, ranks) workers and "
+                         "pcg_solver at RANKS workers (1 rank = 1 partition) "
+                         "through the multi-rank mpi_shim — exercising the "
+                         "reference's neighbor discovery, halo exchange and "
+                         "shared-memory windows as an oracle")
     ap.add_argument("--compare", action="store_true",
                     help="also solve the same MDF with this framework "
                          "(CPU) and report iteration parity")
@@ -186,13 +214,15 @@ def main():
                          "results and compare the .vtu content (implies "
                          "--compare; requires --speedtest 0)")
     ap.add_argument("--export-mode", nargs="+",
-                    choices=["Full", "Boundary", "MidSlices"],
+                    choices=["Full", "Boundary", "MidSlices", "Delaunay"],
                     default=["Full"],
                     help="export mode(s) for --export-compare, all served "
                          "from the ONE solve (Boundary exercises the "
                          "reference's PolysFlat incidence selection, "
-                         "MidSlices its per-face plane loop, vs this "
-                         "framework's vectorized selections)")
+                         "MidSlices its per-face plane loop, Delaunay its "
+                         "point-cloud tetrahedralization — export_vtk.py:"
+                         "178-215, NO geometric filtering on either side — "
+                         "vs this framework's vectorized selections)")
     args = ap.parse_args()
     if args.export_compare:
         args.compare = True
@@ -241,13 +271,22 @@ def main():
     env.pop("JAX_PLATFORMS", None)   # reference is numpy-only
     ref_scratch = os.path.join(scratch, "ref_scratch")
 
+    ranks = args.ranks
+    if ranks > 1 and ranks % 4 != 0:
+        # the reference hardcodes 4 loading ranks (partition_mesh.py:1409
+        # asserts multi-rank worker counts are multiples of 4)
+        ap.error(f"--ranks must be 1 or a multiple of 4, got {ranks}")
+    part_workers = 1 if ranks == 1 else min(4, ranks)
+
     stages = {}
     stages["ingest"], _ = _run(stage, [
         "src/data/read_input_model.py", stage, "cube", ref_scratch,
         archive], env)
-    stages["metis"], _ = _run(stage, ["src/solver/run_metis.py", "1"], env)
+    stages["metis"], _ = _run(stage, ["src/solver/run_metis.py",
+                                      str(ranks)], env)
     stages["partition"], _ = _run(stage, [
-        "src/solver/partition_mesh.py", "1", "0"], env)
+        "src/solver/partition_mesh.py", str(ranks), "0"], env,
+        ranks=part_workers)
 
     # GlobSettings in the reference's schema (run_basic_script.bash:30-49)
     import pickle
@@ -263,7 +302,8 @@ def main():
         f.write(zlib.compress(pickle.dumps(settings)))
 
     stages["solve"], out = _run(stage, [
-        "src/solver/pcg_solver.py", "1", str(args.speedtest)], env)
+        "src/solver/pcg_solver.py", "1", str(args.speedtest)], env,
+        ranks=ranks)
     print("# reference solver output tail:", file=sys.stderr)
     for line in out.strip().splitlines()[-8:]:
         print(f"#   {line}", file=sys.stderr)
@@ -290,8 +330,10 @@ def main():
             "comm_wait_s": round(float(td["Mean_CommWaitTime"]), 3),
             "ns_per_dof_iter": round(ns_per_dof_iter, 3),
             "stage_s": {k: round(v, 2) for k, v in stages.items()},
-            "ranks": 1,
-            "how": "reference code, single rank via tools/mpi_shim",
+            "ranks": ranks,
+            "how": (f"reference code, {ranks} real processes via the "
+                    "multi-rank tools/mpi_shim" if ranks > 1 else
+                    "reference code, single rank via tools/mpi_shim"),
         },
     }
 
