@@ -50,11 +50,24 @@ def main():
 
     xla = jax.jit(lambda d, xx: ops.matvec_local(d, xx))
     t_xla, y0 = timeit(xla, data, x)
-    print(f"xla:       {t_xla*1e3:8.3f} ms/matvec", flush=True)
+    print(f"xla (gse):    {t_xla*1e3:8.3f} ms/matvec", flush=True)
+
+    # corner form: the fusion-friendly XLA formulation (no (24, cells)
+    # intermediates — parallel/structured.py _gse_corner)
+    corner = jax.jit(lambda d, xx: ops._gse_corner(
+        d["blocks"][0], ops._grid(xx), d["blocks"][0]["ck"]).reshape(
+            xx.shape))
+    try:
+        t_c, y_c = timeit(corner, data, x)
+        err = float(jnp.abs(y_c - y0).max() / jnp.abs(y0).max())
+        print(f"xla (corner): {t_c*1e3:8.3f} ms/matvec  "
+              f"(vs gse {t_xla/t_c:5.2f}x, maxrelerr {err:.2e})", flush=True)
+    except Exception as e:                          # noqa: BLE001
+        print(f"xla (corner): FAILED {type(e).__name__}: {e}", flush=True)
 
     variants = [("pallas v1", structured_matvec_pallas),
                 ("pallas v2", structured_matvec_pallas_v2)]
-    for c in (2, 4, 8):
+    for c in (8, 16):
         variants.append((f"pallas v3 C={c}", functools.partial(
             structured_matvec_pallas_v3, planes=c)))
     for name, fn in variants:

@@ -419,6 +419,7 @@ def main():
         "mode": mode,
         "backend": solver.backend,
         "pallas": bool(pallas_on),
+        "matvec_form": os.environ.get("PCG_TPU_MATVEC_FORM", "gse"),
         "n_parts": n_parts,
         "partition_s": round(t_part, 2),
         "platform": jax.devices()[0].platform + (

@@ -260,14 +260,23 @@ class HybridOps(Ops):
         return y3.reshape(Pn, self.n_loc)
 
     def _stencil(self, Ke, ck, xg):
-        """Structured brick matvec on one level grid (same formulation as
-        parallel/structured.py: slice gather -> einsum -> sum of padded
-        translates; fused Pallas kernel when enabled)."""
+        """Structured brick matvec on one level grid (same formulations
+        as parallel/structured.py: slice gather -> einsum -> sum of
+        padded translates, the fusion-friendly corner form under
+        PCG_TPU_MATVEC_FORM=corner, or the fused Pallas kernel when
+        enabled)."""
         if self.use_pallas and np.dtype(xg.dtype) == np.float32:
             from pcg_mpi_solver_tpu.ops.pallas_matvec import (
                 batched_structured_matvec)
 
             return batched_structured_matvec(xg, ck, Ke)
+        import os
+
+        if os.environ.get("PCG_TPU_MATVEC_FORM", "gse") == "corner":
+            from pcg_mpi_solver_tpu.parallel.structured import (
+                corner_matvec_grid)
+
+            return corner_matvec_grid(Ke, ck, xg)
         bx, by, bz = ck.shape[1], ck.shape[2], ck.shape[3]
         slots = [xg[:, :, dx:dx + bx, dy:dy + by, dz:dz + bz]
                  for dx, dy, dz in _CORNERS]

@@ -175,6 +175,40 @@ def device_data_structured(sp: StructuredPartition, dtype=jnp.float64) -> dict:
 _CORNERS = HEX_CORNERS.astype(np.int64)  # (8, 3)
 
 
+def corner_matvec_grid(Ke, ck, xg):
+    """Fusion-friendly brick-grid matvec: no (24, cells) intermediates.
+
+    y = sum_b pad_b(sum_a Ke[3b:3b+3, 3a:3a+3] @ (ck * x_a)) with each
+    3x3 block unrolled to scalar-broadcast FMAs (static unroll — XLA
+    fuses the whole thing into slice-read -> FMA -> pad-accumulate
+    chains), landing on the node grid as zero-padded translates.  Shared
+    by the structured slab backend (_gse corner form) and the hybrid
+    level-grid stencil.
+
+    Ke (24, 24); ck (P, cx, cy, cz); xg (P, 3, cx+1, cy+1, cz+1)."""
+    cx, cy, cz = ck.shape[1], ck.shape[2], ck.shape[3]
+    w = []
+    for a in range(8):
+        dx, dy, dz = _CORNERS[a]
+        w.append(ck[:, None] * xg[:, :, dx:dx + cx, dy:dy + cy, dz:dz + cz])
+    y = None
+    for b in range(8):
+        ex, ey, ez = _CORNERS[b]
+        comps = []
+        for d in range(3):
+            acc = None
+            for a in range(8):
+                for c in range(3):
+                    t = Ke[3 * b + d, 3 * a + c] * w[a][:, c]
+                    acc = t if acc is None else acc + t
+            comps.append(acc)
+        vb = jnp.stack(comps, axis=1)                  # (P, 3, cells)
+        term = jnp.pad(vb, ((0, 0), (0, 0), (ex, 1 - ex),
+                            (ey, 1 - ey), (ez, 1 - ez)))
+        y = term if y is None else y + term
+    return y
+
+
 @dataclasses.dataclass(frozen=True)
 class StructuredOps(Ops):
     """Same operator protocol as Ops, slab-structured implementation."""
@@ -284,11 +318,34 @@ class StructuredOps(Ops):
         return 0
 
     def _gse(self, blk, xg, ck):
-        """gather -> Ke einsum -> scatter on one x-slab (the whole matvec)."""
+        """One slab matvec; two XLA formulations, env-selected.
+
+        - ``gse`` (default): gather -> one (24,24)@(24,cells) MXU einsum
+          -> scatter.  Materializes the gathered corner array and the
+          product — two (24, cells) HBM round-trips (~650 MB each way at
+          10M dofs).
+        - ``corner`` (PCG_TPU_MATVEC_FORM=corner): per-output-corner
+          accumulation y = sum_b pad_b(sum_a Ke[3b:3b+3, 3a:3a+3] @
+          (ck * x_a)), with each 3x3 block unrolled to scalar
+          multiply-adds so XLA fuses the whole thing into
+          slice-read -> FMA -> pad-accumulate chains and NO (24, cells)
+          intermediate ever exists.  Trades the single big MXU matmul
+          (arithmetic intensity ~12 flops/byte — far below the MXU
+          roofline anyway; the op is HBM-bound) for ~4x less HBM
+          traffic.  Read at trace time: toggling after a solver
+          compiled does not retrace (build a new Solver to switch).
+        """
+        import os
+
+        if os.environ.get("PCG_TPU_MATVEC_FORM", "gse") == "corner":
+            return self._gse_corner(blk, xg, ck)
         u = self._gather_cells(xg)                     # (P, 24, cells)
         v = jnp.einsum("de,pexyz->pdxyz", blk["Ke"], ck[:, None] * u,
                        precision=self.precision)
         return self._scatter_cells(v)
+
+    def _gse_corner(self, blk, xg, ck):
+        return corner_matvec_grid(blk["Ke"], ck, xg)
 
     def matvec_local(self, data, x):
         blk = data["blocks"][0]

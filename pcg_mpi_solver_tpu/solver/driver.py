@@ -62,7 +62,10 @@ def _pallas_enabled(mode: str, mesh, shapes=()) -> bool:
     from pcg_mpi_solver_tpu.ops.pallas_matvec import (
         probe_shapes, selected_variant)
 
-    key = (d.platform, selected_variant()[0], tuple(shapes))
+    # the planes knob changes what the v3 variant lowers to, so a probe
+    # cached under one value must not vouch for another
+    key = (d.platform, selected_variant()[0],
+           os.environ.get("PCG_TPU_PALLAS_PLANES", "8"), tuple(shapes))
     if key not in _PALLAS_PROBE:
         try:
             probe_shapes(list(shapes) or [((3, 3, 3, 3), (2, 2, 2))])

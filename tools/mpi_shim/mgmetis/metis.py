@@ -35,11 +35,13 @@ def part_mesh_dual(nparts, cells, vwgt=None, ncommon=1, **_kw):
     eind = np.concatenate([np.asarray(c, dtype=np.int64) for c in cells])
     n_node = int(eind.max()) + 1 if eind.size else 0
 
+    # one numpy dual-graph build serves both the fallback partition and
+    # the edge-cut objval (it is the dominant cost of this function)
+    xadj, adjncy = native.build_dual_graph_np(eptr, eind, n_node,
+                                              ncommon=int(ncommon))
     epart = native.part_mesh_dual(eptr, eind, n_node, int(nparts),
                                   ncommon=int(ncommon))
     if epart is None:
-        xadj, adjncy = native.build_dual_graph_np(eptr, eind, n_node,
-                                                  ncommon=int(ncommon))
         epart = _greedy_parts(xadj, adjncy, int(nparts))
     epart = np.asarray(epart, dtype=np.int64)
 
@@ -53,8 +55,6 @@ def part_mesh_dual(nparts, cells, vwgt=None, ncommon=1, **_kw):
     npart[~seen] = 0
 
     # objval: dual-graph edge cut of the produced partition
-    xadj, adjncy = native.build_dual_graph_np(eptr, eind, n_node,
-                                              ncommon=int(ncommon))
     objval = int(native.edge_cut(xadj, adjncy, epart))
     return objval, epart, npart
 

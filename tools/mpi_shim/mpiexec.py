@@ -41,6 +41,11 @@ def launch(argv, ranks: int, cwd=None, env=None, timeout=3600):
     env["MPI_SHIM_SIZE"] = str(ranks)
     env["MPI_SHIM_SOCK"] = sock
     env["MPI_SHIM_JOBDIR"] = jobdir
+    # the ranks must resolve `import mpi4py` to THIS shim regardless of
+    # how the launcher was invoked (mpi4py is not installed in the image)
+    pp = env.get("PYTHONPATH", "")
+    if shim_dir not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = (shim_dir + os.pathsep + pp) if pp else shim_dir
 
     procs = []
     logs = []

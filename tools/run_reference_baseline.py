@@ -191,6 +191,14 @@ def main():
                     help="octree: 2:1-graded mesh with multiple pattern "
                          "types and sign vectors — the reference's actual "
                          "problem class")
+    ap.add_argument("--level", type=int, default=2,
+                    help="octree max refinement level (deeper grading -> "
+                         "more simultaneous edge+face hanging-node pattern "
+                         "types; level 4 with --incl 8 produces 170+ "
+                         "distinct types, the reference's <=144-type "
+                         "regime, partition_mesh.py:1074)")
+    ap.add_argument("--incl", type=int, default=2,
+                    help="octree inclusion count (refinement seeds)")
     ap.add_argument("--tol", type=float, default=1e-7)
     ap.add_argument("--scratch", default=None)
     ap.add_argument("--speedtest", type=int, default=1,
@@ -251,7 +259,8 @@ def main():
     if args.model == "octree":
         from pcg_mpi_solver_tpu.models.octree import make_octree_model
 
-        model = make_octree_model(n, n, n, max_level=2, n_incl=2, seed=3,
+        model = make_octree_model(n, n, n, max_level=args.level,
+                                  n_incl=args.incl, seed=3,
                                   E=30e9, nu=0.2, load="traction",
                                   load_value=1e6)
     else:
