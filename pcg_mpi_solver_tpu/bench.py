@@ -381,6 +381,9 @@ def main():
     dtype = os.environ.get("BENCH_DTYPE", "float32")
     n_parts = int(os.environ.get("BENCH_PARTS", len(jax.devices())))
 
+    from pcg_mpi_solver_tpu.parallel.structured import (
+        matvec_form as _matvec_form)
+
     ladder = _ladder(kind, cpu_fallback)
     # loop invariant: reaching the emit below implies the LAST iteration
     # assigned all of these (every failure path raises or re-execs)
@@ -419,7 +422,7 @@ def main():
         "mode": mode,
         "backend": solver.backend,
         "pallas": bool(pallas_on),
-        "matvec_form": os.environ.get("PCG_TPU_MATVEC_FORM", "gse"),
+        "matvec_form": _matvec_form(),
         "n_parts": n_parts,
         "partition_s": round(t_part, 2),
         "platform": jax.devices()[0].platform + (

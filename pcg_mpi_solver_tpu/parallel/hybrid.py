@@ -270,12 +270,10 @@ class HybridOps(Ops):
                 batched_structured_matvec)
 
             return batched_structured_matvec(xg, ck, Ke)
-        import os
+        from pcg_mpi_solver_tpu.parallel.structured import (
+            corner_matvec_grid, matvec_form)
 
-        if os.environ.get("PCG_TPU_MATVEC_FORM", "gse") == "corner":
-            from pcg_mpi_solver_tpu.parallel.structured import (
-                corner_matvec_grid)
-
+        if matvec_form() == "corner":
             return corner_matvec_grid(Ke, ck, xg)
         bx, by, bz = ck.shape[1], ck.shape[2], ck.shape[3]
         slots = [xg[:, :, dx:dx + bx, dy:dy + by, dz:dz + bz]

@@ -175,6 +175,19 @@ def device_data_structured(sp: StructuredPartition, dtype=jnp.float64) -> dict:
 _CORNERS = HEX_CORNERS.astype(np.int64)  # (8, 3)
 
 
+def matvec_form() -> str:
+    """The PCG_TPU_MATVEC_FORM knob, validated — the ONE place its
+    name/default/valid values live (read at trace time by the structured
+    and hybrid matvecs; reported by bench.py)."""
+    import os
+
+    form = os.environ.get("PCG_TPU_MATVEC_FORM", "gse")
+    if form not in ("gse", "corner"):
+        raise ValueError(
+            f"PCG_TPU_MATVEC_FORM must be 'gse' or 'corner', got {form!r}")
+    return form
+
+
 def corner_matvec_grid(Ke, ck, xg):
     """Fusion-friendly brick-grid matvec: no (24, cells) intermediates.
 
@@ -335,9 +348,7 @@ class StructuredOps(Ops):
           traffic.  Read at trace time: toggling after a solver
           compiled does not retrace (build a new Solver to switch).
         """
-        import os
-
-        if os.environ.get("PCG_TPU_MATVEC_FORM", "gse") == "corner":
+        if matvec_form() == "corner":
             return self._gse_corner(blk, xg, ck)
         u = self._gather_cells(xg)                     # (P, 24, cells)
         v = jnp.einsum("de,pexyz->pdxyz", blk["Ke"], ck[:, None] * u,
