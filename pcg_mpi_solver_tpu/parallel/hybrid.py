@@ -34,6 +34,7 @@ node by construction.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List, Optional
 
 import jax
@@ -48,17 +49,31 @@ from pcg_mpi_solver_tpu.parallel.partition import (
 
 @dataclasses.dataclass
 class LevelGrid:
-    """One refinement level's brick cells on a dense per-part grid."""
+    """One refinement level's brick cells as a BATCH of dense blocks.
+
+    A graded octree's per-level bounding box is mostly holes at scale
+    (measured 3.7% fill on the 5.67M-dof flagship's finest level — 96%
+    of a dense-bbox stencil would be wasted compute), so each level is
+    tiled into bs^3-cell blocks and only blocks containing at least one
+    brick are kept (5.8x total-cell reduction on that flagship at
+    bs=8).  Small or well-filled levels keep a single dense-bbox block
+    (nb == 1, dims == bbox) — the tiled and dense layouts are the same
+    code path with different dims.
+
+    Parts are padded to a common block count nb; padding blocks have
+    ck = 0 and nidx = pad, so they compute and scatter exactly nothing.
+    """
 
     size: int                   # cell edge length in finest lattice units
-    bx: int                     # cell-grid dims (common, padded over parts)
+    nb: int                     # blocks per part (common, padded)
+    bx: int                     # per-BLOCK cell dims
     by: int
     bz: int
-    origin: np.ndarray          # (P, 3) lattice origin in LEVEL units
-    ck: np.ndarray              # (P, bx, by, bz); 0 = hole
-    ce: np.ndarray              # (P, bx, by, bz)
-    nidx: np.ndarray            # (P, (bx+1)*(by+1)*(bz+1)) int32 local node
-                                # ids, n_node_loc = pad
+    origin: np.ndarray          # (P, nb, 3) block origin in LEVEL units
+    ck: np.ndarray              # (P, nb, bx, by, bz); 0 = hole
+    ce: np.ndarray              # (P, nb, bx, by, bz)
+    nidx: np.ndarray            # (P, nb, (bx+1)*(by+1)*(bz+1)) int32 local
+                                # node ids, n_node_loc = pad
     n_cells: np.ndarray         # (P,) true brick count per part
 
 
@@ -89,17 +104,43 @@ def can_hybrid(model: ModelData) -> bool:
             and model.octree.get("brick_type") is not None)
 
 
+# batched_structured_matvec launches the kernel once per leading-batch
+# entry (part*block); beyond this many launches per level the XLA
+# stencil wins on dispatch overhead alone.  ONE constant shared by the
+# probe/enable decision and the per-level trace-time dispatch.
+PALLAS_BATCH_CAP = 16
+
+
+def local_parts(n_parts: int, mesh) -> int:
+    """Parts resident per device (the stencil's leading batch is
+    local_parts * blocks under shard_map)."""
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    return max(1, -(-int(n_parts) // n_dev))
+
+
 def hybrid_pallas_enabled(hp: "HybridPartition", pallas_mode: str,
                           mesh) -> bool:
     """Resolve the pallas knob with THIS partition's level-grid shapes —
     the one shared probe call for every hybrid consumer (quasi-static
-    driver, dynamics)."""
+    driver, dynamics).  Only levels whose part*block batch fits the
+    per-launch cap are probed (the others always run the XLA stencil);
+    if no level qualifies the kernel is declined outright."""
     from pcg_mpi_solver_tpu.solver.driver import _pallas_enabled
 
-    return _pallas_enabled(
-        pallas_mode, mesh,
-        shapes=tuple(((3, lv.bx + 1, lv.by + 1, lv.bz + 1),
-                      (lv.bx, lv.by, lv.bz)) for lv in hp.levels))
+    lp = local_parts(hp.pm.n_parts, mesh)
+    shapes = tuple(sorted(set(
+        ((3, lv.bx + 1, lv.by + 1, lv.bz + 1), (lv.bx, lv.by, lv.bz))
+        for lv in hp.levels if lp * lv.nb <= PALLAS_BATCH_CAP)))
+    if not shapes:
+        if pallas_mode == "on":
+            import warnings
+
+            warnings.warn(
+                "pallas='on' but every hybrid level's part*block batch "
+                f"exceeds the {PALLAS_BATCH_CAP}-launch cap; using the "
+                "XLA stencils")
+        return False
+    return _pallas_enabled(pallas_mode, mesh, shapes=shapes)
 
 
 def partition_hybrid(model: ModelData, n_parts: int,
@@ -126,41 +167,77 @@ def partition_hybrid(model: ModelData, n_parts: int,
 
     P = n_parts
     lib = model.elem_lib[bt]
+    bs_knob = int(os.environ.get("PCG_TPU_HYBRID_BLOCK", "8"))
     levels: List[LevelGrid] = []
     for s in sorted(int(v) for v in np.unique(leaves[brick, 3])):
         sel_lvl = brick & (leaves[:, 3] == s)
         per_part = [np.where(sel_lvl & (elem_part == p))[0] for p in range(P)]
         # level-unit cell coords (octree cells of size s are s-aligned)
         lat = [leaves[e, :3] // s for e in per_part]
-        lo = np.zeros((P, 3), dtype=np.int64)
-        dims = np.zeros((P, 3), dtype=np.int64)
+
+        # choose this level's block dims: a single dense bbox block when
+        # that is no larger than the bs^3 tiling would be, else bs^3
+        # tiles of only the occupied blocks (absolute bs-aligned ids, so
+        # dims stay common across parts).  One key-sort per part serves
+        # both the decision and the fill below.
+        ext = np.zeros(3, dtype=np.int64)
+        bmax = 1
+        blocks = [None] * P      # (uniq_block_keys, binv) per part
         for p in range(P):
-            if len(per_part[p]):
-                lo[p] = lat[p].min(axis=0)
-                dims[p] = lat[p].max(axis=0) + 1 - lo[p]
-        bx, by, bz = (int(d) for d in dims.max(axis=0))
-        if bx == 0:
+            if not len(per_part[p]):
+                continue
+            lo_p = lat[p].min(axis=0)
+            ext = np.maximum(ext, lat[p].max(axis=0) + 1 - lo_p)
+            bid = lat[p] // bs_knob
+            uniq, binv = np.unique(
+                (bid[:, 0] << 42) + (bid[:, 1] << 21) + bid[:, 2],
+                return_inverse=True)
+            blocks[p] = (uniq, binv)
+            bmax = max(bmax, len(uniq))
+        if not ext.any():
             continue
-        ck = np.zeros((P, bx, by, bz))
-        ce = np.zeros((P, bx, by, bz))
+        # the dense layout allocates prod(ext) of the COMMON (padded)
+        # extents for every part — that, not any single part's bbox, is
+        # what tiling competes against
+        if int(np.prod(ext)) <= bmax * bs_knob ** 3:
+            nb, (bx, by, bz) = 1, (int(ext[0]), int(ext[1]), int(ext[2]))
+            tiled = False
+        else:
+            nb, (bx, by, bz) = bmax, (bs_knob,) * 3
+            tiled = True
+
+        ck = np.zeros((P, nb, bx, by, bz))
+        ce = np.zeros((P, nb, bx, by, bz))
         nn = (bx + 1) * (by + 1) * (bz + 1)
-        nidx = np.full((P, nn), pm.n_node_loc, dtype=np.int32)
+        nidx = np.full((P, nb, nn), pm.n_node_loc, dtype=np.int32)
+        origin = np.zeros((P, nb, 3), dtype=np.int64)
         n_cells = np.zeros(P, dtype=np.int64)
         II, JJ, KK = np.meshgrid(np.arange(bx + 1), np.arange(by + 1),
                                  np.arange(bz + 1), indexing="ij")
+        lat_nodes = np.stack([II, JJ, KK], axis=-1).reshape(-1, 3)  # (nn, 3)
         for p in range(P):
             e = per_part[p]
             n_cells[p] = len(e)
             if not len(e):
                 continue
-            c = lat[p] - lo[p]
-            ck[p, c[:, 0], c[:, 1], c[:, 2]] = model.ck[e]
-            ce[p, c[:, 0], c[:, 1], c[:, 2]] = model.ce[e]
-            # node lattice -> local node ids (missing / non-local -> pad)
-            gx = (II + lo[p, 0]) * s
-            gy = (JJ + lo[p, 1]) * s
-            gz = (KK + lo[p, 2]) * s
-            keys = (gx + sy * gy + sz * gz).reshape(-1)
+            if tiled:
+                uniq, binv = blocks[p]
+                blk_origin = np.stack([uniq >> 42, (uniq >> 21) & ((1 << 21) - 1),
+                                       uniq & ((1 << 21) - 1)],
+                                      axis=-1) * bs_knob      # (B_p, 3)
+                c = lat[p] - blk_origin[binv]
+            else:
+                blk_origin = lat[p].min(axis=0)[None]          # (1, 3)
+                binv = np.zeros(len(e), dtype=np.int64)
+                c = lat[p] - blk_origin[0]
+            B_p = len(blk_origin)
+            origin[p, :B_p] = blk_origin
+            ck[p, binv, c[:, 0], c[:, 1], c[:, 2]] = model.ck[e]
+            ce[p, binv, c[:, 0], c[:, 1], c[:, 2]] = model.ce[e]
+            # node lattice -> local node ids (missing / non-local -> pad),
+            # vectorized over this part's blocks
+            g = (blk_origin[:, None, :] + lat_nodes[None]) * s   # (B_p, nn, 3)
+            keys = (g[..., 0] + sy * g[..., 1] + sz * g[..., 2]).reshape(-1)
             kpos = np.searchsorted(node_keys, keys)
             kpos_c = np.minimum(kpos, len(node_keys) - 1)
             is_node = node_keys[kpos_c] == keys
@@ -169,9 +246,10 @@ def partition_hybrid(model: ModelData, n_parts: int,
             lpos = np.searchsorted(loc_gids, np.where(gnid < 0, 0, gnid))
             lpos_c = np.minimum(lpos, len(loc_gids) - 1)
             is_loc = is_node & (loc_gids[lpos_c] == gnid)
-            nidx[p] = np.where(is_loc, lpos_c, pm.n_node_loc).astype(np.int32)
-        levels.append(LevelGrid(size=s, bx=bx, by=by, bz=bz,
-                                origin=lo, ck=ck, ce=ce,
+            nidx[p, :B_p] = np.where(is_loc, lpos_c, pm.n_node_loc) \
+                .astype(np.int32).reshape(B_p, nn)
+        levels.append(LevelGrid(size=s, nb=nb, bx=bx, by=by, bz=bz,
+                                origin=origin, ck=ck, ce=ce,
                                 nidx=nidx, n_cells=n_cells))
 
     return HybridPartition(
@@ -210,26 +288,34 @@ class HybridOps(Ops):
     """General Ops over the transition blocks + dense level-grid stencils
     for the brick cells of each refinement level."""
 
-    # static (bx, by, bz) per level — shapes must be trace-constants
+    # static (nb, bx, by, bz) per level — shapes must be trace-constants
     level_dims: tuple = ()
     # run the f32 level stencils through the fused Pallas plane-march
     # kernel (ops/pallas_matvec.py) — same kernel as the structured backend
     use_pallas: bool = False
+    # per-level kernel eligibility (part*block batch within the launch
+    # cap), resolved at construction so the trace-time dispatch agrees
+    # with hybrid_pallas_enabled's probe
+    pallas_levels: tuple = ()
 
     @classmethod
     def from_hybrid(cls, hp: HybridPartition, dot_dtype=jnp.float64,
                     axis_name=None,
                     precision=jax.lax.Precision.HIGHEST,
-                    use_pallas=False):
+                    use_pallas=False, n_local_parts=1):
         pm = hp.pm
         return cls(n_loc=pm.n_loc, n_iface=pm.n_iface,
                    n_node_loc=pm.n_node_loc, n_node_iface=pm.n_node_iface,
                    dot_dtype=dot_dtype, axis_name=axis_name,
                    precision=precision,
                    use_node_ell=pm.ell is not None,
-                   level_dims=tuple((lv.bx, lv.by, lv.bz)
+                   level_dims=tuple((lv.nb, lv.bx, lv.by, lv.bz)
                                     for lv in hp.levels),
-                   use_pallas=use_pallas)
+                   use_pallas=use_pallas,
+                   pallas_levels=tuple(
+                       use_pallas
+                       and n_local_parts * lv.nb <= PALLAS_BATCH_CAP
+                       for lv in hp.levels))
 
     # -- level-grid primitives -----------------------------------------
     def _rows_pad(self, x):
@@ -241,31 +327,33 @@ class HybridOps(Ops):
         ).reshape(Pn * (self.n_node_loc + 1), 3)
 
     def _level_gather(self, x3p, lv, dims, Pn):
-        """Node-lattice gather: (P, 3, bx+1, by+1, bz+1) grid."""
-        bx, by, bz = dims
+        """Node-lattice gather: (P*nb, 3, bx+1, by+1, bz+1) block batch."""
+        nb, bx, by, bz = dims
         nr = self.n_node_loc + 1
-        offs = (jnp.arange(Pn, dtype=jnp.int32) * nr)[:, None]
+        offs = (jnp.arange(Pn, dtype=jnp.int32) * nr)[:, None, None]
         g = jnp.take(x3p, (lv["nidx"] + offs).reshape(-1), axis=0,
                      mode="clip")
-        g = g.reshape(Pn, bx + 1, by + 1, bz + 1, 3)
+        g = g.reshape(Pn * nb, bx + 1, by + 1, bz + 1, 3)
         return g.transpose(0, 4, 1, 2, 3)
 
     def _level_scatter_add(self, y, grid, lv, dims, Pn):
-        """Adds (P, 3, bx+1, by+1, bz+1) node-grid values into y (P, n_loc)."""
+        """Adds (P*nb, 3, bx+1, by+1, bz+1) block-batch node-grid values
+        into y (P, n_loc).  Block-boundary lattice nodes appear in every
+        adjacent block's lattice; the row scatter accumulates them."""
         rows = grid.transpose(0, 2, 3, 4, 1).reshape(Pn, -1, 3)
         y3 = y.reshape(Pn, self.n_node_loc, 3)
         y3 = jax.vmap(
             lambda yp, idx, r: yp.at[idx].add(r, mode="drop")
-        )(y3, lv["nidx"], rows)
+        )(y3, lv["nidx"].reshape(Pn, -1), rows)
         return y3.reshape(Pn, self.n_loc)
 
-    def _stencil(self, Ke, ck, xg):
+    def _stencil(self, Ke, ck, xg, pallas_ok=False):
         """Structured brick matvec on one level grid (same formulations
         as parallel/structured.py: slice gather -> einsum -> sum of
         padded translates, the fusion-friendly corner form under
-        PCG_TPU_MATVEC_FORM=corner, or the fused Pallas kernel when
-        enabled)."""
-        if self.use_pallas and np.dtype(xg.dtype) == np.float32:
+        PCG_TPU_MATVEC_FORM=corner, or the fused Pallas kernel when this
+        level is flagged eligible in ``pallas_levels``)."""
+        if pallas_ok and np.dtype(xg.dtype) == np.float32:
             from pcg_mpi_solver_tpu.ops.pallas_matvec import (
                 batched_structured_matvec)
 
@@ -300,9 +388,11 @@ class HybridOps(Ops):
             y = self._apply_springs(data, x, jnp.zeros_like(x))
         if data["levels"]:
             x3p = self._rows_pad(x)
-            for lv, dims in zip(data["levels"], self.level_dims):
+            pal = self.pallas_levels or (False,) * len(data["levels"])
+            for lv, dims, pok in zip(data["levels"], self.level_dims, pal):
                 xg = self._level_gather(x3p, lv, dims, Pn)
-                yg = self._stencil(data["brick_Ke"], lv["ck"], xg)
+                ck = lv["ck"].reshape((Pn * dims[0],) + lv["ck"].shape[2:])
+                yg = self._stencil(data["brick_Ke"], ck, xg, pallas_ok=pok)
                 y = self._level_scatter_add(y, yg, lv, dims, Pn)
         return y
 
@@ -314,7 +404,7 @@ class HybridOps(Ops):
             y = self._apply_springs_diag(
                 data, jnp.zeros((Pn, self.n_loc), data["weight"].dtype))
         for lv, dims in zip(data["levels"], self.level_dims):
-            ck = lv["ck"]
+            ck = lv["ck"].reshape((Pn * dims[0],) + lv["ck"].shape[2:])
             dk = data["brick_diag"]
             terms = []
             for a, (dx, dy, dz) in enumerate(_CORNERS):
@@ -343,13 +433,13 @@ class HybridOps(Ops):
         from pcg_mpi_solver_tpu.ops.precond import corner_block_field
 
         for lv, dims in zip(data["levels"], self.level_dims):
-            ck = lv["ck"]
-            Pn = ck.shape[0]
+            Pn = lv["ck"].shape[0]
+            ck = lv["ck"].reshape((Pn * dims[0],) + lv["ck"].shape[2:])
             g = corner_block_field(data["brick_Ke"], ck, _CORNERS)
             rows = g.transpose(0, 2, 3, 4, 1).reshape(Pn, -1, 9)
             y = jax.vmap(
                 lambda yp, idx, r: yp.at[idx].add(r, mode="drop")
-            )(y, lv["nidx"], rows)
+            )(y, lv["nidx"].reshape(Pn, -1), rows)
         return y
 
     # -- export protocol (strain + nodal averaging over blocks + levels) --
@@ -363,13 +453,17 @@ class HybridOps(Ops):
             x3p = self._rows_pad(x)
             for lv, dims in zip(data["levels"], self.level_dims):
                 xg = self._level_gather(x3p, lv, dims, Pn)
-                bx, by, bz = dims
+                nb, bx, by, bz = dims
                 slots = [xg[:, :, dx:dx + bx, dy:dy + by, dz:dz + bz]
                          for dx, dy, dz in _CORNERS]
                 u = jnp.concatenate(slots, axis=1)
+                ce = lv["ce"].reshape((Pn * nb,) + lv["ce"].shape[2:])
                 eps = jnp.einsum("sd,pdxyz->psxyz", data["brick_Se"],
-                                 lv["ce"][:, None] * u,
+                                 ce[:, None] * u,
                                  precision=self.precision)
+                # (P*nb, 6, cells) -> (P, 6, nb*cells): per-part cell
+                # order stays aligned with elem_scale/nodal_average
+                eps = eps.reshape(Pn, nb, 6, -1).transpose(0, 2, 1, 3)
                 out.append(eps.reshape(Pn, 6, -1))
         return out
 
@@ -406,10 +500,12 @@ class HybridOps(Ops):
 
         for lv, dims, vals in zip(data["levels"], self.level_dims,
                                   vals_list[nb:]):
-            bx, by, bz = dims
-            vg = vals.reshape(Pl, k, bx, by, bz)
+            lnb, bx, by, bz = dims
+            vg = vals.reshape(Pl, k, lnb, bx, by, bz) \
+                .transpose(0, 2, 1, 3, 4, 5).reshape(Pl * lnb, k, bx, by, bz)
             # valid-cell mask: holes (ck == 0) must not count
-            valid = (lv["ck"] != 0).astype(dt)[:, None]
+            valid = (lv["ck"].reshape(Pl * lnb, bx, by, bz) != 0) \
+                .astype(dt)[:, None]
             both = jnp.concatenate([vg * valid, valid], axis=1)
             terms = []
             for dx, dy, dz in _CORNERS:
@@ -418,13 +514,13 @@ class HybridOps(Ops):
                            (dz, 1 - dz))))
             g = terms[0]
             for t in terms[1:]:
-                g = g + t                       # (P, k+1, node grid)
+                g = g + t                       # (P*nb, k+1, node grid)
             rows = g.transpose(0, 2, 3, 4, 1).reshape(Pl, -1, k + 1)
             joined = jnp.concatenate([sums, counts], axis=1) \
                 .transpose(0, 2, 1)             # (P, n_node_loc, k+1)
             joined = jax.vmap(
                 lambda jp, idx, r: jp.at[idx].add(r, mode="drop")
-            )(joined, lv["nidx"], rows)
+            )(joined, lv["nidx"].reshape(Pl, -1), rows)
             joined = joined.transpose(0, 2, 1)
             sums, counts = joined[:, :k], joined[:, k:]
 

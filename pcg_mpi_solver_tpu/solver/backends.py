@@ -36,13 +36,15 @@ def select_time_backend(model: ModelData, n_parts: int, *,
     if backend in ("auto", "hybrid") and can_hybrid(model):
         from pcg_mpi_solver_tpu.parallel.hybrid import (
             HybridOps, device_data_hybrid, hybrid_pallas_enabled,
-            partition_hybrid)
+            local_parts, partition_hybrid)
 
         pm = partition_hybrid(model, n_parts, method=partition_method)
         use_pallas = kernels_f32 and hybrid_pallas_enabled(
             pm, pallas_mode, mesh)
+        lp = local_parts(n_parts, mesh)
         mk_ops = lambda dd: HybridOps.from_hybrid(
-            pm, dot_dtype=dd, axis_name=PARTS_AXIS, use_pallas=use_pallas)
+            pm, dot_dtype=dd, axis_name=PARTS_AXIS, use_pallas=use_pallas,
+            n_local_parts=lp)
         return "hybrid", pm, mk_ops, lambda dt: device_data_hybrid(pm, dt)
 
     pm = partition_model(model, n_parts, method=partition_method)

@@ -212,13 +212,16 @@ class Solver:
                     selected_variant)
 
                 self.pallas_variant = selected_variant()[0]
+            from pcg_mpi_solver_tpu.parallel.hybrid import local_parts
+
+            lp = local_parts(n_parts, self.mesh)
             self.ops = HybridOps.from_hybrid(
                 self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS,
-                use_pallas=use_pallas)
+                use_pallas=use_pallas, n_local_parts=lp)
             data = device_data_hybrid(self.pm, dtype)
             ops32_factory = lambda: HybridOps.from_hybrid(
                 self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS,
-                use_pallas=use_pallas)
+                use_pallas=use_pallas, n_local_parts=lp)
         else:
             self.pm = partition_model(model, n_parts, elem_part=elem_part,
                                       method=self.config.partition_method)
