@@ -50,7 +50,10 @@ Env knobs: BENCH_NX/NY/NZ (cells), BENCH_TOL, BENCH_PARTS, BENCH_DTYPE,
 BENCH_MODE (mixed|direct), BENCH_BACKEND (auto|structured|general),
 BENCH_REF_ITERS, BENCH_REF_MAX_DOFS, BENCH_MODEL (cube|octree),
 BENCH_OT_N, BENCH_OT_LEVEL, BENCH_PROBE_BUDGET_S, BENCH_LADDER,
-BENCH_OT_LADDER, BENCH_CPU_FALLBACK, BENCH_REF_TIMEOUT_S.
+BENCH_OT_LADDER, BENCH_CPU_FALLBACK, BENCH_REF_TIMEOUT_S; plus the
+solver-level performance knobs PCG_TPU_MATVEC_FORM / PCG_TPU_PALLAS_V /
+PCG_TPU_PALLAS_PLANES / PCG_TPU_HYBRID_BLOCK (docs/RUNBOOK.md knob
+table) — the engaged form is reported in detail.matvec_form.
 """
 
 import json
