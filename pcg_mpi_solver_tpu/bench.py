@@ -425,7 +425,11 @@ def main():
         "mode": mode,
         "backend": solver.backend,
         "pallas": bool(pallas_on),
-        "matvec_form": _matvec_form(),
+        # the form knob only applies to the stencil backends; a
+        # general-backend solve must not be attributed to it
+        "matvec_form": (_matvec_form()
+                        if solver.backend in ("structured", "hybrid")
+                        else "n/a"),
         "n_parts": n_parts,
         "partition_s": round(t_part, 2),
         "platform": jax.devices()[0].platform + (

@@ -1,0 +1,116 @@
+"""Unit coverage for bench.py's resilience machinery — the code that
+stands between the round's one driver-captured perf artifact and
+infrastructure weather.  Pure logic tests (no solver, no accelerator)."""
+
+import os
+from unittest import mock
+
+import pytest
+
+from pcg_mpi_solver_tpu import bench
+
+
+def _clear_bench_env(monkeypatch):
+    for k in list(os.environ):
+        if k.startswith("BENCH_") or k.startswith("PCG_TPU_"):
+            monkeypatch.delenv(k, raising=False)
+
+
+def test_ladder_cube_default(monkeypatch):
+    _clear_bench_env(monkeypatch)
+    assert bench._ladder("cube", False) == [
+        (150, 150, 150, 0, 0), (128, 128, 128, 0, 0), (96, 96, 96, 0, 0)]
+
+
+def test_ladder_explicit_pin_wins(monkeypatch):
+    _clear_bench_env(monkeypatch)
+    monkeypatch.setenv("BENCH_LADDER", "100,50")
+    monkeypatch.setenv("BENCH_NX", "64")
+    assert bench._ladder("cube", False) == [(64, 64, 64, 0, 0)]
+
+
+def test_ladder_tolerates_sloppy_spec(monkeypatch):
+    """A trailing comma or spaces must not crash the artifact run."""
+    _clear_bench_env(monkeypatch)
+    monkeypatch.setenv("BENCH_LADDER", " 100 , 50 , ")
+    assert bench._ladder("cube", False) == [
+        (100, 100, 100, 0, 0), (50, 50, 50, 0, 0)]
+    monkeypatch.setenv("BENCH_LADDER", ",,")
+    with pytest.raises(ValueError, match="no sizes"):
+        bench._ladder("cube", False)
+
+
+def test_ladder_octree_pin_beats_ladder(monkeypatch):
+    _clear_bench_env(monkeypatch)
+    monkeypatch.setenv("BENCH_OT_LADDER", "14,8")
+    monkeypatch.setenv("BENCH_OT_N", "10")
+    monkeypatch.setenv("BENCH_OT_LEVEL", "3")
+    assert bench._ladder("octree", False) == [(0, 0, 0, 10, 3)]
+
+
+def test_ladder_cpu_fallback_is_small(monkeypatch):
+    """CPU fallback must ignore flagship-size envs (a 150^3 CPU solve
+    would blow the driver's wall budget — the exact failure the
+    fallback exists to avoid)."""
+    _clear_bench_env(monkeypatch)
+    monkeypatch.setenv("BENCH_NX", "150")
+    monkeypatch.setenv("BENCH_NY", "150")
+    monkeypatch.setenv("BENCH_NZ", "150")
+    assert bench._ladder("cube", True) == [(48, 48, 48, 0, 0)]
+    monkeypatch.setenv("BENCH_OT_N", "22")
+    assert bench._ladder("octree", True) == [
+        (0, 0, 0, 6, int(os.environ.get("BENCH_OT_LEVEL", 4)))]
+
+
+def test_probe_retry_waits_out_timeouts(monkeypatch):
+    """Transient tunnel timeouts are retried across the budget (the r02
+    failure mode: one 180s attempt, artifact lost)."""
+    _clear_bench_env(monkeypatch)
+    monkeypatch.setenv("BENCH_PROBE_BUDGET_S", "10")
+    calls = {"n": 0}
+
+    def fake_probe(timeout_s=180.0):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            return False, "backend init did not complete within 180s"
+        return True, "ok"
+
+    with mock.patch("pcg_mpi_solver_tpu.utils.backend_probe.probe_backend",
+                    fake_probe), \
+            mock.patch("time.sleep", lambda s: None):
+        ok, detail = bench._probe_with_retry()
+    assert ok and calls["n"] == 3
+
+
+def test_probe_retry_two_strikes_on_deterministic_failure(monkeypatch):
+    """A missing/broken plugin must NOT burn the 45-minute budget."""
+    _clear_bench_env(monkeypatch)
+    monkeypatch.setenv("BENCH_PROBE_BUDGET_S", "10000")
+    calls = {"n": 0}
+
+    def fake_probe(timeout_s=180.0):
+        calls["n"] += 1
+        return False, ("backend init failed (rc=1):\n"
+                       "ModuleNotFoundError: No module named 'axon'")
+
+    with mock.patch("pcg_mpi_solver_tpu.utils.backend_probe.probe_backend",
+                    fake_probe), \
+            mock.patch("time.sleep", lambda s: None):
+        ok, _ = bench._probe_with_retry()
+    assert not ok and calls["n"] == 2
+
+
+def test_result_json_marks_unconverged(monkeypatch):
+    """time_to_tol_s must be null when the emitted solve has flag != 0."""
+    import json
+    import types
+
+    model = types.SimpleNamespace(n_dof=1000)
+    r1 = types.SimpleNamespace(flag=1, relres=1e-3, wall_s=2.0)
+    line = bench._result_json(model, "cube", r1, 50, 235.0, "note", {})
+    d = json.loads(line)
+    assert d["detail"]["time_to_tol_s"] is None
+    assert d["detail"]["solve_wall_s"] == 2.0
+    r0 = types.SimpleNamespace(flag=0, relres=1e-8, wall_s=2.0)
+    d0 = json.loads(bench._result_json(model, "cube", r0, 50, 235.0, "n", {}))
+    assert d0["detail"]["time_to_tol_s"] == 2.0
