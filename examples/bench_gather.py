@@ -1,0 +1,75 @@
+"""Hybrid level gather/scatter microbenchmark: the row-traffic side.
+
+The hybrid octree matvec moves data between the flat local dof rows and
+the per-level block lattices twice per level per matvec:
+
+    gather:  jnp.take of (rows, 3) from the padded node-row table
+    scatter: vmap'd  y.at[idx].add(rows)  back into the dof vector
+
+TPU lowers arbitrary indexed reads/writes far less efficiently than
+dense math (parallel/structured.py measured per-ELEMENT gathers at
+~28 ms for 1.2M rows at 160k dofs).  Whether the hybrid's per-NODE
+row traffic is the octree flagship's bottleneck decides the next
+optimization (level-owned contiguous node ordering vs stencil work) —
+this isolates exactly that cost at flagship-like sizes.
+
+Usage: python examples/bench_gather.py [n_nodes_millions [n_rows_millions]]
+(defaults 1.9M nodes / 7.4M gathered rows — the 5.67M-dof octree's
+finest-level numbers at PCG_TPU_HYBRID_BLOCK=8)
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(y):
+    float(jnp.asarray(jax.tree.leaves(y)[0]).ravel()[0])
+
+
+def timeit(f, *args, reps=10):
+    y = f(*args)
+    _sync(y)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        y = f(*args)
+    _sync(y)
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def main():
+    n_nodes = int(float(sys.argv[1]) * 1e6) if len(sys.argv) > 1 else 1_900_000
+    n_rows = int(float(sys.argv[2]) * 1e6) if len(sys.argv) > 2 else 7_400_000
+    rng = np.random.default_rng(0)
+    # ~70% of lattice points resolve to real nodes, the rest to the pad
+    # row (holes/non-local) — matches the blocked flagship's fill
+    idx = rng.integers(0, n_nodes, size=n_rows).astype(np.int32)
+    idx[rng.random(n_rows) < 0.3] = n_nodes
+    x3p = jnp.asarray(rng.standard_normal((n_nodes + 1, 3)), jnp.float32)
+    idxd = jnp.asarray(idx)
+    rows = jnp.asarray(rng.standard_normal((n_rows, 3)), jnp.float32)
+    y0 = jnp.zeros((n_nodes, 3), jnp.float32)
+    print(f"{n_nodes/1e6:.2f}M nodes, {n_rows/1e6:.2f}M rows on "
+          f"{jax.devices()[0]}", flush=True)
+
+    gather = jax.jit(lambda t, i: jnp.take(t, i, axis=0, mode="clip"))
+    t = timeit(gather, x3p, idxd)
+    print(f"row gather:  {t:8.3f} ms  ({t*1e6/n_rows:6.1f} ns/row, "
+          f"{n_rows*12/t/1e6:7.1f} GB/s effective)", flush=True)
+
+    scatter = jax.jit(lambda y, i, r: y.at[i].add(r, mode="drop"))
+    t = timeit(scatter, y0, idxd, rows)
+    print(f"row scatter: {t:8.3f} ms  ({t*1e6/n_rows:6.1f} ns/row)",
+          flush=True)
+
+    # reference point: a dense copy of the same byte volume
+    big = jnp.asarray(rng.standard_normal((n_rows, 3)), jnp.float32)
+    t = timeit(jax.jit(lambda a: a * 1.0000001), big)
+    print(f"dense same-bytes pass: {t:8.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

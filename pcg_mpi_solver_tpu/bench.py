@@ -197,6 +197,17 @@ def _live_baseline(kind, n_dof, nx, ny, nz, ot_n, ot_level):
     return None
 
 
+def matvec_form_label(backend: str) -> str:
+    """What to report as detail.matvec_form: the knob value for the
+    stencil backends, "n/a" otherwise — a general-backend solve never
+    reads the form knob and must not be attributed to it."""
+    if backend in ("structured", "hybrid"):
+        from pcg_mpi_solver_tpu.parallel.structured import matvec_form
+
+        return matvec_form()
+    return "n/a"
+
+
 def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
     dof_iters_per_sec = model.n_dof * iters / r1.wall_s
     # idealized 8-rank reference: perfect 8x scaling of the measured hot loop
@@ -384,9 +395,6 @@ def main():
     dtype = os.environ.get("BENCH_DTYPE", "float32")
     n_parts = int(os.environ.get("BENCH_PARTS", len(jax.devices())))
 
-    from pcg_mpi_solver_tpu.parallel.structured import (
-        matvec_form as _matvec_form)
-
     ladder = _ladder(kind, cpu_fallback)
     # loop invariant: reaching the emit below implies the LAST iteration
     # assigned all of these (every failure path raises or re-execs)
@@ -425,11 +433,7 @@ def main():
         "mode": mode,
         "backend": solver.backend,
         "pallas": bool(pallas_on),
-        # the form knob only applies to the stencil backends; a
-        # general-backend solve must not be attributed to it
-        "matvec_form": (_matvec_form()
-                        if solver.backend in ("structured", "hybrid")
-                        else "n/a"),
+        "matvec_form": matvec_form_label(solver.backend),
         "n_parts": n_parts,
         "partition_s": round(t_part, 2),
         "platform": jax.devices()[0].platform + (

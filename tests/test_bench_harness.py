@@ -58,8 +58,18 @@ def test_ladder_cpu_fallback_is_small(monkeypatch):
     monkeypatch.setenv("BENCH_NZ", "150")
     assert bench._ladder("cube", True) == [(48, 48, 48, 0, 0)]
     monkeypatch.setenv("BENCH_OT_N", "22")
-    assert bench._ladder("octree", True) == [
-        (0, 0, 0, 6, int(os.environ.get("BENCH_OT_LEVEL", 4)))]
+    assert bench._ladder("octree", True) == [(0, 0, 0, 6, 4)]
+
+
+def test_matvec_form_label(monkeypatch):
+    """Only the stencil backends are attributed to the form knob."""
+    _clear_bench_env(monkeypatch)
+    monkeypatch.setenv("PCG_TPU_MATVEC_FORM", "corner")
+    assert bench.matvec_form_label("structured") == "corner"
+    assert bench.matvec_form_label("hybrid") == "corner"
+    assert bench.matvec_form_label("general") == "n/a"
+    monkeypatch.delenv("PCG_TPU_MATVEC_FORM")
+    assert bench.matvec_form_label("structured") == "gse"
 
 
 def test_probe_retry_waits_out_timeouts(monkeypatch):
