@@ -63,6 +63,9 @@ def make_mh_test_model(backend):
         return make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
     from pcg_mpi_solver_tpu.models import make_cube_model
 
+    if backend == "structured":
+        # slab decomposition needs nx % n_parts == 0 (8 parts)
+        return make_cube_model(8, 4, 4, heterogeneous=True)
     return make_cube_model(6, 4, 4, heterogeneous=True)
 
 
@@ -173,7 +176,8 @@ def _run_multiproc(tmp_path, child_source, n_procs, extra_argv):
 @pytest.mark.skipif(os.environ.get("PCG_TPU_SKIP_MULTIPROC") == "1",
                     reason="multi-process test disabled")
 @pytest.mark.parametrize("n_procs,backend", [(2, "general"), (4, "general"),
-                                             (2, "hybrid")])
+                                             (2, "hybrid"),
+                                             (2, "structured")])
 def test_multi_process_solve(tmp_path, n_procs, backend):
     scratch = tmp_path / "scratch"
     results = _run_multiproc(tmp_path, _CHILD, n_procs,
