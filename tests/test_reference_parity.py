@@ -72,8 +72,16 @@ def test_reference_pipeline_iteration_parity(tmp_path, model, n, modes):
 @pytest.mark.skipif(
     not os.path.isdir(os.path.join(REFERENCE, "src", "solver")),
     reason="reference checkout not available")
-@pytest.mark.parametrize("model,n", [("cube", 10), ("octree", 4)])
-def test_reference_multirank_iteration_parity(tmp_path, model, n):
+@pytest.mark.parametrize("model,n,level,incl", [
+    ("cube", 10, 2, 2),
+    ("octree", 4, 2, 2),
+    # deep grading: level-3 with 6 inclusions -> 77 simultaneous
+    # edge+face hanging-node pattern types (the reference's <=144-type
+    # regime, partition_mesh.py:1074) through the full 8-rank pipeline
+    ("octree", 4, 3, 6),
+])
+def test_reference_multirank_iteration_parity(tmp_path, model, n, level,
+                                              incl):
     """The reference at 8 REAL ranks (tools/mpi_shim multi-rank: router-
     backed p2p/collectives, mmap shared windows, concurrent MPI-IO):
     run_metis builds a genuine k-way dual-graph partition (mgmetis
@@ -88,7 +96,8 @@ def test_reference_multirank_iteration_parity(tmp_path, model, n):
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools",
                                       "run_reference_baseline.py"),
-         "--model", model, "--n", str(n), "--ranks", "8", "--compare",
+         "--model", model, "--n", str(n), "--level", str(level),
+         "--incl", str(incl), "--ranks", "8", "--compare",
          "--speedtest", "0", "--scratch", str(tmp_path)],
         capture_output=True, text=True, timeout=900, env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
