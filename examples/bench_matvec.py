@@ -48,13 +48,13 @@ def main():
         jnp.asarray(rng.normal(size=(1, sp.n_loc)), jnp.float32))
     xg = x.reshape(1, 3, nx + 1, ny + 1, nz + 1)[0]
 
-    # pin the baseline to the gse form regardless of the session's
-    # PCG_TPU_MATVEC_FORM (matvec_local reads it at trace time — an
-    # inherited 'corner' would make the A/B compare corner vs corner)
-    import os
+    # the form is pinned per-ops instance, so the A/B is explicit — an
+    # inherited PCG_TPU_MATVEC_FORM cannot make this compare a form
+    # against itself
+    import dataclasses
 
-    os.environ["PCG_TPU_MATVEC_FORM"] = "gse"
-    xla = jax.jit(lambda d, xx: ops.matvec_local(d, xx))
+    ops_gse = dataclasses.replace(ops, form="gse")
+    xla = jax.jit(lambda d, xx: ops_gse.matvec_local(d, xx))
     t_xla, y0 = timeit(xla, data, x)
     print(f"xla (gse):    {t_xla*1e3:8.3f} ms/matvec", flush=True)
 

@@ -201,17 +201,6 @@ def _live_baseline(kind, n_dof, nx, ny, nz, ot_n, ot_level):
     return None
 
 
-def matvec_form_label(backend: str) -> str:
-    """What to report as detail.matvec_form: the knob value for the
-    stencil backends, "n/a" otherwise — a general-backend solve never
-    reads the form knob and must not be attributed to it."""
-    if backend in ("structured", "hybrid"):
-        from pcg_mpi_solver_tpu.parallel.structured import matvec_form
-
-        return matvec_form()
-    return "n/a"
-
-
 def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
     dof_iters_per_sec = model.n_dof * iters / r1.wall_s
     # idealized 8-rank reference: perfect 8x scaling of the measured hot loop
@@ -437,7 +426,9 @@ def main():
         "mode": mode,
         "backend": solver.backend,
         "pallas": bool(pallas_on),
-        "matvec_form": matvec_form_label(solver.backend),
+        # ops without a form attribute (general backend) never read the
+        # form knob; the stencil ops PIN it at construction
+        "matvec_form": getattr(solver.ops, "form", "n/a"),
         "n_parts": n_parts,
         "partition_s": round(t_part, 2),
         "platform": jax.devices()[0].platform + (

@@ -297,12 +297,24 @@ class HybridOps(Ops):
     # cap), resolved at construction so the trace-time dispatch agrees
     # with hybrid_pallas_enabled's probe
     pallas_levels: tuple = ()
+    # XLA stencil formulation, PINNED at construction (checkpoint
+    # fingerprints record it — see parallel/structured.py)
+    form: str = "gse"
+
+    def __post_init__(self):
+        from pcg_mpi_solver_tpu.parallel.structured import VALID_FORMS
+
+        if self.form not in VALID_FORMS:
+            raise ValueError(
+                f"form must be one of {VALID_FORMS}, got {self.form!r}")
 
     @classmethod
     def from_hybrid(cls, hp: HybridPartition, dot_dtype=jnp.float64,
                     axis_name=None,
                     precision=jax.lax.Precision.HIGHEST,
-                    use_pallas=False, n_local_parts=1):
+                    use_pallas=False, n_local_parts=1, form=None):
+        from pcg_mpi_solver_tpu.parallel.structured import matvec_form
+
         pm = hp.pm
         return cls(n_loc=pm.n_loc, n_iface=pm.n_iface,
                    n_node_loc=pm.n_node_loc, n_node_iface=pm.n_node_iface,
@@ -315,7 +327,8 @@ class HybridOps(Ops):
                    pallas_levels=tuple(
                        use_pallas
                        and n_local_parts * lv.nb <= PALLAS_BATCH_CAP
-                       for lv in hp.levels))
+                       for lv in hp.levels),
+                   form=form if form is not None else matvec_form())
 
     # -- level-grid primitives -----------------------------------------
     def _rows_pad(self, x):
@@ -350,18 +363,19 @@ class HybridOps(Ops):
     def _stencil(self, Ke, ck, xg, pallas_ok=False):
         """Structured brick matvec on one level grid (same formulations
         as parallel/structured.py: slice gather -> einsum -> sum of
-        padded translates, the fusion-friendly corner form under
-        PCG_TPU_MATVEC_FORM=corner, or the fused Pallas kernel when this
-        level is flagged eligible in ``pallas_levels``)."""
+        padded translates, the fusion-friendly corner form when
+        ``self.form == "corner"`` — pinned at construction, the env knob
+        is not re-read — or the fused Pallas kernel when this level is
+        flagged eligible in ``pallas_levels``)."""
         if pallas_ok and np.dtype(xg.dtype) == np.float32:
             from pcg_mpi_solver_tpu.ops.pallas_matvec import (
                 batched_structured_matvec)
 
             return batched_structured_matvec(xg, ck, Ke)
-        from pcg_mpi_solver_tpu.parallel.structured import (
-            corner_matvec_grid, matvec_form)
+        if self.form == "corner":
+            from pcg_mpi_solver_tpu.parallel.structured import (
+                corner_matvec_grid)
 
-        if matvec_form() == "corner":
             return corner_matvec_grid(Ke, ck, xg)
         bx, by, bz = ck.shape[1], ck.shape[2], ck.shape[3]
         slots = [xg[:, :, dx:dx + bx, dy:dy + by, dz:dz + bz]

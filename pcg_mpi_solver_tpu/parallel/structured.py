@@ -175,16 +175,19 @@ def device_data_structured(sp: StructuredPartition, dtype=jnp.float64) -> dict:
 _CORNERS = HEX_CORNERS.astype(np.int64)  # (8, 3)
 
 
+VALID_FORMS = ("gse", "corner")
+
+
 def matvec_form() -> str:
     """The PCG_TPU_MATVEC_FORM knob, validated — the ONE place its
-    name/default/valid values live (read at trace time by the structured
-    and hybrid matvecs; reported by bench.py)."""
+    name/default/valid values live (resolved once at stencil-ops
+    construction; reported by bench.py and checkpoint fingerprints)."""
     import os
 
     form = os.environ.get("PCG_TPU_MATVEC_FORM", "gse")
-    if form not in ("gse", "corner"):
+    if form not in VALID_FORMS:
         raise ValueError(
-            f"PCG_TPU_MATVEC_FORM must be 'gse' or 'corner', got {form!r}")
+            f"PCG_TPU_MATVEC_FORM must be one of {VALID_FORMS}, got {form!r}")
     return form
 
 
@@ -235,17 +238,30 @@ class StructuredOps(Ops):
     # f32 matvecs through the fused Pallas plane-march kernel
     # (ops/pallas_matvec.py) instead of the XLA gather/einsum/scatter
     use_pallas: bool = False
+    # XLA stencil formulation, PINNED at construction (the checkpoint
+    # fingerprint records it; an env flip after construction must not
+    # silently change what a resume replays)
+    form: str = "gse"
+
+    def __post_init__(self):
+        # explicit pins (incl. dataclasses.replace) must not bypass the
+        # validation matvec_form() applies to the env path — a typo'd
+        # form would silently run gse while being recorded as itself
+        if self.form not in VALID_FORMS:
+            raise ValueError(
+                f"form must be one of {VALID_FORMS}, got {self.form!r}")
 
     @classmethod
     def from_partition(cls, sp: StructuredPartition, dot_dtype=jnp.float64,
                        axis_name=None, precision=jax.lax.Precision.HIGHEST,
-                       use_pallas=False):
+                       use_pallas=False, form=None):
         return cls(n_loc=sp.n_loc, n_iface=0,
                    n_node_loc=sp.n_node_loc, n_node_iface=0,
                    dot_dtype=dot_dtype,
                    axis_name=axis_name, precision=precision,
                    nxc=sp.nxc, ny=sp.ny, nz=sp.nz, n_parts=sp.n_parts,
-                   use_pallas=use_pallas)
+                   use_pallas=use_pallas,
+                   form=form if form is not None else matvec_form())
 
     # -- grid helpers ---------------------------------------------------
     def _grid(self, x):
@@ -345,10 +361,10 @@ class StructuredOps(Ops):
           intermediate ever exists.  Trades the single big MXU matmul
           (arithmetic intensity ~12 flops/byte — far below the MXU
           roofline anyway; the op is HBM-bound) for ~4x less HBM
-          traffic.  Read at trace time: toggling after a solver
-          compiled does not retrace (build a new Solver to switch).
+          traffic.  The knob is resolved ONCE at ops construction
+          (self.form) — toggling the env later does nothing.
         """
-        if matvec_form() == "corner":
+        if self.form == "corner":
             return self._gse_corner(blk, xg, ck)
         u = self._gather_cells(xg)                     # (P, 24, cells)
         v = jnp.einsum("de,pexyz->pdxyz", blk["Ke"], ck[:, None] * u,

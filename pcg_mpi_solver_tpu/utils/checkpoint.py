@@ -79,6 +79,16 @@ def _fingerprint(solver) -> dict:
         # breaking exact resume), but kernels only ever execute on f32
         # matvecs — a pure-f64 direct run is byte-identical either way.
         "pallas": _effective_kernel(solver),
+        # same summation-order hazard for the stencil backends: the XLA
+        # formulation (gse vs corner) and the hybrid level-grid block
+        # layout both reorder the pad-accumulate sums.  Both are PINNED
+        # on the ops at construction (ops.form / ops.level_dims — the
+        # env knobs cannot drift between trace and save), and ops
+        # without a form attribute (general backend) never read the
+        # knob.
+        "matvec_form": getattr(solver.ops, "form", "n/a"),
+        "level_dims": [list(d) for d in getattr(solver.ops, "level_dims",
+                                                ())],
     }
 
 
@@ -196,6 +206,13 @@ class CheckpointManager:
             # have come from the scalar-Jacobi path.
             saved.setdefault("precond", "jacobi")
             want = _fingerprint(solver)
+            # Checkpoints that predate the stencil-form/level-dims fields
+            # did not record which formulation/layout produced them (the
+            # corner form and block tiling existed briefly before the
+            # fields did), so their historical values are unknowable —
+            # skip BOTH checks for legacy checkpoints rather than guess.
+            saved.setdefault("matvec_form", want["matvec_form"])
+            saved.setdefault("level_dims", want["level_dims"])
             if saved != want:
                 diffs = {k: (saved.get(k), want[k]) for k in want
                          if saved.get(k) != want[k]}

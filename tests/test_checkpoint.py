@@ -164,3 +164,52 @@ def test_probe_history_survives_resume(tmp_path, model):
 
     np.testing.assert_allclose(plot_u(cfg_b.plot_path),
                                plot_u(cfg_a.plot_path), rtol=1e-12)
+
+
+def test_resume_rejects_flipped_stencil_knobs(tmp_path):
+    """The matvec form and hybrid block layout change the stencil's
+    summation order (same exact-resume hazard as the Pallas variants):
+    a resume under a flipped knob must be refused, not silently drift."""
+    import os
+
+    from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+    model = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
+    cfg = RunConfig(scratch_path=str(tmp_path), checkpoint_every=1,
+                    solver=SolverConfig(tol=1e-8, max_iter=50),
+                    time_history=TimeHistoryConfig(
+                        time_step_delta=[0.0, 1.0]))
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+
+    def build():
+        return Solver(model, cfg, mesh=make_mesh(1), n_parts=1,
+                      backend="hybrid")
+
+    prev = {k: os.environ.get(k)
+            for k in ("PCG_TPU_MATVEC_FORM", "PCG_TPU_HYBRID_BLOCK")}
+    try:
+        os.environ.pop("PCG_TPU_MATVEC_FORM", None)
+        os.environ["PCG_TPU_HYBRID_BLOCK"] = "2"
+        s = build()
+        s.step(1.0)
+        mgr.save(s, 1)
+
+        # same env: restores fine
+        assert mgr.restore(build(), 1) == 1
+
+        # flipped form: refused
+        os.environ["PCG_TPU_MATVEC_FORM"] = "corner"
+        with pytest.raises(ValueError, match="matvec_form"):
+            mgr.restore(build(), 1)
+        os.environ.pop("PCG_TPU_MATVEC_FORM", None)
+
+        # flipped block layout: refused
+        os.environ["PCG_TPU_HYBRID_BLOCK"] = "1000000"
+        with pytest.raises(ValueError, match="level_dims"):
+            mgr.restore(build(), 1)
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -126,11 +126,10 @@ def test_chunked_f64_matvec_matches_unchunked():
 
 
 def test_corner_form_matches_gse():
-    """PCG_TPU_MATVEC_FORM=corner (the fusion-friendly, no-(24,cells)-
-    intermediate formulation) must produce the same matvec as the
-    default gather/einsum/scatter form to float tolerance."""
-    import os
-
+    """The corner form (fusion-friendly, no (24, cells) intermediates)
+    must produce the same matvec as the default gather/einsum/scatter
+    form to float tolerance.  The form is pinned per-ops at
+    construction, so both formulations are explicit instances."""
     import jax.numpy as jnp
 
     from pcg_mpi_solver_tpu.parallel.structured import (
@@ -139,22 +138,11 @@ def test_corner_form_matches_gse():
     model = make_cube_model(8, 6, 4, heterogeneous=True)
     sp = partition_structured(model, 2)
     data = device_data_structured(sp, jnp.float64)
-    ops = StructuredOps.from_partition(sp)
+    ops_gse = StructuredOps.from_partition(sp, form="gse")
+    ops_corner = StructuredOps.from_partition(sp, form="corner")
     rng = np.random.default_rng(5)
     x = jnp.asarray(rng.standard_normal((2, sp.n_loc)))
-    # pin BOTH forms explicitly (an inherited PCG_TPU_MATVEC_FORM=corner
-    # would otherwise make this compare corner against corner) and
-    # restore whatever the caller had
-    prev = os.environ.get("PCG_TPU_MATVEC_FORM")
-    try:
-        os.environ["PCG_TPU_MATVEC_FORM"] = "gse"
-        y_gse = np.asarray(ops.matvec(data, x))
-        os.environ["PCG_TPU_MATVEC_FORM"] = "corner"
-        y_corner = np.asarray(ops.matvec(data, x))
-    finally:
-        if prev is None:
-            os.environ.pop("PCG_TPU_MATVEC_FORM", None)
-        else:
-            os.environ["PCG_TPU_MATVEC_FORM"] = prev
+    y_gse = np.asarray(ops_gse.matvec(data, x))
+    y_corner = np.asarray(ops_corner.matvec(data, x))
     scale = np.abs(y_gse).max()
     assert np.abs(y_corner - y_gse).max() / scale < 1e-13
