@@ -92,25 +92,28 @@ def _cpu_only_env():
     return env
 
 
-def _probe_with_retry():
-    """Retry the backend probe with backoff across the round's budget.
+def _probe_with_retry(budget_s=None, probe_timeout_s=180.0):
+    """Retry the backend probe with backoff across a wall budget.
 
     r02 post-mortem: one 180 s probe attempt died on a transiently dead
     tunnel and the whole round's perf artifact was lost.  The driver
-    gives the bench far more wall than 3 minutes — spend it."""
+    gives the bench far more wall than 3 minutes — spend it.  Also the
+    ONE probe-retry policy for tools/hw_session.py (pass budget_s /
+    probe_timeout_s explicitly there)."""
     from pcg_mpi_solver_tpu.utils.backend_probe import probe_backend
 
-    # 30 min: far past the fatal one-shot 180 s of r02, while keeping
-    # probe + CPU-fallback solve comfortably inside any plausible
+    # default 30 min: far past the fatal one-shot 180 s of r02, while
+    # keeping probe + CPU-fallback solve comfortably inside any plausible
     # driver-side wall cap (an over-long probe that gets the bench
     # externally killed would lose the artifact just like r02 did)
-    budget = float(os.environ.get("BENCH_PROBE_BUDGET_S", 1800))
+    budget = (float(os.environ.get("BENCH_PROBE_BUDGET_S", 1800))
+              if budget_s is None else float(budget_s))
     t0 = time.monotonic()
     attempt = 0
     hard_fails = 0
     while True:
         attempt += 1
-        ok, detail = probe_backend()
+        ok, detail = probe_backend(timeout_s=probe_timeout_s)
         if ok:
             if attempt > 1:
                 _log(f"# backend probe ok on attempt {attempt} "

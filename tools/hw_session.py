@@ -1,0 +1,127 @@
+"""Unattended on-hardware measurement session (RUNBOOK checklist).
+
+Probes the accelerator until it answers (or a deadline passes), then
+runs the whole RUNBOOK "on-hardware measurement checklist" as
+subprocesses with per-step timeouts, appending everything to a log file
+inside the repo — so a tunnel recovery at any hour turns into captured
+measurements without an operator in the loop.
+
+Usage:
+    python tools/hw_session.py [--deadline-min 360] [--log docs/HW_SESSION.log]
+        [--quick]            # small sizes (smoke/CPU test of the harness)
+
+Steps (each independent; a failure is logged and the session continues):
+  1. bench_matvec         — XLA gse vs corner vs Pallas v3 at flagship scale
+  2. bench_gather         — hybrid row-traffic isolation
+  3. bench.py             — cube flagship (mixed)
+  4. bench.py direct      — f64-direct anchor at the same scale
+  5. bench.py octree      — graded-octree flagship on the blocked hybrid
+  6. bench_iter_breakdown — structured per-iteration split
+  7. bench_hybrid_breakdown — per-level gather/stencil/scatter split
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def log_line(path, msg):
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M:%SZ")
+    line = f"[{stamp}] {msg}"
+    print(line, flush=True)
+    with open(path, "a") as f:
+        f.write(line + "\n")
+
+
+def run_step(path, name, argv, env_extra=None, timeout=3600):
+    env = dict(os.environ)
+    env.setdefault("PCG_TPU_VERBOSE", "1")
+    env.update(env_extra or {})
+    log_line(path, f"=== {name}: {' '.join(argv)} "
+                   + (f"env={env_extra} " if env_extra else ""))
+    t0 = time.monotonic()
+    # own process GROUP so a timeout kills the step's whole tree —
+    # bench.py spawns its own subprocesses (reference baseline, CPU
+    # fallback) which would otherwise survive as orphans competing with
+    # the next step, unlogged, in an unattended session
+    import signal
+
+    proc = subprocess.Popen([sys.executable] + argv, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        status = f"rc={proc.returncode}"
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        out, _ = proc.communicate()
+        status = f"TIMEOUT after {timeout}s (process group killed)"
+    wall = time.monotonic() - t0
+    with open(path, "a") as f:
+        f.write((out or "") + "\n")
+    log_line(path, f"=== {name} done: {status} ({wall:.0f}s)")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=360,
+                    help="give up probing after this many minutes")
+    ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes (harness smoke; also used on CPU)")
+    args = ap.parse_args()
+    path = os.path.join(REPO, args.log)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    sys.path.insert(0, REPO)
+    # the ONE probe-retry policy (incl. deterministic-failure two-strike)
+    from pcg_mpi_solver_tpu.bench import _probe_with_retry
+
+    log_line(path, f"hw_session start (deadline {args.deadline_min:.0f} min, "
+                   f"quick={args.quick})")
+    ok, detail = _probe_with_retry(budget_s=args.deadline_min * 60,
+                                   probe_timeout_s=600)
+    if not ok:
+        log_line(path, f"deadline reached; no hardware session ({detail})")
+        sys.exit(3)
+    log_line(path, f"accelerator ANSWERED: {detail}")
+
+    nx = "48" if args.quick else "150"
+    ot = ({"BENCH_OT_N": "6", "BENCH_OT_LEVEL": "2"} if args.quick else {})
+    run_step(path, "matvec A/B", ["examples/bench_matvec.py", nx],
+             timeout=2400)
+    run_step(path, "row traffic",
+             ["examples/bench_gather.py"]
+             + (["0.3", "1.0"] if args.quick else []), timeout=1200)
+    run_step(path, "flagship cube (mixed)", ["bench.py"],
+             env_extra=dict({"BENCH_NX": nx} if args.quick else {}),
+             timeout=3600)
+    run_step(path, "flagship cube (f64 direct)", ["bench.py"],
+             env_extra=dict({"BENCH_MODE": "direct"},
+                            **({"BENCH_NX": nx} if args.quick else {})),
+             timeout=3600)
+    run_step(path, "octree flagship (hybrid)", ["bench.py"],
+             env_extra=dict({"BENCH_MODEL": "octree"}, **ot), timeout=4800)
+    run_step(path, "iteration breakdown",
+             ["examples/bench_iter_breakdown.py", nx], timeout=1800)
+    run_step(path, "hybrid per-level breakdown",
+             ["examples/bench_hybrid_breakdown.py"]
+             + (["6", "2", "3"] if args.quick else ["16", "4", "6"]),
+             timeout=1800)
+    log_line(path, "hw_session complete")
+
+
+if __name__ == "__main__":
+    main()
