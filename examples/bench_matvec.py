@@ -16,7 +16,7 @@ import numpy as np
 from pcg_mpi_solver_tpu.models import make_cube_model
 from pcg_mpi_solver_tpu.ops.pallas_matvec import (
     structured_matvec_pallas, structured_matvec_pallas_v2,
-    structured_matvec_pallas_v3)
+    structured_matvec_pallas_v3, structured_matvec_pallas_v4)
 from pcg_mpi_solver_tpu.parallel.structured import (
     StructuredOps, device_data_structured, partition_structured)
 
@@ -76,6 +76,12 @@ def main():
     for c in (8, 16):
         variants.append((f"pallas v3 C={c}", functools.partial(
             structured_matvec_pallas_v3, planes=c)))
+    # C=16 is expected to exceed the ~16 MB VMEM budget at flagship m —
+    # included because its failure mode (fast alloc error) is cheap and
+    # pins the ceiling; C=24 would only repeat it
+    for c in (8, 16):
+        variants.append((f"pallas v4 C={c}", functools.partial(
+            structured_matvec_pallas_v4, planes=c)))
     for name, fn in variants:
         try:
             t, y = timeit(fn, xg, blk["ck"][0], blk["Ke"])

@@ -108,7 +108,9 @@ def batched_structured_matvec(xg, ck, Ke):
     part per level, negligible against a PCG iteration).
 
     PCG_TPU_PALLAS_V selects the variant (1 = per-plane VPU-FMA, 2 =
-    per-plane MXU, default 3 = chunked double-buffered MXU)."""
+    per-plane MXU, 3 = chunked double-buffered MXU, default 4 =
+    reshape-free chunked — the only one the deployed Mosaic toolchain
+    lowers, docs/RUNBOOK.md)."""
     fn = selected_variant()[1]
     return jnp.stack([fn(xg[p], ck[p], Ke) for p in range(xg.shape[0])])
 
@@ -128,6 +130,18 @@ def _v3_env(xg, ck, Ke, *, interpret=False):
                                        planes=planes)
 
 
+def _v4_env(xg, ck, Ke, *, interpret=False):
+    """v4 with the chunk size from PCG_TPU_PALLAS_PLANES (default 8)."""
+    import os
+
+    planes = int(os.environ.get("PCG_TPU_PALLAS_PLANES", "8"))
+    if planes % 8 != 0:
+        raise ValueError(
+            f"PCG_TPU_PALLAS_PLANES must be a multiple of 8, got {planes}")
+    return structured_matvec_pallas_v4(xg, ck, Ke, interpret=interpret,
+                                       planes=planes)
+
+
 def selected_variant():
     """(name, fn) of the kernel variant the PCG_TPU_PALLAS_V env knob
     selects — the single source of truth for dispatch AND probing.  Read
@@ -135,14 +149,16 @@ def selected_variant():
     retrace (build a new Solver to switch)."""
     import os
 
-    v = os.environ.get("PCG_TPU_PALLAS_V", "3")
+    v = os.environ.get("PCG_TPU_PALLAS_V", "4")
     if v == "1":
         return "v1", structured_matvec_pallas
     if v == "2":
         return "v2", structured_matvec_pallas_v2
-    if v != "3":
-        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3, got {v!r}")
-    return "v3", _v3_env
+    if v == "3":
+        return "v3", _v3_env
+    if v != "4":
+        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3|4, got {v!r}")
+    return "v4", _v4_env
 
 
 def probe_shapes(shapes, dtype=jnp.float32) -> None:
@@ -432,6 +448,153 @@ def structured_matvec_pallas_v3(xg, ck, Ke, *, interpret=False, planes=8):
             pltpu.VMEM((2, 3, (cpp + 1) * m + nzn + 2), xg.dtype),
             pltpu.VMEM((2, cpp, m), ck.dtype),
             pltpu.VMEM((3, (cpp + 1) * m + nzn + 2), xg.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(Ke, x_flat, ck_pad)
+    return y[:, :nxn].reshape(3, nxn, nyn, nzn)
+
+
+# ----------------------------------------------------------------------
+# v4: v3's chunked double-buffered DMA, v2's per-plane compute — and NO
+# lane-merging reshapes.
+#
+# The 2026-07-30 hardware session pinned v3's Mosaic failure to its
+# (cpp, m) -> (cpp*m,) shape casts ("infer-vector-layout: unsupported
+# shape cast" on tpu.reshape when m is not 128-divisible — m = nyn*nzn
+# is 22801 at the flagship).  v4 keeps the plane axis as a real (sublane-
+# tiled) array axis end to end: chunk buffers are (3, cpp+1, m+tail), a
+# corner's dx offset selects a PLANE (static index) instead of a +dx*m
+# lane offset, and each of the cpp planes in the chunk runs v2's flat-
+# lane math ((24, m) stack -> one (24,24)@(24,m) MXU dot -> eight
+# zero-padded lane adds).  Per-step cost stays chunk-sized (v3's fix for
+# v2's per-plane grid overhead), the output BlockSpec is (3, cpp, m)
+# with cpp % 8 == 0 and m the full lane axis — Mosaic-legal — and every
+# slice offset is static.
+# ----------------------------------------------------------------------
+
+
+def _matvec_kernel_v4(ke_ref, x_hbm, ck_hbm, y_ref,
+                      xv, ckv, acc, sems, ck_sems, *, g, cpp, nxn, m, sy):
+    """One grid step = cpp finished output node planes.
+
+    ke_ref: (24, 24) VMEM
+    x_hbm:  (3, nxn, m) ANY/HBM — NOT padded; tail-chunk plane copies
+            beyond nxn are skipped and the stale slot lanes they leave
+            behind only ever multiply ck = 0 (ck IS zero-padded)
+    ck_hbm: (g*cpp, m) ANY/HBM (zero-padded)
+    y_ref:  (3, cpp, m) VMEM output block (planes j*cpp ..< (j+1)*cpp)
+    xv:     (2, 3, cpp+1, m + sy + 2) VMEM — double-buffered node-plane
+            chunk + one overlap plane; lane tail for the per-plane
+            gather overhang (zeroed once, only ever multiplies ck = 0)
+    ckv:    (2, cpp, m) VMEM
+    acc:    (3, m + sy + 2) VMEM — carry: the chunk's last cell plane's
+            upper-corner (dx=1) partials, finishing the NEXT chunk's
+            first output plane
+    """
+    j = pl.program_id(0)
+    mt = m + sy + 2
+
+    def for_chunk(slot, chunk, act):
+        """Start or wait the chunk's copies: cpp+1 node planes (each into
+        the :m lanes of its own plane row) + the ck plane block.
+        Descriptors are recreated identically at wait time (standard
+        double-buffering pattern); out-of-range tail planes are skipped
+        on BOTH sides."""
+        for k in range(cpp + 1):
+            plane = chunk * cpp + k
+
+            @pl.when(plane < nxn)
+            def _cp():
+                getattr(pltpu.make_async_copy(
+                    x_hbm.at[:, plane],
+                    xv.at[slot, :, k, pl.ds(0, m)], sems.at[slot]), act)()
+        getattr(pltpu.make_async_copy(
+            ck_hbm.at[pl.ds(chunk * cpp, cpp)],
+            ckv.at[slot], ck_sems.at[slot]), act)()
+
+    @pl.when(j == 0)
+    def _init():
+        xv[...] = jnp.zeros_like(xv)       # zero overhang tails once
+        acc[...] = jnp.zeros_like(acc)
+        for_chunk(0, 0, "start")
+
+    # wait for this chunk's data; prefetch the next chunk
+    slot = jax.lax.rem(j, jnp.asarray(2, j.dtype))
+    for_chunk(slot, j, "wait")
+
+    @pl.when(j + 1 < g)
+    def _prefetch():
+        for_chunk(1 - slot, j + 1, "start")
+
+    xb = xv[slot]                                       # (3, cpp+1, mt)
+    ckb = ckv[slot]                                     # (cpp, m)
+    carry = acc[...]                                    # (3, mt)
+    for k in range(cpp):
+        ck = ckb[k]                                     # (m,)
+        # u[e] = ck * corner value; dx picks the PLANE (static), dy/dz a
+        # static lane offset — no flattened-x layout, hence no reshape
+        rows = []
+        for a, (dx, dy, dz) in enumerate(_CORNERS):
+            off = dy * sy + dz
+            for c in range(3):
+                rows.append(ck * xb[c, k + dx, off:off + m])
+        u = jnp.stack(rows)                             # (24, m)
+        v = jax.lax.dot_general(
+            ke_ref[...], u, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (24, m) on the MXU
+        # corner placement as zero-padded lane adds (Mosaic has no
+        # scatter-add lowering); dx routes to this output plane (lo) or
+        # the next one (hi -> carry)
+        lo = jnp.zeros((3, mt), u.dtype)
+        hi = jnp.zeros((3, mt), u.dtype)
+        for a, (dx, dy, dz) in enumerate(_CORNERS):
+            off = dy * sy + dz
+            pad = jnp.pad(v[3 * a:3 * a + 3], ((0, 0), (off, mt - off - m)))
+            if dx == 0:
+                lo = lo + pad
+            else:
+                hi = hi + pad
+        out = carry + lo
+        for c in range(3):
+            y_ref[c, k] = out[c, :m]
+        carry = hi
+    acc[...] = carry
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "planes"))
+def structured_matvec_pallas_v4(xg, ck, Ke, *, interpret=False, planes=8):
+    """Reshape-free chunked variant of :func:`structured_matvec_pallas_v3`.
+
+    Same signature/semantics: xg (3, nx+1, ny+1, nz+1), ck (nx, ny, nz),
+    Ke (24, 24), all f32; ``planes`` = cell planes per grid step
+    (multiple of 8 — the output BlockSpec's sublane axis)."""
+    _, nxn, nyn, nzn = xg.shape
+    nx = nxn - 1
+    m = nyn * nzn
+    cpp = max(1, min(planes, ((nx + 1 + 7) // 8) * 8))
+    g = -(-(nx + 1) // cpp)                 # ceil: covers all output planes
+    x_flat = xg.reshape(3, nxn, m)          # free reshape, no copy
+    # single pad; loop-invariant, so XLA hoists it out of the PCG loop
+    ck_pad = jnp.pad(ck, ((0, g * cpp - nx), (0, 1), (0, 1))) \
+        .reshape(g * cpp, m)
+    kernel = functools.partial(_matvec_kernel_v4, g=g, cpp=cpp, nxn=nxn,
+                               m=m, sy=nzn)
+    y = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # Ke
+            pl.BlockSpec(memory_space=pl.ANY),         # x (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),         # ck (manual DMA)
+        ],
+        out_specs=pl.BlockSpec((3, cpp, m), lambda j: (0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, g * cpp, m), xg.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, 3, cpp + 1, m + nzn + 2), xg.dtype),
+            pltpu.VMEM((2, cpp, m), ck.dtype),
+            pltpu.VMEM((3, m + nzn + 2), xg.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],

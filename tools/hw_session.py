@@ -44,6 +44,10 @@ def log_line(path, msg):
 def run_step(path, name, argv, env_extra=None, timeout=3600):
     env = dict(os.environ)
     env.setdefault("PCG_TPU_VERBOSE", "1")
+    # examples/*.py run with sys.path[0]=examples/, and the package is
+    # not pip-installed — the repo root must come from PYTHONPATH
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     env.update(env_extra or {})
     log_line(path, f"=== {name}: {' '.join(argv)} "
                    + (f"env={env_extra} " if env_extra else ""))
@@ -54,23 +58,27 @@ def run_step(path, name, argv, env_extra=None, timeout=3600):
     # the next step, unlogged, in an unattended session
     import signal
 
-    proc = subprocess.Popen([sys.executable] + argv, cwd=REPO, env=env,
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True,
-                            start_new_session=True)
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-        status = f"rc={proc.returncode}"
-    except subprocess.TimeoutExpired:
+    # stream straight into the log (no PIPE): an external kill mid-step
+    # must not lose the step's partial output — that is the exact
+    # artifact-loss mode this harness exists to prevent
+    with open(path, "a") as logf:
+        proc = subprocess.Popen([sys.executable] + argv, cwd=REPO, env=env,
+                                stdout=logf, stderr=subprocess.STDOUT,
+                                text=True, start_new_session=True)
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        out, _ = proc.communicate()
-        status = f"TIMEOUT after {timeout}s (process group killed)"
+            proc.wait(timeout=timeout)
+            status = f"rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass    # a daemonized escapee; the group is dead, move on
+            status = f"TIMEOUT after {timeout}s (process group killed)"
     wall = time.monotonic() - t0
-    with open(path, "a") as f:
-        f.write((out or "") + "\n")
     log_line(path, f"=== {name} done: {status} ({wall:.0f}s)")
 
 
