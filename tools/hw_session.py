@@ -116,8 +116,11 @@ def main():
     run_step(path, "flagship cube (mixed)", ["bench.py"],
              env_extra=dict({"BENCH_NX": nx} if args.quick else {}),
              timeout=3600)
+    # direct mode needs f64 STORAGE too — f32 direct stagnates at
+    # relres ~1e-5*kappa (RUNBOOK) and only ladders down
     run_step(path, "flagship cube (f64 direct)", ["bench.py"],
-             env_extra=dict({"BENCH_MODE": "direct"},
+             env_extra=dict({"BENCH_MODE": "direct",
+                             "BENCH_DTYPE": "float64"},
                             **({"BENCH_NX": nx} if args.quick else {})),
              timeout=3600)
     run_step(path, "octree flagship (hybrid)", ["bench.py"],
