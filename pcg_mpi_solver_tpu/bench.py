@@ -432,6 +432,7 @@ def main():
         # ops without a form attribute (general backend) never read the
         # form knob; the stencil ops PIN it at construction
         "matvec_form": getattr(solver.ops, "form", "n/a"),
+        "combine": getattr(solver.ops, "combine", "n/a"),
         "n_parts": n_parts,
         "partition_s": round(t_part, 2),
         "platform": jax.devices()[0].platform + (

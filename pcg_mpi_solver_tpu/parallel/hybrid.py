@@ -78,6 +78,31 @@ class LevelGrid:
 
 
 @dataclasses.dataclass
+class CombineMaps:
+    """Slot->node gather-combine maps (the scatter-free level combine).
+
+    The 2026-07-30 hardware session measured the duplicate-row scatter at
+    88.7 ns/row against 5.9 ns/row gathers (docs/BENCH_LOG.md "hybrid row
+    traffic") — so the combine is transposed: all levels' lattice slots
+    are sorted by target node at PARTITION time and composed into direct
+    per-node source-slot indices.  At solve time the element->node
+    accumulation (reference pcg_solver.py:300's bincount) becomes KD
+    row gathers (+ a small scatter for the rare heavy nodes), never a
+    7M-row scatter.
+
+    Slot numbering: levels in list order, each level flat over
+    (block, lattice pos) exactly as its ``nidx`` — runtime row arrays are
+    concatenated in the same order, with ONE trailing zero row at index
+    ``n_slots`` serving as the universal pad target.
+    """
+
+    n_slots: int                # total slots across levels (zero row = pad)
+    gidx: np.ndarray            # (P, n_node_loc, KD) int32 slot ids
+    hnode: np.ndarray           # (P, H) int32 heavy node ids (pad=n_node_loc)
+    hgidx: np.ndarray           # (P, H, KE) int32 slot ids
+
+
+@dataclasses.dataclass
 class HybridPartition:
     """PartitionedModel (transition cells only in its type blocks) plus the
     per-level brick grids.  Duck-compatible with the driver's pm usage."""
@@ -87,6 +112,7 @@ class HybridPartition:
     brick_Ke: np.ndarray        # (24, 24) unit brick stiffness
     brick_diag: np.ndarray      # (24,)
     brick_Se: Optional[np.ndarray]  # (6, 24)
+    combine: Optional[CombineMaps] = None
 
     def __getattr__(self, name):
         # Guard 'pm' and dunders: during unpickling/deepcopy the object
@@ -259,7 +285,77 @@ def partition_hybrid(model: ModelData, n_parts: int,
         brick_diag=np.asarray(lib["diagKe"], np.float64),
         brick_Se=(np.asarray(lib["Se"], np.float64)
                   if lib.get("Se") is not None else None),
+        combine=build_combine_maps(levels, pm.n_node_loc, P),
     )
+
+
+def combine_kd() -> int:
+    """Dense width of the gather-combine (slots gathered for EVERY node
+    before falling to the heavy-node residual): PCG_TPU_HYBRID_KD."""
+    kd = int(os.environ.get("PCG_TPU_HYBRID_KD", "2"))
+    if kd < 1:
+        raise ValueError(f"PCG_TPU_HYBRID_KD must be >= 1, got {kd}")
+    return kd
+
+
+def hybrid_combine_mode() -> str:
+    """The PCG_TPU_HYBRID_COMBINE knob, validated — ``gather`` (default:
+    partition-time-composed per-node source indices, scatter-free) or
+    ``scatter`` (the vmap'd at[].add row scatter)."""
+    mode = os.environ.get("PCG_TPU_HYBRID_COMBINE", "gather")
+    if mode not in ("gather", "scatter"):
+        raise ValueError("PCG_TPU_HYBRID_COMBINE must be gather|scatter, "
+                         f"got {mode!r}")
+    return mode
+
+
+def build_combine_maps(levels: List[LevelGrid], n_node_loc: int,
+                       P: int) -> Optional[CombineMaps]:
+    """Sort every level's lattice slots by target node and compose direct
+    per-node source indices (see CombineMaps).  All host-side numpy, one
+    argsort over the concatenated slot count per part."""
+    if not levels:
+        return None
+    KD = combine_kd()
+    nslot = [lv.nb * (lv.bx + 1) * (lv.by + 1) * (lv.bz + 1)
+             for lv in levels]
+    Ns = int(np.sum(nslot))
+    # slot id = position in the level-order concatenation = plain range
+    slots_all = np.arange(Ns, dtype=np.int64)
+    gidx = np.full((P, n_node_loc, KD), Ns, dtype=np.int64)
+    starts_l, lens_l, ss_l = [], [], []
+    ke_max = 0
+    h_max = 0
+    for p in range(P):
+        tgt = np.concatenate([lv.nidx[p].reshape(-1) for lv in levels]) \
+            .astype(np.int64)
+        real = tgt < n_node_loc
+        order = np.argsort(tgt[real], kind="stable")
+        t_s = tgt[real][order]
+        s_s = slots_all[real][order]
+        starts = np.searchsorted(t_s, np.arange(n_node_loc, dtype=np.int64))
+        lens = np.diff(np.append(starts, len(t_s)))
+        for k in range(KD):
+            sel = lens > k
+            gidx[p, sel, k] = s_s[starts[sel] + k]
+        starts_l.append(starts)
+        lens_l.append(lens)
+        ss_l.append(s_s)
+        ke_max = max(ke_max, int(lens.max(initial=0)) - KD)
+        h_max = max(h_max, int((lens > KD).sum()))
+    KE = max(ke_max, 0)
+    hnode = np.full((P, h_max), n_node_loc, dtype=np.int64)
+    hgidx = np.full((P, h_max, KE), Ns, dtype=np.int64)
+    for p in range(P):
+        heavy = np.where(lens_l[p] > KD)[0]
+        hnode[p, :len(heavy)] = heavy
+        for k in range(KE):
+            sel = lens_l[p][heavy] > KD + k
+            hgidx[p, :len(heavy), k][sel] = \
+                ss_l[p][starts_l[p][heavy[sel]] + KD + k]
+    return CombineMaps(n_slots=Ns, gidx=gidx.astype(np.int32),
+                       hnode=hnode.astype(np.int32),
+                       hgidx=hgidx.astype(np.int32))
 
 
 def device_data_hybrid(hp: HybridPartition, dtype=jnp.float64) -> dict:
@@ -273,6 +369,12 @@ def device_data_hybrid(hp: HybridPartition, dtype=jnp.float64) -> dict:
     d["brick_diag"] = jnp.asarray(hp.brick_diag, dtype)
     if hp.brick_Se is not None:
         d["brick_Se"] = jnp.asarray(hp.brick_Se, dtype)
+    if hp.combine is not None:
+        d["combine"] = {
+            "gidx": jnp.asarray(hp.combine.gidx),
+            "hnode": jnp.asarray(hp.combine.hnode),
+            "hgidx": jnp.asarray(hp.combine.hgidx),
+        }
     return d
 
 
@@ -300,6 +402,9 @@ class HybridOps(Ops):
     # XLA stencil formulation, PINNED at construction (checkpoint
     # fingerprints record it — see parallel/structured.py)
     form: str = "gse"
+    # level-combine strategy, PINNED at construction: "gather" (composed
+    # per-node source indices, scatter-free) or "scatter" (row scatter)
+    combine: str = "gather"
 
     def __post_init__(self):
         from pcg_mpi_solver_tpu.parallel.structured import VALID_FORMS
@@ -307,15 +412,23 @@ class HybridOps(Ops):
         if self.form not in VALID_FORMS:
             raise ValueError(
                 f"form must be one of {VALID_FORMS}, got {self.form!r}")
+        if self.combine not in ("gather", "scatter"):
+            raise ValueError("combine must be gather|scatter, "
+                             f"got {self.combine!r}")
 
     @classmethod
     def from_hybrid(cls, hp: HybridPartition, dot_dtype=jnp.float64,
                     axis_name=None,
                     precision=jax.lax.Precision.HIGHEST,
-                    use_pallas=False, n_local_parts=1, form=None):
+                    use_pallas=False, n_local_parts=1, form=None,
+                    combine=None):
         from pcg_mpi_solver_tpu.parallel.structured import matvec_form
 
         pm = hp.pm
+        if combine is None:
+            combine = hybrid_combine_mode()
+        if hp.combine is None:
+            combine = "scatter"     # no maps built (no levels)
         return cls(n_loc=pm.n_loc, n_iface=pm.n_iface,
                    n_node_loc=pm.n_node_loc, n_node_iface=pm.n_node_iface,
                    dot_dtype=dot_dtype, axis_name=axis_name,
@@ -328,7 +441,8 @@ class HybridOps(Ops):
                        use_pallas
                        and n_local_parts * lv.nb <= PALLAS_BATCH_CAP
                        for lv in hp.levels),
-                   form=form if form is not None else matvec_form())
+                   form=form if form is not None else matvec_form(),
+                   combine=combine)
 
     # -- level-grid primitives -----------------------------------------
     def _rows_pad(self, x):
@@ -353,12 +467,46 @@ class HybridOps(Ops):
         """Adds (P*nb, 3, bx+1, by+1, bz+1) block-batch node-grid values
         into y (P, n_loc).  Block-boundary lattice nodes appear in every
         adjacent block's lattice; the row scatter accumulates them."""
-        rows = grid.transpose(0, 2, 3, 4, 1).reshape(Pn, -1, 3)
+        rows = self._grid_rows(grid, Pn)
         y3 = y.reshape(Pn, self.n_node_loc, 3)
         y3 = jax.vmap(
             lambda yp, idx, r: yp.at[idx].add(r, mode="drop")
         )(y3, lv["nidx"].reshape(Pn, -1), rows)
         return y3.reshape(Pn, self.n_loc)
+
+    def _combined_gather_add(self, y, rows_levels, data, Pn):
+        """Scatter-free combine: add every level's lattice-slot rows into
+        y (P, n_loc) through the partition-composed slot->node maps
+        (CombineMaps; measured rationale in docs/BENCH_LOG.md "hybrid row
+        traffic").  ``rows_levels``: per-level (P, n_slots_l, w) arrays in
+        level order; w is the row width (3 for matvec/diag)."""
+        cm = data["combine"]
+        w = rows_levels[0].shape[-1]
+        rows = jnp.concatenate(rows_levels, axis=1)
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((Pn, 1, w), rows.dtype)], axis=1)  # pad row
+        take = jax.vmap(lambda rp, gi: jnp.take(rp, gi, axis=0))
+        acc = None
+        for k in range(cm["gidx"].shape[-1]):
+            t = take(rows, cm["gidx"][:, :, k])
+            acc = t if acc is None else acc + t
+        y3 = y.reshape(Pn, self.n_node_loc, w) + acc
+        if cm["hnode"].shape[1]:
+            hacc = None
+            for k in range(cm["hgidx"].shape[-1]):
+                t = take(rows, cm["hgidx"][:, :, k])
+                hacc = t if hacc is None else hacc + t
+            y3 = jax.vmap(
+                lambda yp, idx, r: yp.at[idx].add(r, mode="drop")
+            )(y3, cm["hnode"], hacc)
+        return y3.reshape(Pn, -1)
+
+    @staticmethod
+    def _grid_rows(grid, Pn):
+        """(P*nb, w, bx+1, by+1, bz+1) block-batch grid -> (P, slots, w)
+        rows in the CombineMaps slot order."""
+        w = grid.shape[1]
+        return grid.transpose(0, 2, 3, 4, 1).reshape(Pn, -1, w)
 
     def _stencil(self, Ke, ck, xg, pallas_ok=False):
         """Structured brick matvec on one level grid (same formulations
@@ -403,11 +551,18 @@ class HybridOps(Ops):
         if data["levels"]:
             x3p = self._rows_pad(x)
             pal = self.pallas_levels or (False,) * len(data["levels"])
+            use_gather = self.combine == "gather" and "combine" in data
+            rows_levels = []
             for lv, dims, pok in zip(data["levels"], self.level_dims, pal):
                 xg = self._level_gather(x3p, lv, dims, Pn)
                 ck = lv["ck"].reshape((Pn * dims[0],) + lv["ck"].shape[2:])
                 yg = self._stencil(data["brick_Ke"], ck, xg, pallas_ok=pok)
-                y = self._level_scatter_add(y, yg, lv, dims, Pn)
+                if use_gather:
+                    rows_levels.append(self._grid_rows(yg, Pn))
+                else:
+                    y = self._level_scatter_add(y, yg, lv, dims, Pn)
+            if use_gather:
+                y = self._combined_gather_add(y, rows_levels, data, Pn)
         return y
 
     def diag_local(self, data):
@@ -417,6 +572,9 @@ class HybridOps(Ops):
         else:
             y = self._apply_springs_diag(
                 data, jnp.zeros((Pn, self.n_loc), data["weight"].dtype))
+        use_gather = (self.combine == "gather" and "combine" in data
+                      and data["levels"])
+        rows_levels = []
         for lv, dims in zip(data["levels"], self.level_dims):
             ck = lv["ck"].reshape((Pn * dims[0],) + lv["ck"].shape[2:])
             dk = data["brick_diag"]
@@ -431,7 +589,12 @@ class HybridOps(Ops):
             g = terms[0]
             for t in terms[1:]:
                 g = g + t
-            y = self._level_scatter_add(y, g, lv, dims, Pn)
+            if use_gather:
+                rows_levels.append(self._grid_rows(g, Pn))
+            else:
+                y = self._level_scatter_add(y, g, lv, dims, Pn)
+        if use_gather:
+            y = self._combined_gather_add(y, rows_levels, data, Pn)
         return y
 
     def _node_block_local(self, data):

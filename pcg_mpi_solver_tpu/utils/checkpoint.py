@@ -89,7 +89,22 @@ def _fingerprint(solver) -> dict:
         "matvec_form": getattr(solver.ops, "form", "n/a"),
         "level_dims": [list(d) for d in getattr(solver.ops, "level_dims",
                                                 ())],
+        # the hybrid level combine (gather vs scatter) also reorders the
+        # slot accumulation — pinned on the ops at construction; KD (the
+        # dense/heavy split of the gather maps) reorders it too and is
+        # frozen in the partition's built maps
+        "combine": getattr(solver.ops, "combine", "n/a"),
+        "combine_kd": _combine_kd(solver),
     }
+
+
+def _combine_kd(solver) -> int | str:
+    # only meaningful when the gather combine is the engaged path (KD
+    # does not touch scatter-mode numerics)
+    if getattr(solver.ops, "combine", "n/a") != "gather":
+        return "n/a"
+    cm = getattr(getattr(solver, "pm", None), "combine", None)
+    return int(cm.gidx.shape[-1]) if cm is not None else "n/a"
 
 
 def _effective_kernel(solver) -> str:
@@ -213,6 +228,13 @@ class CheckpointManager:
             # skip BOTH checks for legacy checkpoints rather than guess.
             saved.setdefault("matvec_form", want["matvec_form"])
             saved.setdefault("level_dims", want["level_dims"])
+            # pre-combine checkpoints are NOT ambiguous: only the scatter
+            # path existed, so a gather-mode resume must mismatch loudly
+            if "combine" not in saved:
+                saved["combine"] = ("scatter" if want["combine"] != "n/a"
+                                    else "n/a")
+                saved["combine_kd"] = "n/a" if saved["combine"] == "n/a" \
+                    else want["combine_kd"]
             if saved != want:
                 diffs = {k: (saved.get(k), want[k]) for k in want
                          if saved.get(k) != want[k]}

@@ -110,6 +110,46 @@ def test_solve_matches_general(model):
                                atol=1e-9 * np.abs(ug).max())
 
 
+def test_combine_gather_matches_scatter(pair):
+    """The scatter-free gather-combine (default) vs the row scatter —
+    identical matvec and diag up to f64 summation-order noise."""
+    import dataclasses
+
+    _, (ops_h, data_h), pm_g, hp = pair
+    assert ops_h.combine == "gather" and "combine" in data_h
+    ops_s = dataclasses.replace(ops_h, combine="scatter")
+    P = pm_g.n_parts
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(P, pm_g.n_loc)))
+    yg = np.asarray(ops_h.matvec(data_h, x))
+    ys = np.asarray(ops_s.matvec(data_h, x))
+    np.testing.assert_allclose(yg, ys, rtol=0,
+                               atol=1e-12 * np.abs(ys).max())
+    dg = np.asarray(ops_h.diag(data_h))
+    ds = np.asarray(ops_s.diag(data_h))
+    np.testing.assert_allclose(dg, ds, rtol=0,
+                               atol=1e-12 * np.abs(ds).max())
+
+
+def test_combine_maps_cover_every_slot_once(pair):
+    """Every real (non-pad-target) lattice slot appears in exactly one
+    gidx/hgidx cell; pad cells all point at the zero row."""
+    _, (ops_h, data_h), pm_g, hp = pair
+    cm = hp.combine
+    nn = hp.pm.n_node_loc
+    for p in range(hp.pm.n_parts):
+        tgt = np.concatenate(
+            [lv.nidx[p].reshape(-1) for lv in hp.levels]).astype(np.int64)
+        used = np.concatenate([cm.gidx[p].reshape(-1),
+                               cm.hgidx[p].reshape(-1)])
+        used = used[used < cm.n_slots]
+        # exactly the slots whose target is a real node, each once
+        expect = np.where(tgt < nn)[0]
+        np.testing.assert_array_equal(np.sort(used), expect)
+        # heavy node ids are real or pad
+        assert (cm.hnode[p] <= nn).all()
+
+
 def test_auto_backend_prefers_hybrid(model):
     s = Solver(model, RunConfig(), mesh=make_mesh(4), n_parts=4)
     assert s.backend == "hybrid"
