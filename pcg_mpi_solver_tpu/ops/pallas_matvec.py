@@ -493,7 +493,12 @@ def _matvec_kernel_v4(ke_ref, x_hbm, ck_hbm, y_ref,
             upper-corner (dx=1) partials, finishing the NEXT chunk's
             first output plane
     """
-    j = pl.program_id(0)
+    # i32 index arithmetic ALWAYS: under jax x64 (the solver's f64 dot
+    # mode) program_id arithmetic otherwise promotes to i64, and Mosaic
+    # rejects i64 memref_slice indices (observed on-HW 2026-07-30:
+    # "tpu.memref_slice ... (i32, i64, i32)" VerificationError from the
+    # driver's probe while the same kernel passed DMA under plain i32)
+    j = jnp.asarray(pl.program_id(0), jnp.int32)
     mt = m + sy + 2
 
     def for_chunk(slot, chunk, act):
