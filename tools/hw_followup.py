@@ -1,0 +1,54 @@
+"""Second-wave hardware queue for the 2026-07-30 session (round 3).
+
+Runs the measurements the first wave could not: the v4 Pallas A/B (i32
+fix landed mid-session), the true f64-direct flagship anchor, the
+combine-variant row microbench, and the octree flagship on the NEW
+gather-combine path.  Same probe/retry + step isolation as
+tools/hw_session.py.
+
+Usage: python tools/hw_followup.py [--deadline-min 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.hw_session import log_line, run_step  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=120)
+    ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
+    args = ap.parse_args()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, args.log)
+
+    from pcg_mpi_solver_tpu.bench import _probe_with_retry
+
+    log_line(path, f"hw_followup start (deadline {args.deadline_min:.0f} min)")
+    ok, detail = _probe_with_retry(budget_s=args.deadline_min * 60,
+                                   probe_timeout_s=600)
+    if not ok:
+        log_line(path, f"deadline reached; no followup session ({detail})")
+        sys.exit(3)
+    log_line(path, f"accelerator ANSWERED: {detail}")
+
+    run_step(path, "matvec A/B v4", ["examples/bench_matvec.py", "150"],
+             timeout=2400)
+    run_step(path, "f64 direct anchor", ["bench.py"],
+             env_extra={"BENCH_MODE": "direct", "BENCH_DTYPE": "float64"},
+             timeout=3600)
+    run_step(path, "combine variants", ["examples/bench_gather.py"],
+             timeout=1800)
+    run_step(path, "octree flagship (gather combine)", ["bench.py"],
+             env_extra={"BENCH_MODEL": "octree"}, timeout=5400)
+    log_line(path, "hw_followup complete")
+
+
+if __name__ == "__main__":
+    main()
