@@ -83,7 +83,8 @@ class ChunkedEngine:
                     glob_n_dof_eff=glob_n_dof_eff,
                     max_stag_steps=scfg.max_stag_steps,
                     max_iter_nominal=scfg.max_iter,
-                    carry_in=carry32, return_carry=True)
+                    carry_in=carry32, return_carry=True,
+                    plateau_window=scfg.mixed_plateau_window)
                 return res.x, carry2, res.flag
 
             self._inner_cycle_fn = smap(

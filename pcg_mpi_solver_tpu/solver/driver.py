@@ -273,6 +273,7 @@ class Solver:
                     glob_n_dof_eff=glob_n_eff,
                     max_stag_steps=solver_cfg.max_stag_steps,
                     inner_tol=solver_cfg.inner_tol,
+                    plateau_window=solver_cfg.mixed_plateau_window,
                 )
             else:
                 # preconditioner rebuild (pcg_solver.py:346-352)

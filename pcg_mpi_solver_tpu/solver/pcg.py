@@ -47,6 +47,7 @@ def cold_carry(x0, r0, normr0, dot_dtype) -> dict:
         rho=jnp.asarray(1.0, dd),
         stag=zero_i, moresteps=zero_i,
         normrmin=jnp.asarray(normr0, dd), xmin=x0, imin=zero_i,
+        since_best=zero_i, best_at_reset=jnp.asarray(normr0, dd),
         normr_act=jnp.asarray(normr0, dd), exec=zero_i)
 
 
@@ -55,7 +56,8 @@ def carry_part_specs(part_spec, rep_spec) -> dict:
     axis, bookkeeping scalars replicated)."""
     P, R = part_spec, rep_spec
     return dict(x=P, r=P, p=P, rho=R, stag=R, moresteps=R,
-                normrmin=R, xmin=P, imin=R, normr_act=R, exec=R)
+                normrmin=R, xmin=P, imin=R, since_best=R, best_at_reset=R,
+                normr_act=R, exec=R)
 
 
 def refine_tol(tolb, normr, inner_tol):
@@ -102,8 +104,18 @@ def pcg(
     max_iter_nominal: Optional[int] = None,
     carry_in: Optional[dict] = None,
     return_carry: bool = False,
+    plateau_window: int = 0,
 ):
     """Returns PCGResult, or (PCGResult, carry) with ``return_carry``.
+
+    ``plateau_window`` > 0 adds a plateau exit beyond MATLAB pcg's
+    stagnation test: if no meaningfully (0.1%) better minimal residual
+    appears for that many consecutive iterations, exit with flag 3 and
+    the min-residual iterate.  Off (0) by default and EXPERIMENTAL:
+    CG's residual is non-monotone pre-asymptotically, so short windows
+    false-trigger during healthy convergence (see SolverConfig.
+    mixed_plateau_window).  The counter rides the carry, so chunked
+    dispatch resumes it exactly.
 
     ``carry_in`` resumes the Krylov iteration from a previous call's carry
     (search direction, rho, stagnation/min-residual bookkeeping), making a
@@ -156,6 +168,10 @@ def pcg(
         normrmin=carry_in["normrmin"] if warm else normr0.astype(ops.dot_dtype),
         xmin=carry_in["xmin"] if warm else x0,
         imin=carry_in["imin"] if warm else jnp.asarray(0, jnp.int32),
+        since_best=(carry_in["since_best"] if warm
+                    else jnp.asarray(0, jnp.int32)),
+        best_at_reset=(carry_in["best_at_reset"] if warm
+                       else normr0.astype(ops.dot_dtype)),
     )
 
     def cond(c):
@@ -242,11 +258,24 @@ def pcg(
             normrmin = jnp.where(better, normr_act, c["normrmin"])
             xmin = jnp.where(better, x, c["xmin"])
             imin = jnp.where(better, i, c["imin"])
+            # the plateau counter demands a MEANINGFUL (0.1%) improvement
+            # since the LAST RESET (a snapshot, not the ratcheting
+            # normrmin: steady sub-0.1%-per-iteration convergence must
+            # accumulate against the snapshot and keep resetting, while
+            # hair-thin dips at the f32 floor must not)
+            improved = normr_act < c["best_at_reset"] * (1 - 1e-3)
+            since_best = jnp.where(improved, 0,
+                                   c["since_best"] + 1).astype(jnp.int32)
+            best_at_reset = jnp.where(improved, normr_act,
+                                      c["best_at_reset"])
 
             stagnated = (stag >= max_stag_steps) & ~converged & ~toosmall
+            plateaued = ((since_best > plateau_window) & ~converged
+                         & ~toosmall if plateau_window else jnp.asarray(False))
 
             flag = jnp.where(converged, 0,
-                    jnp.where(toosmall | stagnated, 3, 1)).astype(jnp.int32)
+                    jnp.where(toosmall | stagnated | plateaued, 3,
+                              1)).astype(jnp.int32)
             stop = flag != 1
             return dict(
                 x=x, r=r, p=p, rho=rho,
@@ -254,6 +283,7 @@ def pcg(
                 flag=flag, stag=stag, moresteps=moresteps,
                 iter_out=i,
                 normr_act=normr_act, normrmin=normrmin, xmin=xmin, imin=imin,
+                since_best=since_best, best_at_reset=best_at_reset,
             )
 
         return jax.lax.cond(flag2 | breakdown, on_break, on_continue, c)
@@ -302,7 +332,8 @@ def pcg(
         # Raw (non-finalized) continuation state: x is the LAST iterate, not
         # the min-residual fallback — resuming must continue the recurrence.
         carry = {k: c[k] for k in ("x", "r", "p", "rho", "stag", "moresteps",
-                                   "normrmin", "xmin", "imin", "normr_act")}
+                                   "normrmin", "xmin", "imin", "since_best",
+                                   "best_at_reset", "normr_act")}
         # Executed body-iteration count for host-side budget accounting
         # (result.iters reports the min-residual index on failure, which
         # would undercount).
@@ -327,6 +358,7 @@ def pcg_mixed(
     max_stag_steps: int = 3,
     inner_tol: float = 1e-5,
     max_outer: int = 12,
+    plateau_window: int = 0,
 ) -> PCGResult:
     """Mixed-precision PCG by iterative refinement (TPU performance path).
 
@@ -369,7 +401,12 @@ def pcg_mixed(
         rhat32 = (c["r"] / scale).astype(jnp.float32)
         remaining = jnp.maximum(max_iter - c["total"], 1)
         tol_cycle = refine_tol(tolb, scale, inner_tol)
-        inner = pcg(
+        # return_carry gives the EXECUTED body-iteration count: on flag-3
+        # exits inner.iters is the min-residual index, which would both
+        # undercount the reported work and let the budget run past
+        # max_iter.  (inner itself is still the finalized min-residual
+        # result — finalize runs before the carry branch.)
+        inner, icarry = pcg(
             ops32, data32,
             fext=rhat32,
             x0=jnp.zeros_like(rhat32),
@@ -379,11 +416,13 @@ def pcg_mixed(
             glob_n_dof_eff=glob_n_dof_eff,
             max_stag_steps=max_stag_steps,
             max_iter_nominal=max_iter,
+            plateau_window=plateau_window,
+            return_carry=True,
         )
         x = c["x"] + inner.x.astype(fext.dtype) * scale
         r = fext - amul64(x)
         normr = jnp.sqrt(ops64.wdot(w64, r, r))
-        total = c["total"] + inner.iters
+        total = c["total"] + jnp.maximum(icarry["exec"], 1)
         converged = normr <= tolb
         # no-progress guard: refinement must contract the residual
         stalled = normr > 0.5 * c["normr"]

@@ -104,3 +104,55 @@ def test_multistep_dirichlet_lifting():
     s2.step(1.0)
     u_full = s2.displacement_global()
     np.testing.assert_allclose(u_full, 2.0 * u_half, rtol=1e-5, atol=1e-10)
+
+
+def test_plateau_window_mechanism():
+    """The experimental plateau exit (off by default): a short window cuts
+    an f32 solve at floor earlier than MATLAB's stagnation protocol and
+    returns the min-residual iterate; window=0 is exactly the MATLAB
+    behavior.  Also pins WHY it is off by default: a too-short window
+    false-triggers during CG's non-monotone pre-asymptotic phase."""
+    from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+    from pcg_mpi_solver_tpu.parallel.partition import partition_model
+    from pcg_mpi_solver_tpu.solver.pcg import pcg
+
+    model = make_cube_model(6, 5, 5, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    pm = partition_model(model, 1)
+    data = device_data(pm, jnp.float32)
+    ops = Ops.from_model(pm, dot_dtype=jnp.float32)
+    eff = data["eff"]
+    fext = eff * data["F"]
+    x0 = jnp.zeros_like(fext)
+    d = eff * ops.diag(data)
+    inv_diag = jnp.where(d != 0, 1.0 / jnp.maximum(d, 1e-30), 0.0)
+    kw = dict(tol=1e-14, max_iter=1500,
+              glob_n_dof_eff=int(model.dof_eff.sum()))
+    res_full = pcg(ops, data, fext, x0, inv_diag, plateau_window=0, **kw)
+    res_plat = pcg(ops, data, fext, x0, inv_diag, plateau_window=10, **kw)
+    res_tiny = pcg(ops, data, fext, x0, inv_diag, plateau_window=5, **kw)
+    # MATLAB protocol alone: stagnation + MoreSteps end the grind
+    assert int(res_full.flag) == 3
+    # window=10 exits earlier with a min-residual iterate of useful quality
+    assert int(res_plat.flag) == 3
+    assert int(res_plat.iters) < int(res_full.iters)
+    assert float(res_plat.relres) < 1e-2
+    # the false-trigger hazard (the reason the default is off): a 5-iter
+    # window fires inside the pre-asymptotic residual wander
+    assert int(res_tiny.iters) < 10
+
+
+def test_mixed_converges_with_plateau_default():
+    model = make_cube_model(5, 4, 4, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-9, max_iter=4000, dtype="float32",
+                            dot_dtype="float64", precision_mode="mixed"),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1)
+    r = s.step(1.0)
+    assert r.flag == 0 and r.relres <= 1e-9
+    u = np.asarray(s.displacement_global())
+    np.testing.assert_allclose(u, scipy_solution(model), rtol=0,
+                               atol=1e-7 * np.abs(scipy_solution(model)).max())
