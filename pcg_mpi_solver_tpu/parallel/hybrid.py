@@ -501,6 +501,12 @@ class HybridOps(Ops):
             )(y3, cm["hnode"], hacc)
         return y3.reshape(Pn, -1)
 
+    def _use_gather(self, data) -> bool:
+        """ONE eligibility rule for the gather-combine across every
+        consumer method (matvec, diag, node blocks, nodal averaging)."""
+        return (self.combine == "gather" and "combine" in data
+                and bool(data["levels"]))
+
     @staticmethod
     def _grid_rows(grid, Pn):
         """(P*nb, w, bx+1, by+1, bz+1) block-batch grid -> (P, slots, w)
@@ -551,7 +557,7 @@ class HybridOps(Ops):
         if data["levels"]:
             x3p = self._rows_pad(x)
             pal = self.pallas_levels or (False,) * len(data["levels"])
-            use_gather = self.combine == "gather" and "combine" in data
+            use_gather = self._use_gather(data)
             rows_levels = []
             for lv, dims, pok in zip(data["levels"], self.level_dims, pal):
                 xg = self._level_gather(x3p, lv, dims, Pn)
@@ -572,8 +578,7 @@ class HybridOps(Ops):
         else:
             y = self._apply_springs_diag(
                 data, jnp.zeros((Pn, self.n_loc), data["weight"].dtype))
-        use_gather = (self.combine == "gather" and "combine" in data
-                      and data["levels"])
+        use_gather = self._use_gather(data)
         rows_levels = []
         for lv, dims in zip(data["levels"], self.level_dims):
             ck = lv["ck"].reshape((Pn * dims[0],) + lv["ck"].shape[2:])
@@ -609,14 +614,24 @@ class HybridOps(Ops):
                                 data["weight"].dtype))
         from pcg_mpi_solver_tpu.ops.precond import corner_block_field
 
+        use_gather = self._use_gather(data)
+        rows_levels = []
         for lv, dims in zip(data["levels"], self.level_dims):
             Pn = lv["ck"].shape[0]
             ck = lv["ck"].reshape((Pn * dims[0],) + lv["ck"].shape[2:])
             g = corner_block_field(data["brick_Ke"], ck, _CORNERS)
-            rows = g.transpose(0, 2, 3, 4, 1).reshape(Pn, -1, 9)
-            y = jax.vmap(
-                lambda yp, idx, r: yp.at[idx].add(r, mode="drop")
-            )(y, lv["nidx"].reshape(Pn, -1), rows)
+            if use_gather:
+                rows_levels.append(self._grid_rows(g, Pn))
+            else:
+                rows = self._grid_rows(g, Pn)
+                y = jax.vmap(
+                    lambda yp, idx, r: yp.at[idx].add(r, mode="drop")
+                )(y, lv["nidx"].reshape(Pn, -1), rows)
+        if use_gather:
+            Pn = y.shape[0]
+            y = self._combined_gather_add(
+                y.reshape(Pn, -1), rows_levels, data, Pn
+            ).reshape(Pn, self.n_node_loc, 9)
         return y
 
     # -- export protocol (strain + nodal averaging over blocks + levels) --
@@ -675,6 +690,17 @@ class HybridOps(Ops):
             sums = jax.vmap(scat)(sums, ids, contrib)
             counts = jax.vmap(scat)(counts, ids, ones)
 
+        # ONE pack/unpack for the (sums, counts) <-> (P, n_node_loc, k+1)
+        # row layout, shared by the gather and scatter combine branches
+        def pack():
+            return jnp.concatenate([sums, counts], axis=1).transpose(0, 2, 1)
+
+        def unpack(joined):
+            j = joined.transpose(0, 2, 1)
+            return j[:, :k], j[:, k:]
+
+        use_gather = self._use_gather(data)
+        rows_levels = []
         for lv, dims, vals in zip(data["levels"], self.level_dims,
                                   vals_list[nb:]):
             lnb, bx, by, bz = dims
@@ -692,14 +718,19 @@ class HybridOps(Ops):
             g = terms[0]
             for t in terms[1:]:
                 g = g + t                       # (P*nb, k+1, node grid)
-            rows = g.transpose(0, 2, 3, 4, 1).reshape(Pl, -1, k + 1)
-            joined = jnp.concatenate([sums, counts], axis=1) \
-                .transpose(0, 2, 1)             # (P, n_node_loc, k+1)
+            rows = self._grid_rows(g, Pl)
+            if use_gather:
+                rows_levels.append(rows)
+                continue
             joined = jax.vmap(
                 lambda jp, idx, r: jp.at[idx].add(r, mode="drop")
-            )(joined, lv["nidx"].reshape(Pl, -1), rows)
-            joined = joined.transpose(0, 2, 1)
-            sums, counts = joined[:, :k], joined[:, k:]
+            )(pack(), lv["nidx"].reshape(Pl, -1), rows)
+            sums, counts = unpack(joined)
+        if use_gather and rows_levels:
+            joined = self._combined_gather_add(
+                pack().reshape(Pl, -1), rows_levels, data, Pl
+            ).reshape(Pl, self.n_node_loc, k + 1)
+            sums, counts = unpack(joined)
 
         both = jnp.concatenate([sums, counts], axis=1)
         both = self.niface_assemble(data, both)

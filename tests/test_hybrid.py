@@ -129,6 +129,17 @@ def test_combine_gather_matches_scatter(pair):
     ds = np.asarray(ops_s.diag(data_h))
     np.testing.assert_allclose(dg, ds, rtol=0,
                                atol=1e-12 * np.abs(ds).max())
+    # node-block preconditioner assembly and nodal averaging share the
+    # combine; the scatter branches must stay live-equivalent too
+    bg = np.asarray(ops_h._node_block_local(data_h))
+    bs_ = np.asarray(ops_s._node_block_local(data_h))
+    np.testing.assert_allclose(bg, bs_, rtol=0,
+                               atol=1e-12 * np.abs(bs_).max())
+    eg = ops_h.elem_strain(data_h, x)
+    ag = np.asarray(ops_h.nodal_average(data_h, eg))
+    as_ = np.asarray(ops_s.nodal_average(data_h, ops_s.elem_strain(data_h, x)))
+    np.testing.assert_allclose(ag, as_, rtol=0,
+                               atol=1e-11 * max(np.abs(as_).max(), 1e-30))
 
 
 def test_combine_maps_cover_every_slot_once(pair):
