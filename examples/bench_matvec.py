@@ -82,6 +82,11 @@ def main():
     for c in (8, 16):
         variants.append((f"pallas v4 C={c}", functools.partial(
             structured_matvec_pallas_v4, planes=c)))
+    from pcg_mpi_solver_tpu.ops.pallas_matvec import (
+        structured_matvec_pallas_v5)
+    for c in (8, 16):
+        variants.append((f"pallas v5 C={c}", functools.partial(
+            structured_matvec_pallas_v5, planes=c)))
     for name, fn in variants:
         try:
             t, y = timeit(fn, xg, blk["ck"][0], blk["Ke"])

@@ -108,38 +108,31 @@ def batched_structured_matvec(xg, ck, Ke):
     part per level, negligible against a PCG iteration).
 
     PCG_TPU_PALLAS_V selects the variant (1 = per-plane VPU-FMA, 2 =
-    per-plane MXU, 3 = chunked double-buffered MXU, default 4 =
-    reshape-free chunked — the only one the deployed Mosaic toolchain
-    lowers, docs/RUNBOOK.md)."""
+    per-plane MXU, 3 = chunked double-buffered MXU, 4 = reshape-free
+    chunked — fails Mosaic concat-offset checks on its corner pads,
+    default 5 = layout-legal chunked, docs/RUNBOOK.md)."""
     fn = selected_variant()[1]
     return jnp.stack([fn(xg[p], ck[p], Ke) for p in range(xg.shape[0])])
 
 
-def _v3_env(xg, ck, Ke, *, interpret=False):
-    """v3 with the chunk size from PCG_TPU_PALLAS_PLANES (default 8 —
-    the smallest Mosaic-legal block, see structured_matvec_pallas_v3)."""
-    import os
+def _planes_env(fn):
+    """Wrap a chunked variant so it reads its chunk size from
+    PCG_TPU_PALLAS_PLANES (default 8 — the smallest Mosaic-legal
+    block)."""
 
-    planes = int(os.environ.get("PCG_TPU_PALLAS_PLANES", "8"))
-    if planes % 8 != 0:
-        # a typo'd knob would otherwise fail Mosaic lowering and silently
-        # degrade pallas='auto' to the XLA path
-        raise ValueError(
-            f"PCG_TPU_PALLAS_PLANES must be a multiple of 8, got {planes}")
-    return structured_matvec_pallas_v3(xg, ck, Ke, interpret=interpret,
-                                       planes=planes)
+    def wrapped(xg, ck, Ke, *, interpret=False):
+        import os
 
+        planes = int(os.environ.get("PCG_TPU_PALLAS_PLANES", "8"))
+        if planes % 8 != 0:
+            # a typo'd knob would otherwise fail Mosaic lowering and
+            # silently degrade pallas='auto' to the XLA path
+            raise ValueError(
+                f"PCG_TPU_PALLAS_PLANES must be a multiple of 8, "
+                f"got {planes}")
+        return fn(xg, ck, Ke, interpret=interpret, planes=planes)
 
-def _v4_env(xg, ck, Ke, *, interpret=False):
-    """v4 with the chunk size from PCG_TPU_PALLAS_PLANES (default 8)."""
-    import os
-
-    planes = int(os.environ.get("PCG_TPU_PALLAS_PLANES", "8"))
-    if planes % 8 != 0:
-        raise ValueError(
-            f"PCG_TPU_PALLAS_PLANES must be a multiple of 8, got {planes}")
-    return structured_matvec_pallas_v4(xg, ck, Ke, interpret=interpret,
-                                       planes=planes)
+    return wrapped
 
 
 def selected_variant():
@@ -149,16 +142,18 @@ def selected_variant():
     retrace (build a new Solver to switch)."""
     import os
 
-    v = os.environ.get("PCG_TPU_PALLAS_V", "4")
+    v = os.environ.get("PCG_TPU_PALLAS_V", "5")
     if v == "1":
         return "v1", structured_matvec_pallas
     if v == "2":
         return "v2", structured_matvec_pallas_v2
     if v == "3":
-        return "v3", _v3_env
-    if v != "4":
-        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3|4, got {v!r}")
-    return "v4", _v4_env
+        return "v3", _planes_env(structured_matvec_pallas_v3)
+    if v == "4":
+        return "v4", _planes_env(structured_matvec_pallas_v4)
+    if v != "5":
+        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3|4|5, got {v!r}")
+    return "v5", _planes_env(structured_matvec_pallas_v5)
 
 
 def probe_shapes(shapes, dtype=jnp.float32) -> None:
@@ -359,7 +354,11 @@ def _matvec_kernel_v3(ke_ref, x_hbm, ck_hbm, y_ref,
         are recreated identically at wait time (standard double-buffering
         pattern); out-of-range tail planes are skipped on BOTH sides."""
         for k in range(cpp + 1):
-            plane = chunk * cpp + k
+            # i32 ALWAYS: the static _init path (chunk = python 0)
+            # otherwise traces plane as i64 under jax x64, and
+            # Mosaic rejects i64 memref_slice indices (observed
+            # on-HW 2026-07-31 from the driver's f64-mode probe)
+            plane = jnp.asarray(chunk * cpp + k, jnp.int32)
 
             @pl.when(plane < nxn)
             def _cp():
@@ -508,7 +507,11 @@ def _matvec_kernel_v4(ke_ref, x_hbm, ck_hbm, y_ref,
         double-buffering pattern); out-of-range tail planes are skipped
         on BOTH sides."""
         for k in range(cpp + 1):
-            plane = chunk * cpp + k
+            # i32 ALWAYS: the static _init path (chunk = python 0)
+            # otherwise traces plane as i64 under jax x64, and
+            # Mosaic rejects i64 memref_slice indices (observed
+            # on-HW 2026-07-31 from the driver's f64-mode probe)
+            plane = jnp.asarray(chunk * cpp + k, jnp.int32)
 
             @pl.when(plane < nxn)
             def _cp():
@@ -566,6 +569,161 @@ def _matvec_kernel_v4(ke_ref, x_hbm, ck_hbm, y_ref,
             y_ref[c, k] = out[c, :m]
         carry = hi
     acc[...] = carry
+
+
+# ----------------------------------------------------------------------
+# v5: v4 minus every Mosaic-illegal layout op.  The 2026-07-31 hardware
+# session pinned v4's failure to its corner-placement pads:
+#
+#   tpu.concatenate (3x22801)+(3x153) -> (3x22954), in_layouts
+#   {3,0} / {0,17} — "result/input offset mismatch on non-concat
+#   dimension"
+#
+# i.e. (a) v[3a:3a+3] — a slice of a LOADED vector — carries sublane
+# offset 3 while the pad's zeros are offset 0, and (b) the pad boundary
+# m = 22801 = 17 (mod 128) puts the zeros at a misaligned lane offset.
+# Three surgical fixes, same dataflow as v4 otherwise:
+#
+#   1. the per-corner product block is produced by its OWN small dot
+#      ke[3a:3a+3] @ u — a fresh dot result gets a canonical {0,0}
+#      layout, unlike v4's v[3a:3a+3] vector slice (sublane offset 3).
+#      8 M=3 dots cost ~2.7x the one M=24 dot in MXU time, but the MXU
+#      is ~0.3 us/plane against an HBM-bound kernel — irrelevant.
+#   2. the lane axis is padded to m128 (a 128-multiple) on the host, so
+#      the only remaining concatenate — the right-pad to mt128 — joins
+#      at an aligned lane boundary with both inputs at {0,0}.
+#   3. corner lane placement is pltpu.roll (tpu rotate primitive), not
+#      an offset pad; the cyclic wrap only ever carries the zeroed lane
+#      tail (mt128 - off >= m128 for every corner offset).
+# ----------------------------------------------------------------------
+
+
+def _matvec_kernel_v5(ke_ref, x_hbm, ck_hbm, y_ref,
+                      xv, ckv, acc, sems, ck_sems,
+                      *, g, cpp, nxn, m128, mt128, sy):
+    """One grid step = cpp finished output node planes.
+
+    ke_ref: (24, 24) VMEM
+    x_hbm:  (3, nxn, m) ANY/HBM — NOT lane-padded (padding x would cost
+            a full extra HBM round trip of the grid per matvec); VMEM
+            rows are m128-wide, lanes [m:m128] stay zero from _init and
+            only ever multiply ck = 0 (ck_hbm IS lane-padded — that pad
+            is loop-invariant, so XLA hoists it out of the PCG loop)
+    ck_hbm: (g*cpp, m128) ANY/HBM (zero-padded both axes)
+    y_ref:  (3, cpp, m128) VMEM output block
+    xv:     (2, 3, cpp+1, mt128) VMEM double-buffered chunk + overlap
+            plane; zeroed lane tail holds the corner-read overhang
+    ckv:    (2, cpp, m128) VMEM
+    acc:    (3, mt128) VMEM — dx=1 partials carried to the next plane
+    """
+    j = jnp.asarray(pl.program_id(0), jnp.int32)  # i32 ALWAYS (see v4)
+    m = x_hbm.shape[-1]
+
+    def for_chunk(slot, chunk, act):
+        for k in range(cpp + 1):
+            # i32 ALWAYS: the static _init path (chunk = python 0)
+            # otherwise traces plane as i64 under jax x64, and
+            # Mosaic rejects i64 memref_slice indices (observed
+            # on-HW 2026-07-31 from the driver's f64-mode probe)
+            plane = jnp.asarray(chunk * cpp + k, jnp.int32)
+
+            @pl.when(plane < nxn)
+            def _cp():
+                getattr(pltpu.make_async_copy(
+                    x_hbm.at[:, plane],
+                    xv.at[slot, :, k, pl.ds(0, m)], sems.at[slot]), act)()
+        getattr(pltpu.make_async_copy(
+            ck_hbm.at[pl.ds(chunk * cpp, cpp)],
+            ckv.at[slot], ck_sems.at[slot]), act)()
+
+    @pl.when(j == 0)
+    def _init():
+        xv[...] = jnp.zeros_like(xv)       # zero overhang tails once
+        acc[...] = jnp.zeros_like(acc)
+        for_chunk(0, 0, "start")
+
+    slot = jax.lax.rem(j, jnp.asarray(2, j.dtype))
+    for_chunk(slot, j, "wait")
+
+    @pl.when(j + 1 < g)
+    def _prefetch():
+        for_chunk(1 - slot, j + 1, "start")
+
+    ke = ke_ref[...]                                    # (24, 24)
+    xb = xv[slot]                                       # (3, cpp+1, mt128)
+    ckb = ckv[slot]                                     # (cpp, m128)
+    carry = acc[...]                                    # (3, mt128)
+    for k in range(cpp):
+        ck = ckb[k]                                     # (m128,)
+        rows = []
+        for a, (dx, dy, dz) in enumerate(_CORNERS):
+            off = dy * sy + dz
+            for c in range(3):
+                rows.append(ck * xb[c, k + dx, off:off + m128])
+        u = jnp.stack(rows)                             # (24, m128)
+        lo = jnp.zeros((3, mt128), u.dtype)
+        hi = jnp.zeros((3, mt128), u.dtype)
+        for b, (dx, dy, dz) in enumerate(_CORNERS):
+            off = dy * sy + dz
+            blk = jax.lax.dot_general(
+                ke[3 * b:3 * b + 3], u, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)     # (3, m128), {0,0}
+            vp = jnp.pad(blk, ((0, 0), (0, mt128 - m128)))  # aligned concat
+            if off:
+                vp = pltpu.roll(vp, off, 1)             # lane rotate
+            if dx == 0:
+                lo = lo + vp
+            else:
+                hi = hi + vp
+        out = carry + lo
+        for c in range(3):
+            y_ref[c, k] = out[c, :m128]
+        carry = hi
+    acc[...] = carry
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "planes"))
+def structured_matvec_pallas_v5(xg, ck, Ke, *, interpret=False, planes=8):
+    """Layout-legal variant of :func:`structured_matvec_pallas_v4`.
+
+    Same signature/semantics: xg (3, nx+1, ny+1, nz+1), ck (nx, ny, nz),
+    Ke (24, 24), all f32; ``planes`` = cell planes per grid step
+    (multiple of 8 — the output BlockSpec's sublane axis)."""
+    _, nxn, nyn, nzn = xg.shape
+    nx = nxn - 1
+    m = nyn * nzn
+    m128 = -(-m // 128) * 128
+    sy = nzn
+    mt128 = m128 + (-(-(sy + 2) // 128)) * 128
+    cpp = max(1, min(planes, ((nx + 1 + 7) // 8) * 8))
+    g = -(-(nx + 1) // cpp)                 # ceil: covers all output planes
+    x_flat = xg.reshape(3, nxn, m)          # free reshape, no copy
+    # ck pads are loop-invariant, so XLA hoists them out of the PCG loop
+    ck_pad = jnp.pad(ck, ((0, g * cpp - nx), (0, 1), (0, 1))) \
+        .reshape(g * cpp, m)
+    ck_pad = jnp.pad(ck_pad, ((0, 0), (0, m128 - m)))
+    kernel = functools.partial(_matvec_kernel_v5, g=g, cpp=cpp, nxn=nxn,
+                               m128=m128, mt128=mt128, sy=sy)
+    y = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # Ke
+            pl.BlockSpec(memory_space=pl.ANY),         # x (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),         # ck (manual DMA)
+        ],
+        out_specs=pl.BlockSpec((3, cpp, m128), lambda j: (0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, g * cpp, m128), xg.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, 3, cpp + 1, mt128), xg.dtype),
+            pltpu.VMEM((2, cpp, m128), ck.dtype),
+            pltpu.VMEM((3, mt128), xg.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(Ke, x_flat, ck_pad)
+    return y[:, :nxn, :m].reshape(3, nxn, nyn, nzn)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "planes"))
