@@ -1,0 +1,61 @@
+"""Third-wave hardware queue for the 2026-07-31 session (round 3).
+
+Runs what the second wave could not: the v5 Pallas A/B (layout-legal
+kernel committed mid-session, eda25cd), a flagship bench with the v5
+probe live (if v5 lowers, the fused path engages and the headline moves),
+and the two breakdown runs wave 1 lost to the grant wedge.  Same
+probe/retry + step isolation as tools/hw_session.py.
+
+Usage: python tools/hw_wave3.py [--deadline-min 240]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.hw_session import log_line, run_step  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=240)
+    ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
+    args = ap.parse_args()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, args.log)
+
+    from pcg_mpi_solver_tpu.bench import _probe_with_retry
+
+    log_line(path, f"hw_wave3 start (deadline {args.deadline_min:.0f} min)")
+    ok, detail = _probe_with_retry(budget_s=args.deadline_min * 60,
+                                   probe_timeout_s=600)
+    if not ok:
+        log_line(path, f"deadline reached; no wave3 session ({detail})")
+        sys.exit(3)
+    log_line(path, f"accelerator ANSWERED: {detail}")
+
+    run_step(path, "matvec A/B v5", ["examples/bench_matvec.py", "150"],
+             timeout=2400)
+    # default bench (mixed flagship): pallas='auto' now probes v5 — if it
+    # lowers, this is the first fused-path headline number
+    run_step(path, "flagship (v5 probe live)", ["bench.py"], timeout=3600)
+    # f64-direct anchor: 150^3/128^3 f64 fail REMOTE COMPILE (UNAVAILABLE,
+    # ~25 min each before erroring — the second-wave step burned its whole
+    # budget on them); pin the largest size that can realistically compile
+    run_step(path, "f64 direct anchor 96", ["bench.py"],
+             env_extra={"BENCH_MODE": "direct", "BENCH_DTYPE": "float64",
+                        "BENCH_NX": "96"},
+             timeout=3600)
+    run_step(path, "iteration breakdown", ["examples/bench_iter_breakdown.py",
+                                           "150"], timeout=2400)
+    run_step(path, "hybrid breakdown", ["examples/bench_hybrid_breakdown.py"],
+             timeout=2400)
+    log_line(path, "hw_wave3 complete")
+
+
+if __name__ == "__main__":
+    main()
