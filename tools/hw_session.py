@@ -41,7 +41,26 @@ def log_line(path, msg):
         f.write(line + "\n")
 
 
-def run_step(path, name, argv, env_extra=None, timeout=3600):
+_last_step_ok = True
+
+
+def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900):
+    """Run one checklist step.  If the PREVIOUS step failed or timed out,
+    first re-probe the accelerator (bounded by ``gate_s``): a SIGKILLed
+    step wedges the device grant for minutes (docs/RUNBOOK.md), and the
+    example scripts — unlike bench.py — have no probe/retry of their own,
+    so without this gate they die instantly at the first device touch
+    (observed: second-wave combine-variants step, rc=1 after the f64
+    step's timeout kill)."""
+    global _last_step_ok
+    if not _last_step_ok and gate_s:
+        from pcg_mpi_solver_tpu.bench import _probe_with_retry
+
+        log_line(path, f"gate: previous step failed; re-probing before "
+                       f"{name} (wedged-grant guard, {gate_s:.0f}s budget)")
+        ok, detail = _probe_with_retry(budget_s=gate_s, probe_timeout_s=300)
+        log_line(path, f"gate: {'accelerator ok' if ok else 'STILL DOWN'} "
+                       f"({detail}); launching step regardless")
     env = dict(os.environ)
     env.setdefault("PCG_TPU_VERBOSE", "1")
     # examples/*.py run with sys.path[0]=examples/, and the package is
@@ -79,6 +98,7 @@ def run_step(path, name, argv, env_extra=None, timeout=3600):
                 pass    # a daemonized escapee; the group is dead, move on
             status = f"TIMEOUT after {timeout}s (process group killed)"
     wall = time.monotonic() - t0
+    _last_step_ok = status == "rc=0"
     log_line(path, f"=== {name} done: {status} ({wall:.0f}s)")
 
 
