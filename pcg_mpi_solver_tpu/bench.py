@@ -40,7 +40,7 @@ driver exactly once, in whatever infrastructure weather prevails):
   (default 1800 s) instead of giving up after one 3-minute attempt;
 - a size LADDER retries the solve at smaller models if the flagship size
   fails to build/compile/converge (cube: BENCH_LADDER nx rungs, default
-  "150,128,96"; octree: BENCH_OT_LADDER n0 rungs, default "12,10,8");
+  "150,128,96"; octree: BENCH_OT_LADDER n0 rungs, default "22,18,12");
 - the live numpy baseline runs in a crash-isolated SUBPROCESS with a
   timeout; if it fails, the pre-validated constant is used instead;
 - if the accelerator never comes up, BENCH_CPU_FALLBACK=1 (default) runs
@@ -50,7 +50,8 @@ Env knobs: BENCH_NX/NY/NZ (cells), BENCH_TOL, BENCH_PARTS, BENCH_DTYPE,
 BENCH_MODE (mixed|direct), BENCH_BACKEND (auto|structured|general),
 BENCH_REF_ITERS, BENCH_REF_MAX_DOFS, BENCH_MODEL (cube|octree),
 BENCH_OT_N, BENCH_OT_LEVEL, BENCH_PROBE_BUDGET_S, BENCH_LADDER,
-BENCH_OT_LADDER, BENCH_CPU_FALLBACK, BENCH_REF_TIMEOUT_S; plus the
+BENCH_OT_LADDER, BENCH_CPU_FALLBACK, BENCH_REF_TIMEOUT_S,
+BENCH_PLATEAU (mixed-mode inner plateau-exit window, 0=off); plus the
 solver-level performance knobs PCG_TPU_MATVEC_FORM / PCG_TPU_PALLAS_V /
 PCG_TPU_PALLAS_PLANES / PCG_TPU_HYBRID_BLOCK (docs/RUNBOOK.md knob
 table) — the engaged form is reported in detail.matvec_form.
@@ -257,7 +258,9 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
     cfg = RunConfig(
         solver=SolverConfig(tol=tol, max_iter=20000, dtype=dtype,
                             dot_dtype="float64", precision_mode=mode,
-                            pallas=os.environ.get("BENCH_PALLAS", "auto")),
+                            pallas=os.environ.get("BENCH_PALLAS", "auto"),
+                            mixed_plateau_window=int(
+                                os.environ.get("BENCH_PLATEAU", 0))),
         time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
     )
     t_part0 = time.perf_counter()

@@ -110,7 +110,9 @@ def batched_structured_matvec(xg, ck, Ke):
     PCG_TPU_PALLAS_V selects the variant (1 = per-plane VPU-FMA, 2 =
     per-plane MXU, 3 = chunked double-buffered MXU, 4 = reshape-free
     chunked — fails Mosaic concat-offset checks on its corner pads,
-    default 5 = layout-legal chunked, docs/RUNBOOK.md)."""
+    5 = layout-legal chunked — fails Mosaic DMA slicing (size-1 sublane
+    plane copies), default 6 = v5 compute + slab-aligned DMA,
+    docs/RUNBOOK.md)."""
     fn = selected_variant()[1]
     return jnp.stack([fn(xg[p], ck[p], Ke) for p in range(xg.shape[0])])
 
@@ -142,7 +144,7 @@ def selected_variant():
     retrace (build a new Solver to switch)."""
     import os
 
-    v = os.environ.get("PCG_TPU_PALLAS_V", "5")
+    v = os.environ.get("PCG_TPU_PALLAS_V", "6")
     if v == "1":
         return "v1", structured_matvec_pallas
     if v == "2":
@@ -151,9 +153,11 @@ def selected_variant():
         return "v3", _planes_env(structured_matvec_pallas_v3)
     if v == "4":
         return "v4", _planes_env(structured_matvec_pallas_v4)
-    if v != "5":
-        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3|4|5, got {v!r}")
-    return "v5", _planes_env(structured_matvec_pallas_v5)
+    if v == "5":
+        return "v5", _planes_env(structured_matvec_pallas_v5)
+    if v != "6":
+        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3|4|5|6, got {v!r}")
+    return "v6", _planes_env(structured_matvec_pallas_v6)
 
 
 def probe_shapes(shapes, dtype=jnp.float32) -> None:
@@ -764,3 +768,164 @@ def structured_matvec_pallas_v4(xg, ck, Ke, *, interpret=False, planes=8):
         interpret=interpret,
     )(Ke, x_flat, ck_pad)
     return y[:, :nxn].reshape(3, nxn, nyn, nzn)
+
+
+# ----------------------------------------------------------------------
+# v6: v5's compute, slab-aligned DMA.
+#
+# The 2026-07-31 wave-3 A/B showed v5 lowering PAST v4's concat error
+# into the DMA legality check v1 hit from the start:
+#
+#   tpu.memref_slice (3,152,22912) -> (3,1,22801): "Slice shape along
+#   dimension 1 must be aligned to tiling (8), but is 1"
+#
+# i.e. on this toolchain a DMA may slice a TILED dimension only in
+# multiples of the tile (8 sublanes / 128 lanes) at tile-aligned
+# offsets; the per-plane x copies (one node plane = a size-1 sublane
+# slice) that every variant v1-v5 used are categorically illegal —
+# v3/v4 just died in earlier layout passes before reaching this check.
+# v6 keeps v5's compute body (fresh per-corner dots, m128-aligned pads,
+# pltpu.roll placement — everything v4/v5 already fixed) and makes every
+# DMA slab-aligned:
+#
+#   1. x is host-padded to (3, g*cpp + 8, m128) — lanes to a
+#      128-multiple, planes so every slab read is in bounds.  The pad is
+#      one extra HBM round-trip of x per matvec (~0.1 ms at the 10M-dof
+#      flagship) — acceptable until the structured backend keeps x in
+#      padded layout natively.
+#   2. each grid step DMAs ONE slab of cpp+8 planes (cpp % 8 == 0, so
+#      both the chunk offset j*cpp and the slice shape cpp+8 are
+#      8-aligned) at FULL m128 lane width into rows [0, cpp+8) of the
+#      mt128-wide chunk buffer (lane slice offset 0, shape m128 — a
+#      128-multiple).  The 8 extra planes per chunk cover the +dx=1
+#      corner overlap (only 1 is needed; 8 is the smallest legal slab),
+#      costing 2x x reads at cpp=8 — ~84 MB/matvec at the flagship
+#      against the unfused path's ~1.7 GB.
+#   3. ck was already slab-copied (cpp planes, m128 lanes) — unchanged.
+# ----------------------------------------------------------------------
+
+
+def _matvec_kernel_v6(ke_ref, x_hbm, ck_hbm, y_ref,
+                      xv, ckv, acc, sems, ck_sems,
+                      *, g, cpp, m128, mt128, sy):
+    """One grid step = cpp finished output node planes.
+
+    ke_ref: (24, 24) VMEM
+    x_hbm:  (3, g*cpp + 8, m128) ANY/HBM — lane- AND plane-padded on the
+            host (see v6 header note); pad lanes/planes are zero, and
+            out-of-range corner reads only ever multiply ck = 0
+    ck_hbm: (g*cpp, m128) ANY/HBM (zero-padded both axes)
+    y_ref:  (3, cpp, m128) VMEM output block
+    xv:     (2, 3, cpp+8, mt128) VMEM double-buffered slab; lanes
+            [m128, mt128) stay zero from _init and hold the corner-read
+            overhang
+    ckv:    (2, cpp, m128) VMEM
+    acc:    (3, mt128) VMEM — dx=1 partials carried to the next plane
+    """
+    j = jnp.asarray(pl.program_id(0), jnp.int32)  # i32 ALWAYS (see v4)
+
+    def for_chunk(slot, chunk, act):
+        # i32 ALWAYS: the static _init path (chunk = python 0) otherwise
+        # traces the offset as i64 under jax x64 (see v5)
+        c0 = jnp.asarray(chunk * cpp, jnp.int32)
+        getattr(pltpu.make_async_copy(
+            x_hbm.at[:, pl.ds(c0, cpp + 8), :],
+            xv.at[slot, :, :, pl.ds(0, m128)], sems.at[slot]), act)()
+        getattr(pltpu.make_async_copy(
+            ck_hbm.at[pl.ds(c0, cpp)],
+            ckv.at[slot], ck_sems.at[slot]), act)()
+
+    @pl.when(j == 0)
+    def _init():
+        xv[...] = jnp.zeros_like(xv)       # zero overhang tails once
+        acc[...] = jnp.zeros_like(acc)
+        for_chunk(0, 0, "start")
+
+    slot = jax.lax.rem(j, jnp.asarray(2, j.dtype))
+    for_chunk(slot, j, "wait")
+
+    @pl.when(j + 1 < g)
+    def _prefetch():
+        for_chunk(1 - slot, j + 1, "start")
+
+    # ---- compute: verbatim v5 (fresh per-corner dots, aligned pads,
+    # roll placement) — only the xb row count differs (cpp+8 vs cpp+1).
+    ke = ke_ref[...]                                    # (24, 24)
+    xb = xv[slot]                                       # (3, cpp+8, mt128)
+    ckb = ckv[slot]                                     # (cpp, m128)
+    carry = acc[...]                                    # (3, mt128)
+    for k in range(cpp):
+        ck = ckb[k]                                     # (m128,)
+        rows = []
+        for a, (dx, dy, dz) in enumerate(_CORNERS):
+            off = dy * sy + dz
+            for c in range(3):
+                rows.append(ck * xb[c, k + dx, off:off + m128])
+        u = jnp.stack(rows)                             # (24, m128)
+        lo = jnp.zeros((3, mt128), u.dtype)
+        hi = jnp.zeros((3, mt128), u.dtype)
+        for b, (dx, dy, dz) in enumerate(_CORNERS):
+            off = dy * sy + dz
+            blk = jax.lax.dot_general(
+                ke[3 * b:3 * b + 3], u, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)     # (3, m128), {0,0}
+            vp = jnp.pad(blk, ((0, 0), (0, mt128 - m128)))  # aligned concat
+            if off:
+                vp = pltpu.roll(vp, off, 1)             # lane rotate
+            if dx == 0:
+                lo = lo + vp
+            else:
+                hi = hi + vp
+        out = carry + lo
+        for c in range(3):
+            y_ref[c, k] = out[c, :m128]
+        carry = hi
+    acc[...] = carry
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "planes"))
+def structured_matvec_pallas_v6(xg, ck, Ke, *, interpret=False, planes=8):
+    """Slab-DMA variant of :func:`structured_matvec_pallas_v5`.
+
+    Same signature/semantics: xg (3, nx+1, ny+1, nz+1), ck (nx, ny, nz),
+    Ke (24, 24), all f32; ``planes`` = cell planes per grid step
+    (multiple of 8 — the output BlockSpec's sublane axis AND the DMA
+    slab alignment).  VMEM budget caps planes at 8 for flagship m."""
+    _, nxn, nyn, nzn = xg.shape
+    nx = nxn - 1
+    m = nyn * nzn
+    m128 = -(-m // 128) * 128
+    sy = nzn
+    mt128 = m128 + (-(-(sy + 2) // 128)) * 128
+    cpp = max(1, min(planes, ((nx + 1 + 7) // 8) * 8))
+    g = -(-(nx + 1) // cpp)                 # ceil: covers all output planes
+    x_flat = xg.reshape(3, nxn, m)          # free reshape, no copy
+    # x pad: ONE fused pad to (planes, lanes) the slab DMA can read
+    # whole; costs an extra HBM round trip of x per matvec (v6 header).
+    x_pad = jnp.pad(x_flat, ((0, 0), (0, g * cpp + 8 - nxn), (0, m128 - m)))
+    # ck pads are loop-invariant, so XLA hoists them out of the PCG loop
+    ck_pad = jnp.pad(ck, ((0, g * cpp - nx), (0, 1), (0, 1))) \
+        .reshape(g * cpp, m)
+    ck_pad = jnp.pad(ck_pad, ((0, 0), (0, m128 - m)))
+    kernel = functools.partial(_matvec_kernel_v6, g=g, cpp=cpp,
+                               m128=m128, mt128=mt128, sy=sy)
+    y = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # Ke
+            pl.BlockSpec(memory_space=pl.ANY),         # x (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),         # ck (manual DMA)
+        ],
+        out_specs=pl.BlockSpec((3, cpp, m128), lambda j: (0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, g * cpp, m128), xg.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, 3, cpp + 8, mt128), xg.dtype),
+            pltpu.VMEM((2, cpp, m128), ck.dtype),
+            pltpu.VMEM((3, mt128), xg.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(Ke, x_pad, ck_pad)
+    return y[:, :nxn, :m].reshape(3, nxn, nyn, nzn)

@@ -55,14 +55,20 @@ def test_pallas_matvec_v2_matches_xla(dims):
         np.asarray(y).reshape(-1), y_ref, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("variant", ["v3", "v4", "v5", "v6"])
 @pytest.mark.parametrize("dims,planes", [((6, 5, 4), 2), ((4, 4, 4), 4),
                                          ((7, 3, 5), 3), ((5, 4, 3), 8)])
-def test_pallas_matvec_v3_matches_xla(dims, planes):
-    """Chunked double-buffered variant, incl. chunk sizes that do not
-    divide nx+1 (tail handled by zero padding)."""
-    from pcg_mpi_solver_tpu.ops.pallas_matvec import (
-        structured_matvec_pallas_v3)
+def test_pallas_matvec_chunked_matches_xla(variant, dims, planes):
+    """Chunked variants vs the XLA matvec, incl. chunk sizes that do not
+    divide nx+1 (tail handled by zero padding / skipped copies):
+    v3 double-buffered MXU, v4 reshape-free, v5 layout-legal (canonical
+    per-corner dots, aligned pad + lane roll), v6 slab-aligned DMA."""
+    from pcg_mpi_solver_tpu.ops import pallas_matvec as pm
 
+    fn = {"v3": pm.structured_matvec_pallas_v3,
+          "v4": pm.structured_matvec_pallas_v4,
+          "v5": pm.structured_matvec_pallas_v5,
+          "v6": pm.structured_matvec_pallas_v6}[variant]
     nx, ny, nz = dims
     model = make_cube_model(nx, ny, nz, heterogeneous=True, seed=11)
     sp = partition_structured(model, 1)
@@ -75,78 +81,26 @@ def test_pallas_matvec_v3_matches_xla(dims, planes):
 
     blk = data["blocks"][0]
     xg = x.reshape(1, 3, nx + 1, ny + 1, nz + 1)[0]
-    y = structured_matvec_pallas_v3(xg, blk["ck"][0], blk["Ke"],
-                                    interpret=True, planes=planes)
+    y = fn(xg, blk["ck"][0], blk["Ke"], interpret=True, planes=planes)
     np.testing.assert_allclose(
         np.asarray(y).reshape(-1), y_ref, rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("dims,planes", [((6, 5, 4), 2), ((4, 4, 4), 4),
-                                         ((7, 3, 5), 3), ((5, 4, 3), 8)])
-def test_pallas_matvec_v4_matches_xla(dims, planes):
-    """Reshape-free chunked variant (the one that must lower on the
-    deployed Mosaic toolchain), incl. chunk sizes that do not divide
-    nx+1 (tail handled by skipped copies + ck zero padding)."""
-    from pcg_mpi_solver_tpu.ops.pallas_matvec import (
-        structured_matvec_pallas_v4)
-
-    nx, ny, nz = dims
-    model = make_cube_model(nx, ny, nz, heterogeneous=True, seed=11)
-    sp = partition_structured(model, 1)
-    data = device_data_structured(sp, jnp.float32)
-    ops = StructuredOps.from_partition(sp, dot_dtype=jnp.float32)
-
-    rng = np.random.default_rng(3)
-    x = jnp.asarray(rng.normal(size=(1, sp.n_loc)), jnp.float32)
-    y_ref = np.asarray(ops.matvec_local(data, x))[0]
-
-    blk = data["blocks"][0]
-    xg = x.reshape(1, 3, nx + 1, ny + 1, nz + 1)[0]
-    y = structured_matvec_pallas_v4(xg, blk["ck"][0], blk["Ke"],
-                                    interpret=True, planes=planes)
-    np.testing.assert_allclose(
-        np.asarray(y).reshape(-1), y_ref, rtol=2e-5, atol=2e-5)
-
-
-@pytest.mark.parametrize("dims,planes", [((6, 5, 4), 2), ((4, 4, 4), 4),
-                                         ((7, 3, 5), 3), ((5, 4, 3), 8)])
-def test_pallas_matvec_v5_matches_xla(dims, planes):
-    """Layout-legal chunked variant (canonical per-corner dots, aligned
-    pad + lane roll), incl. chunk sizes that do not divide nx+1."""
-    from pcg_mpi_solver_tpu.ops.pallas_matvec import (
-        structured_matvec_pallas_v5)
-
-    nx, ny, nz = dims
-    model = make_cube_model(nx, ny, nz, heterogeneous=True, seed=11)
-    sp = partition_structured(model, 1)
-    data = device_data_structured(sp, jnp.float32)
-    ops = StructuredOps.from_partition(sp, dot_dtype=jnp.float32)
-
-    rng = np.random.default_rng(3)
-    x = jnp.asarray(rng.normal(size=(1, sp.n_loc)), jnp.float32)
-    y_ref = np.asarray(ops.matvec_local(data, x))[0]
-
-    blk = data["blocks"][0]
-    xg = x.reshape(1, 3, nx + 1, ny + 1, nz + 1)[0]
-    y = structured_matvec_pallas_v5(xg, blk["ck"][0], blk["Ke"],
-                                    interpret=True, planes=planes)
-    np.testing.assert_allclose(
-        np.asarray(y).reshape(-1), y_ref, rtol=2e-5, atol=2e-5)
-
-
-@pytest.mark.parametrize("kernel_fn", ["v1", "v2", "v3", "v4", "v5"])
+@pytest.mark.parametrize("kernel_fn", ["v1", "v2", "v3", "v4", "v5", "v6"])
 def test_pallas_matvec_zero_ck_column_isolated(kernel_fn):
     """Cells with ck=0 must contribute nothing (the padded-cell trick the
     sharded integration — and v2's own gather padding — relies on)."""
     from pcg_mpi_solver_tpu.ops.pallas_matvec import (
         structured_matvec_pallas_v2, structured_matvec_pallas_v3,
-        structured_matvec_pallas_v4, structured_matvec_pallas_v5)
+        structured_matvec_pallas_v4, structured_matvec_pallas_v5,
+        structured_matvec_pallas_v6)
 
     fn = {"v1": structured_matvec_pallas,
           "v2": structured_matvec_pallas_v2,
           "v3": structured_matvec_pallas_v3,
           "v4": structured_matvec_pallas_v4,
-          "v5": structured_matvec_pallas_v5}[kernel_fn]
+          "v5": structured_matvec_pallas_v5,
+          "v6": structured_matvec_pallas_v6}[kernel_fn]
     model = make_cube_model(4, 3, 3, heterogeneous=True, seed=1)
     sp = partition_structured(model, 1)
     data = device_data_structured(sp, jnp.float32)

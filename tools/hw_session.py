@@ -102,6 +102,26 @@ def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900):
     log_line(path, f"=== {name} done: {status} ({wall:.0f}s)")
 
 
+def start_queue(name, deadline_min, log):
+    """Shared session-start policy for every hardware queue script: derive
+    the log path, probe the accelerator with the ONE retry policy (incl.
+    the deterministic-failure two-strike, pcg_mpi_solver_tpu/bench.py),
+    exit(3) if the deadline passes.  Returns the log path."""
+    path = os.path.join(REPO, log)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    sys.path.insert(0, REPO)
+    from pcg_mpi_solver_tpu.bench import _probe_with_retry
+
+    log_line(path, f"{name} start (deadline {deadline_min:.0f} min)")
+    ok, detail = _probe_with_retry(budget_s=deadline_min * 60,
+                                   probe_timeout_s=600)
+    if not ok:
+        log_line(path, f"deadline reached; no {name} session ({detail})")
+        sys.exit(3)
+    log_line(path, f"accelerator ANSWERED: {detail}")
+    return path
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--deadline-min", type=float, default=360,
@@ -110,21 +130,8 @@ def main():
     ap.add_argument("--quick", action="store_true",
                     help="small sizes (harness smoke; also used on CPU)")
     args = ap.parse_args()
-    path = os.path.join(REPO, args.log)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-
-    sys.path.insert(0, REPO)
-    # the ONE probe-retry policy (incl. deterministic-failure two-strike)
-    from pcg_mpi_solver_tpu.bench import _probe_with_retry
-
-    log_line(path, f"hw_session start (deadline {args.deadline_min:.0f} min, "
-                   f"quick={args.quick})")
-    ok, detail = _probe_with_retry(budget_s=args.deadline_min * 60,
-                                   probe_timeout_s=600)
-    if not ok:
-        log_line(path, f"deadline reached; no hardware session ({detail})")
-        sys.exit(3)
-    log_line(path, f"accelerator ANSWERED: {detail}")
+    path = start_queue(f"hw_session (quick={args.quick})",
+                       args.deadline_min, args.log)
 
     nx = "48" if args.quick else "150"
     ot = ({"BENCH_OT_N": "6", "BENCH_OT_LEVEL": "2"} if args.quick else {})

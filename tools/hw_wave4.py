@@ -1,0 +1,69 @@
+"""Fourth-wave hardware queue (round 3).
+
+Runs what waves 1-3 still owe:
+
+  1. v6 Pallas A/B — wave 3 pinned v5's failure to DMA slice legality
+     (size-1 sublane plane copies); v6 DMAs tile-aligned slabs.  If v6
+     lowers, the fused-path headline finally exists.
+  2. OCTREE FLAGSHIP retry — wave 1's 5.67M/3.76M-dof octree rungs
+     failed REMOTE COMPILE under the then-default scatter combine; the
+     gather-combine level assembly (afc29e3) is now the default and is
+     both cheaper (no duplicate-row scatter, the measured 88.7 ns/row
+     hot spot) and structurally simpler for the compiler.  VERDICT r2
+     item 5 ("octree at >=5M dofs") is open until this lands.
+  3. Flagship bench with the v6 probe live — if the probe lowers, this
+     is the first fused-path headline number.
+  4. PLATEAU A/B at 10.33M dofs — the mixed flagship's refinement trace
+     burns ~670 stagnation iterations in its first f32 cycle; the
+     plateau window (off by default, BENCH_PLATEAU) could cut 15-20%.
+     Small-scale A/Bs were null/negative (docs/BENCH_LOG.md 2026-07-31);
+     only the at-scale run decides.
+  5. Gather/scatter combine variants at flagship fill — the candidate
+     scatter replacements added to examples/bench_gather.py after the
+     row-traffic isolation.
+
+Same probe/retry + wedged-grant step isolation as tools/hw_session.py.
+
+Usage: python tools/hw_wave4.py [--deadline-min 240]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.hw_session import log_line, run_step, start_queue  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=240)
+    ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
+    args = ap.parse_args()
+    path = start_queue("hw_wave4", args.deadline_min, args.log)
+
+    run_step(path, "matvec A/B v6", ["examples/bench_matvec.py", "150"],
+             timeout=2400)
+    # Octree flagship: ladder 22 -> 18 -> 12 (5.67M / 3.76M / 1.3M dofs
+    # at level 4).  Model gen alone took 134 s at 22^3 in wave 1; compile
+    # of the blocked hybrid is the open question — full-budget step.
+    run_step(path, "octree flagship (gather combine)", ["bench.py"],
+             env_extra={"BENCH_MODEL": "octree"}, timeout=4800)
+    # Flagship cube with the v6 probe live (pallas=auto probes v6 now).
+    run_step(path, "flagship (v6 probe live)", ["bench.py"], timeout=3600)
+    # Plateau A/B: same flagship cube as the rc=0 headline, window 120
+    # (the only setting that was lossless at small scale).  Compare
+    # iters/time against the window-0 runs already in the log.
+    run_step(path, "flagship plateau=120", ["bench.py"],
+             env_extra={"BENCH_PLATEAU": "120"}, timeout=3600)
+    # Scatter-replacement candidates at flagship fill.
+    run_step(path, "gather/scatter variants", ["examples/bench_gather.py"],
+             timeout=2400)
+    log_line(path, "hw_wave4 complete")
+
+
+if __name__ == "__main__":
+    main()
