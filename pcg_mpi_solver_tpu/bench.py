@@ -141,17 +141,94 @@ def _probe_with_retry(budget_s=None, probe_timeout_s=180.0):
         time.sleep(min(30.0 + 15.0 * attempt, 120.0))
 
 
+def _model_cache_key(kind, nx, ny, nz, ot_n, ot_level):
+    """Cache key = generator args + a hash of the model-source files, so
+    a stale cache cannot survive a generator code change."""
+    import hashlib
+
+    import pcg_mpi_solver_tpu.models as m
+
+    h = hashlib.sha256()
+    pkg = os.path.dirname(m.__file__)
+    for fn in sorted(os.listdir(pkg)):
+        if fn.endswith(".py"):
+            with open(os.path.join(pkg, fn), "rb") as f:
+                h.update(f.read())
+    h.update(repr((kind, nx, ny, nz, ot_n, ot_level)).encode())
+    return h.hexdigest()[:16]
+
+
 def _build_model(kind, nx, ny, nz, ot_n, ot_level):
+    """Build (or load from the on-disk cache) a bench model.  Octree
+    generation costs minutes at flagship scale on the 1-core bench host;
+    caching it cuts per-hardware-step latency and step-timeout pressure.
+    Disable with BENCH_MODEL_CACHE=0."""
+    import pickle
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             os.pardir, ".bench_cache")
+    use_cache = os.environ.get("BENCH_MODEL_CACHE", "1") == "1"
+    path = os.path.join(
+        cache_dir, f"model_{_model_cache_key(kind, nx, ny, nz, ot_n, ot_level)}.pkl")
+    if use_cache and os.path.exists(path):
+        try:
+            with open(path, "rb") as f:
+                model = pickle.load(f)
+            os.utime(path)                              # LRU touch
+            return model
+        except Exception as e:                          # noqa: BLE001
+            _log(f"# model cache read failed ({type(e).__name__}); rebuilding")
+
     if kind == "octree":
         from pcg_mpi_solver_tpu.models.octree import make_octree_model
 
-        return make_octree_model(ot_n, ot_n, ot_n, max_level=ot_level,
-                                 n_incl=6, seed=2, E=30e9, nu=0.2,
-                                 load="traction", load_value=1e6)
-    from pcg_mpi_solver_tpu.models import make_cube_model
+        model = make_octree_model(ot_n, ot_n, ot_n, max_level=ot_level,
+                                  n_incl=6, seed=2, E=30e9, nu=0.2,
+                                  load="traction", load_value=1e6)
+    else:
+        from pcg_mpi_solver_tpu.models import make_cube_model
 
-    return make_cube_model(nx, ny, nz, E=30e9, nu=0.2, load="traction",
-                           load_value=1e6, heterogeneous=True)
+        model = make_cube_model(nx, ny, nz, E=30e9, nu=0.2, load="traction",
+                                load_value=1e6, heterogeneous=True)
+    if use_cache:
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            # unique tmp per process: concurrent writers must not truncate
+            # each other's half-written pickle before the atomic publish
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(model, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)                       # atomic publish
+            _evict_model_cache(cache_dir, keep=path)
+        except Exception as e:                          # noqa: BLE001
+            _log(f"# model cache write failed ({type(e).__name__}); continuing")
+    return model
+
+
+def _evict_model_cache(cache_dir, keep, cap_bytes=None):
+    """LRU-evict model_*.pkl until the cache fits the size cap
+    (BENCH_MODEL_CACHE_GB, default 8).  Source-file edits re-key every
+    entry, permanently orphaning the old generation — without eviction
+    the multi-hundred-MB flagship pickles accumulate unboundedly."""
+    if cap_bytes is None:
+        cap_bytes = float(os.environ.get("BENCH_MODEL_CACHE_GB", 8)) * 2**30
+    try:
+        entries = []
+        for fn in os.listdir(cache_dir):
+            if fn.startswith("model_") and fn.endswith(".pkl"):
+                p = os.path.join(cache_dir, fn)
+                st = os.stat(p)
+                entries.append((st.st_mtime, st.st_size, p))
+        total = sum(s for _, s, _ in entries)
+        for mtime, size, p in sorted(entries):          # oldest first
+            if total <= cap_bytes:
+                break
+            if os.path.abspath(p) == os.path.abspath(keep):
+                continue                                # never the new entry
+            os.remove(p)
+            total -= size
+    except OSError:
+        pass                                            # best-effort
 
 
 def measure_ref_ns(kind, n_dof, ref_max_dofs, n_ref_iters,

@@ -76,6 +76,18 @@ def _pallas_enabled(mode: str, mesh, shapes=()) -> bool:
             warnings.warn(f"Pallas matvec unavailable on {kind} "
                           f"({type(e).__name__}: {e}); using the XLA path")
             ok = False
+            # A failed remote compile can wedge the device grant for
+            # minutes (docs/RUNBOOK.md) — observed wave 3: the flagship's
+            # XLA compile died UNAVAILABLE right after ten Mosaic probe
+            # failures.  Settle: verify the compile service answers again
+            # before handing control to the real compile.
+            from pcg_mpi_solver_tpu.utils.backend_probe import settle_compile
+
+            settled, detail = settle_compile()
+            if not settled:
+                warnings.warn(f"compile service still unsettled after "
+                              f"failed Pallas probe ({detail}); the next "
+                              f"compile may fail UNAVAILABLE")
         if jax.process_count() > 1:
             # One SPMD program, one kernel: all processes must agree, else
             # hosts would silently run different matvecs (and the resume

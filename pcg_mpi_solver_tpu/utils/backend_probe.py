@@ -50,3 +50,35 @@ def probe_backend(timeout_s: float = 180.0) -> Tuple[bool, str]:
             f"backend init failed (rc={proc.returncode}):\n"
             + "\n".join(tail))
     return True, "ok"
+
+
+def settle_compile(max_attempts: int = 4) -> Tuple[bool, str]:
+    """Verify the (possibly remote) compile service answers by compiling
+    a trivial jitted function, retrying with backoff.
+
+    A failed remote compile (e.g. a Mosaic probe rejection) can wedge the
+    tunnel's device grant for minutes (docs/RUNBOOK.md); unlike
+    :func:`probe_backend` this works WITH a live in-process backend and
+    exercises the compile path specifically.  Each attempt uses a fresh
+    shape so an in-process or persistent compile-cache hit cannot fake
+    health."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    for attempt in range(max_attempts):
+        try:
+            # odd sublane count -> unlikely to collide with real programs
+            # in any persistent cache; varies per attempt
+            n = 8 * (attempt + 3) + 123
+            jax.jit(lambda x: (x * 3 + 1).sum()).lower(
+                jax.ShapeDtypeStruct((n, 128), jnp.float32)).compile()
+            return True, f"compile service ok (attempt {attempt + 1})"
+        except Exception as e:                          # noqa: BLE001
+            if attempt + 1 == max_attempts:
+                return False, (f"compile service still failing after "
+                               f"{max_attempts} attempts "
+                               f"({type(e).__name__}: {e})")
+            time.sleep(30.0 * (attempt + 1))
+    return False, "unreachable"

@@ -150,3 +150,34 @@ def test_result_json_marks_unconverged(monkeypatch):
     r0 = types.SimpleNamespace(flag=0, relres=1e-8, wall_s=2.0)
     d0 = json.loads(bench._result_json(model, "cube", r0, 50, 235.0, "n", {}))
     assert d0["detail"]["time_to_tol_s"] == 2.0
+
+
+def test_settle_compile_healthy_backend():
+    """settle_compile must succeed on the first attempt against a healthy
+    (CPU) backend and report which attempt answered."""
+    from pcg_mpi_solver_tpu.utils.backend_probe import settle_compile
+
+    ok, detail = settle_compile(max_attempts=1)
+    assert ok, detail
+    assert "attempt 1" in detail
+
+
+def test_model_cache_eviction(tmp_path):
+    """LRU eviction keeps the cache under the cap, never deletes the
+    just-written entry, and evicts oldest-mtime first."""
+    import os
+    import time
+
+    from pcg_mpi_solver_tpu.bench import _evict_model_cache
+
+    d = str(tmp_path)
+    for i, sz in enumerate([100, 200, 300]):
+        p = os.path.join(d, f"model_{i}.pkl")
+        with open(p, "wb") as f:
+            f.write(b"x" * sz)
+        os.utime(p, (time.time() - 100 + i,) * 2)
+    keep = os.path.join(d, "model_2.pkl")
+    _evict_model_cache(d, keep=keep, cap_bytes=550)
+    assert sorted(os.listdir(d)) == ["model_1.pkl", "model_2.pkl"]
+    _evict_model_cache(d, keep=keep, cap_bytes=50)
+    assert sorted(os.listdir(d)) == ["model_2.pkl"]

@@ -44,16 +44,21 @@ def log_line(path, msg):
 _last_step_ok = True
 
 
-def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900):
+def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
+             force_gate=False):
     """Run one checklist step.  If the PREVIOUS step failed or timed out,
     first re-probe the accelerator (bounded by ``gate_s``): a SIGKILLed
     step wedges the device grant for minutes (docs/RUNBOOK.md), and the
     example scripts — unlike bench.py — have no probe/retry of their own,
     so without this gate they die instantly at the first device touch
     (observed: second-wave combine-variants step, rc=1 after the f64
-    step's timeout kill)."""
+    step's timeout kill).  ``force_gate`` probes even after an rc=0 step:
+    the matvec A/B exits 0 while its per-variant try/except swallows
+    Mosaic failures that wedge the grant all the same (observed wave 3:
+    the flagship's XLA compile died UNAVAILABLE right after the rc=0
+    A/B's ten failed probe compiles)."""
     global _last_step_ok
-    if not _last_step_ok and gate_s:
+    if (not _last_step_ok or force_gate) and gate_s:
         from pcg_mpi_solver_tpu.bench import _probe_with_retry
 
         log_line(path, f"gate: previous step failed; re-probing before "

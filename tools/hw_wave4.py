@@ -51,9 +51,12 @@ def main():
     # at level 4).  Model gen alone took 134 s at 22^3 in wave 1; compile
     # of the blocked hybrid is the open question — full-budget step.
     run_step(path, "octree flagship (gather combine)", ["bench.py"],
-             env_extra={"BENCH_MODEL": "octree"}, timeout=4800)
+             env_extra={"BENCH_MODEL": "octree"}, timeout=4800,
+             force_gate=True)   # the A/B exits 0 even when every Mosaic
+    #                             probe failed and wedged the grant
     # Flagship cube with the v6 probe live (pallas=auto probes v6 now).
-    run_step(path, "flagship (v6 probe live)", ["bench.py"], timeout=3600)
+    run_step(path, "flagship (v6 probe live)", ["bench.py"], timeout=3600,
+             force_gate=True)
     # Plateau A/B: same flagship cube as the rc=0 headline, window 120
     # (the only setting that was lossless at small scale).  Compare
     # iters/time against the window-0 runs already in the log.
