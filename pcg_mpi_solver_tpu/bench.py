@@ -141,9 +141,10 @@ def _probe_with_retry(budget_s=None, probe_timeout_s=180.0):
         time.sleep(min(30.0 + 15.0 * attempt, 120.0))
 
 
-def _model_cache_key(kind, nx, ny, nz, ot_n, ot_level):
-    """Cache key = generator args + a hash of the model-source files, so
-    a stale cache cannot survive a generator code change."""
+def _model_cache_key(kind, gen_kwargs):
+    """Cache key = the FULL generator kwargs + a hash of the model-source
+    files, so neither a generator code change nor an edit to the
+    hard-coded kwargs below can serve a stale model."""
     import hashlib
 
     import pcg_mpi_solver_tpu.models as m
@@ -154,7 +155,7 @@ def _model_cache_key(kind, nx, ny, nz, ot_n, ot_level):
         if fn.endswith(".py"):
             with open(os.path.join(pkg, fn), "rb") as f:
                 h.update(f.read())
-    h.update(repr((kind, nx, ny, nz, ot_n, ot_level)).encode())
+    h.update(repr((kind, sorted(gen_kwargs.items()))).encode())
     return h.hexdigest()[:16]
 
 
@@ -165,31 +166,41 @@ def _build_model(kind, nx, ny, nz, ot_n, ot_level):
     Disable with BENCH_MODEL_CACHE=0."""
     import pickle
 
+    if kind == "octree":
+        gen_kwargs = dict(nx0=ot_n, ny0=ot_n, nz0=ot_n, max_level=ot_level,
+                          n_incl=6, seed=2, E=30e9, nu=0.2,
+                          load="traction", load_value=1e6)
+    else:
+        gen_kwargs = dict(nx=nx, ny=ny, nz=nz, E=30e9, nu=0.2,
+                          load="traction", load_value=1e6,
+                          heterogeneous=True)
+
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              os.pardir, ".bench_cache")
     use_cache = os.environ.get("BENCH_MODEL_CACHE", "1") == "1"
     path = os.path.join(
-        cache_dir, f"model_{_model_cache_key(kind, nx, ny, nz, ot_n, ot_level)}.pkl")
+        cache_dir, f"model_{_model_cache_key(kind, gen_kwargs)}.pkl")
     if use_cache and os.path.exists(path):
         try:
             with open(path, "rb") as f:
                 model = pickle.load(f)
-            os.utime(path)                              # LRU touch
-            return model
         except Exception as e:                          # noqa: BLE001
             _log(f"# model cache read failed ({type(e).__name__}); rebuilding")
+        else:
+            try:
+                os.utime(path)                          # LRU touch
+            except OSError:
+                pass            # best-effort metadata; the load succeeded
+            return model
 
     if kind == "octree":
         from pcg_mpi_solver_tpu.models.octree import make_octree_model
 
-        model = make_octree_model(ot_n, ot_n, ot_n, max_level=ot_level,
-                                  n_incl=6, seed=2, E=30e9, nu=0.2,
-                                  load="traction", load_value=1e6)
+        model = make_octree_model(**gen_kwargs)
     else:
         from pcg_mpi_solver_tpu.models import make_cube_model
 
-        model = make_cube_model(nx, ny, nz, E=30e9, nu=0.2, load="traction",
-                                load_value=1e6, heterogeneous=True)
+        model = make_cube_model(**gen_kwargs)
     if use_cache:
         try:
             os.makedirs(cache_dir, exist_ok=True)
@@ -212,11 +223,20 @@ def _evict_model_cache(cache_dir, keep, cap_bytes=None):
     the multi-hundred-MB flagship pickles accumulate unboundedly."""
     if cap_bytes is None:
         cap_bytes = float(os.environ.get("BENCH_MODEL_CACHE_GB", 8)) * 2**30
+    import time
+
     try:
         entries = []
         for fn in os.listdir(cache_dir):
+            p = os.path.join(cache_dir, fn)
+            if fn.startswith("model_") and fn.endswith(".tmp"):
+                # a SIGKILLed writer (run_step timeout) leaves a
+                # multi-hundred-MB orphan the cap would never see
+                st = os.stat(p)
+                if time.time() - st.st_mtime > 3600:
+                    os.remove(p)
+                continue
             if fn.startswith("model_") and fn.endswith(".pkl"):
-                p = os.path.join(cache_dir, fn)
                 st = os.stat(p)
                 entries.append((st.st_mtime, st.st_size, p))
         total = sum(s for _, s, _ in entries)

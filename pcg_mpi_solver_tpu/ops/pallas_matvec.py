@@ -368,7 +368,8 @@ def _matvec_kernel_v3(ke_ref, x_hbm, ck_hbm, y_ref,
             def _cp():
                 getattr(pltpu.make_async_copy(
                     x_hbm.at[:, plane],
-                    xv.at[slot, :, pl.ds(k * m, m)], sems.at[slot]), act)()
+                    xv.at[slot, :, pl.ds(jnp.asarray(k * m, jnp.int32), m)],
+                    sems.at[slot]), act)()
         getattr(pltpu.make_async_copy(
             ck_hbm.at[pl.ds(chunk * cpp, cpp)],
             ckv.at[slot], ck_sems.at[slot]), act)()
@@ -521,7 +522,9 @@ def _matvec_kernel_v4(ke_ref, x_hbm, ck_hbm, y_ref,
             def _cp():
                 getattr(pltpu.make_async_copy(
                     x_hbm.at[:, plane],
-                    xv.at[slot, :, k, pl.ds(0, m)], sems.at[slot]), act)()
+                    xv.at[slot, :, jnp.asarray(k, jnp.int32),
+                          pl.ds(jnp.asarray(0, jnp.int32), m)],
+                    sems.at[slot]), act)()
         getattr(pltpu.make_async_copy(
             ck_hbm.at[pl.ds(chunk * cpp, cpp)],
             ckv.at[slot], ck_sems.at[slot]), act)()
@@ -635,7 +638,9 @@ def _matvec_kernel_v5(ke_ref, x_hbm, ck_hbm, y_ref,
             def _cp():
                 getattr(pltpu.make_async_copy(
                     x_hbm.at[:, plane],
-                    xv.at[slot, :, k, pl.ds(0, m)], sems.at[slot]), act)()
+                    xv.at[slot, :, jnp.asarray(k, jnp.int32),
+                          pl.ds(jnp.asarray(0, jnp.int32), m)],
+                    sems.at[slot]), act)()
         getattr(pltpu.make_async_copy(
             ck_hbm.at[pl.ds(chunk * cpp, cpp)],
             ckv.at[slot], ck_sems.at[slot]), act)()
@@ -825,12 +830,16 @@ def _matvec_kernel_v6(ke_ref, x_hbm, ck_hbm, y_ref,
     j = jnp.asarray(pl.program_id(0), jnp.int32)  # i32 ALWAYS (see v4)
 
     def for_chunk(slot, chunk, act):
-        # i32 ALWAYS: the static _init path (chunk = python 0) otherwise
-        # traces the offset as i64 under jax x64 (see v5)
+        # i32 ALWAYS — including literal zeros: under jax x64 a python-int
+        # index traces as i64, and index PROMOTION then lifts every other
+        # index in the same memref_slice to i64, which Mosaic rejects
+        # ("operand #1 must be variadic of 32-bit signless integer" —
+        # observed on-HW 2026-07-31 from the flagship's v5 probe)
         c0 = jnp.asarray(chunk * cpp, jnp.int32)
+        z = jnp.asarray(0, jnp.int32)
         getattr(pltpu.make_async_copy(
             x_hbm.at[:, pl.ds(c0, cpp + 8), :],
-            xv.at[slot, :, :, pl.ds(0, m128)], sems.at[slot]), act)()
+            xv.at[slot, :, :, pl.ds(z, m128)], sems.at[slot]), act)()
         getattr(pltpu.make_async_copy(
             ck_hbm.at[pl.ds(c0, cpp)],
             ckv.at[slot], ck_sems.at[slot]), act)()

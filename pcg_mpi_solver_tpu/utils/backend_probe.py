@@ -52,33 +52,43 @@ def probe_backend(timeout_s: float = 180.0) -> Tuple[bool, str]:
     return True, "ok"
 
 
-def settle_compile(max_attempts: int = 4) -> Tuple[bool, str]:
+def settle_compile(max_attempts: int = 4,
+                   timeout_s: float = 180.0) -> Tuple[bool, str]:
     """Verify the (possibly remote) compile service answers by compiling
     a trivial jitted function, retrying with backoff.
 
     A failed remote compile (e.g. a Mosaic probe rejection) can wedge the
     tunnel's device grant for minutes (docs/RUNBOOK.md); unlike
-    :func:`probe_backend` this works WITH a live in-process backend and
-    exercises the compile path specifically.  Each attempt uses a fresh
-    shape so an in-process or persistent compile-cache hit cannot fake
-    health."""
+    :func:`probe_backend` this exercises the COMPILE path specifically.
+    Each attempt runs in a SUBPROCESS with a timeout — a wedged backend
+    can hang (not error) in a native retry loop Python cannot interrupt,
+    and an in-process hang here would block the caller (the solver's
+    Pallas-probe fallback) worse than the wedge itself.  The probe shape
+    is pid/time-derived so a persistent compile-cache hit cannot fake
+    health on repeat invocations."""
     import time
 
-    import jax
-    import jax.numpy as jnp
-
+    detail = "no attempt ran"
     for attempt in range(max_attempts):
+        # odd sublane count -> unlikely to collide with real programs
+        n = 8 * (attempt + 3) + 123 + 8 * ((os.getpid()
+                                            + int(time.time())) % 1024)
+        code = (f"import jax, jax.numpy as jnp; "
+                f"jax.jit(lambda x: (x * 3 + 1).sum()).lower("
+                f"jax.ShapeDtypeStruct(({n}, 128), jnp.float32)).compile()")
         try:
-            # odd sublane count -> unlikely to collide with real programs
-            # in any persistent cache; varies per attempt
-            n = 8 * (attempt + 3) + 123
-            jax.jit(lambda x: (x * 3 + 1).sum()).lower(
-                jax.ShapeDtypeStruct((n, 128), jnp.float32)).compile()
-            return True, f"compile service ok (attempt {attempt + 1})"
-        except Exception as e:                          # noqa: BLE001
-            if attempt + 1 == max_attempts:
-                return False, (f"compile service still failing after "
-                               f"{max_attempts} attempts "
-                               f"({type(e).__name__}: {e})")
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  timeout=timeout_s, capture_output=True,
+                                  text=True)
+        except subprocess.TimeoutExpired:
+            detail = f"compile probe hung past {timeout_s:.0f}s"
+        else:
+            if proc.returncode == 0:
+                return True, f"compile service ok (attempt {attempt + 1})"
+            tail = (proc.stderr or "").strip().splitlines()[-4:]
+            detail = (f"compile probe rc={proc.returncode}: "
+                      + " | ".join(tail))
+        if attempt + 1 < max_attempts:
             time.sleep(30.0 * (attempt + 1))
-    return False, "unreachable"
+    return False, (f"compile service still failing after "
+                   f"{max_attempts} attempts ({detail})")

@@ -152,14 +152,23 @@ def test_result_json_marks_unconverged(monkeypatch):
     assert d0["detail"]["time_to_tol_s"] == 2.0
 
 
-def test_settle_compile_healthy_backend():
-    """settle_compile must succeed on the first attempt against a healthy
-    (CPU) backend and report which attempt answered."""
-    from pcg_mpi_solver_tpu.utils.backend_probe import settle_compile
+def test_settle_compile_mechanics(monkeypatch):
+    """settle_compile subprocess plumbing: success and failure paths.
 
-    ok, detail = settle_compile(max_attempts=1)
-    assert ok, detail
-    assert "attempt 1" in detail
+    Uses stub executables instead of a real jax probe — on the bench
+    host a real probe subprocess first-touches the tunneled TPU backend
+    (JAX_PLATFORMS=cpu alone does NOT stop axon backend init; only an
+    in-process jax.config.update can, see tests/conftest.py) and hangs
+    the suite for the full timeout whenever the tunnel is wedged."""
+    from pcg_mpi_solver_tpu.utils import backend_probe
+
+    monkeypatch.setattr(backend_probe.sys, "executable", "/bin/true")
+    ok, detail = backend_probe.settle_compile(max_attempts=1)
+    assert ok and "attempt 1" in detail, detail
+
+    monkeypatch.setattr(backend_probe.sys, "executable", "/bin/false")
+    ok, detail = backend_probe.settle_compile(max_attempts=1)
+    assert not ok and "rc=1" in detail, detail
 
 
 def test_model_cache_eviction(tmp_path):

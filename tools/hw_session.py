@@ -61,7 +61,8 @@ def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
     if (not _last_step_ok or force_gate) and gate_s:
         from pcg_mpi_solver_tpu.bench import _probe_with_retry
 
-        log_line(path, f"gate: previous step failed; re-probing before "
+        why = "previous step failed" if not _last_step_ok else "force_gate"
+        log_line(path, f"gate: {why}; re-probing before "
                        f"{name} (wedged-grant guard, {gate_s:.0f}s budget)")
         ok, detail = _probe_with_retry(budget_s=gate_s, probe_timeout_s=300)
         log_line(path, f"gate: {'accelerator ok' if ok else 'STILL DOWN'} "
