@@ -51,7 +51,10 @@ class SolverConfig:
     # devices; state stays on device between dispatches.
     iters_per_dispatch: int = -1
     # Fused Pallas matvec kernel for f32 structured-backend matvecs
-    # (ops/pallas_matvec.py): "auto" = on TPU devices, "on", "off".
+    # (ops/pallas_matvec.py): "auto" = on TPU devices, "on", "off",
+    # "interpret" = force the kernel through the Pallas interpreter on
+    # any backend (CI's way to exercise the real solver->kernel dispatch
+    # on CPU; far slower than the XLA path — testing only).
     pallas: str = "auto"
 
 

@@ -99,7 +99,7 @@ def _matvec_kernel(ke_ref, x_hbm, ck_hbm, y_ref,
             y_ref[c, 0] = carry[c]
 
 
-def batched_structured_matvec(xg, ck, Ke):
+def batched_structured_matvec(xg, ck, Ke, interpret=False):
     """Batched dispatch over the leading parts axis: one kernel launch per
     local part.  The structured backend always has exactly one local slab
     (n_parts == n_devices); the hybrid backend may carry several local
@@ -112,9 +112,12 @@ def batched_structured_matvec(xg, ck, Ke):
     chunked — fails Mosaic concat-offset checks on its corner pads,
     5 = layout-legal chunked — fails Mosaic DMA slicing (size-1 sublane
     plane copies), default 6 = v5 compute + slab-aligned DMA,
-    docs/RUNBOOK.md)."""
+    docs/RUNBOOK.md).  ``interpret`` runs the kernel through the Pallas
+    interpreter (SolverConfig.pallas='interpret') so CI exercises this
+    exact dispatch on CPU."""
     fn = selected_variant()[1]
-    return jnp.stack([fn(xg[p], ck[p], Ke) for p in range(xg.shape[0])])
+    return jnp.stack([fn(xg[p], ck[p], Ke, interpret=interpret)
+                      for p in range(xg.shape[0])])
 
 
 def _planes_env(fn):

@@ -158,13 +158,13 @@ def hybrid_pallas_enabled(hp: "HybridPartition", pallas_mode: str,
         ((3, lv.bx + 1, lv.by + 1, lv.bz + 1), (lv.bx, lv.by, lv.bz))
         for lv in hp.levels if lp * lv.nb <= PALLAS_BATCH_CAP)))
     if not shapes:
-        if pallas_mode == "on":
+        if pallas_mode in ("on", "interpret"):
             import warnings
 
             warnings.warn(
-                "pallas='on' but every hybrid level's part*block batch "
-                f"exceeds the {PALLAS_BATCH_CAP}-launch cap; using the "
-                "XLA stencils")
+                f"pallas={pallas_mode!r} but every hybrid level's "
+                f"part*block batch exceeds the {PALLAS_BATCH_CAP}-launch "
+                "cap; using the XLA stencils")
         return False
     return _pallas_enabled(pallas_mode, mesh, shapes=shapes)
 
@@ -399,6 +399,9 @@ class HybridOps(Ops):
     # cap), resolved at construction so the trace-time dispatch agrees
     # with hybrid_pallas_enabled's probe
     pallas_levels: tuple = ()
+    # run the kernel through the Pallas interpreter (CI on CPU exercises
+    # the real solver->kernel dispatch; SolverConfig.pallas='interpret')
+    pallas_interpret: bool = False
     # XLA stencil formulation, PINNED at construction (checkpoint
     # fingerprints record it — see parallel/structured.py)
     form: str = "gse"
@@ -421,7 +424,7 @@ class HybridOps(Ops):
                     axis_name=None,
                     precision=jax.lax.Precision.HIGHEST,
                     use_pallas=False, n_local_parts=1, form=None,
-                    combine=None):
+                    combine=None, pallas_interpret=False):
         from pcg_mpi_solver_tpu.parallel.structured import matvec_form
 
         pm = hp.pm
@@ -436,7 +439,7 @@ class HybridOps(Ops):
                    use_node_ell=pm.ell is not None,
                    level_dims=tuple((lv.nb, lv.bx, lv.by, lv.bz)
                                     for lv in hp.levels),
-                   use_pallas=use_pallas,
+                   use_pallas=use_pallas, pallas_interpret=pallas_interpret,
                    pallas_levels=tuple(
                        use_pallas
                        and n_local_parts * lv.nb <= PALLAS_BATCH_CAP
@@ -525,7 +528,8 @@ class HybridOps(Ops):
             from pcg_mpi_solver_tpu.ops.pallas_matvec import (
                 batched_structured_matvec)
 
-            return batched_structured_matvec(xg, ck, Ke)
+            return batched_structured_matvec(
+                xg, ck, Ke, interpret=self.pallas_interpret)
         if self.form == "corner":
             from pcg_mpi_solver_tpu.parallel.structured import (
                 corner_matvec_grid)

@@ -238,6 +238,9 @@ class StructuredOps(Ops):
     # f32 matvecs through the fused Pallas plane-march kernel
     # (ops/pallas_matvec.py) instead of the XLA gather/einsum/scatter
     use_pallas: bool = False
+    # run the kernel through the Pallas interpreter (CI on CPU exercises
+    # the real solver->kernel dispatch; SolverConfig.pallas='interpret')
+    pallas_interpret: bool = False
     # XLA stencil formulation, PINNED at construction (the checkpoint
     # fingerprint records it; an env flip after construction must not
     # silently change what a resume replays)
@@ -254,13 +257,13 @@ class StructuredOps(Ops):
     @classmethod
     def from_partition(cls, sp: StructuredPartition, dot_dtype=jnp.float64,
                        axis_name=None, precision=jax.lax.Precision.HIGHEST,
-                       use_pallas=False, form=None):
+                       use_pallas=False, form=None, pallas_interpret=False):
         return cls(n_loc=sp.n_loc, n_iface=0,
                    n_node_loc=sp.n_node_loc, n_node_iface=0,
                    dot_dtype=dot_dtype,
                    axis_name=axis_name, precision=precision,
                    nxc=sp.nxc, ny=sp.ny, nz=sp.nz, n_parts=sp.n_parts,
-                   use_pallas=use_pallas,
+                   use_pallas=use_pallas, pallas_interpret=pallas_interpret,
                    form=form if form is not None else matvec_form())
 
     # -- grid helpers ---------------------------------------------------
@@ -383,7 +386,8 @@ class StructuredOps(Ops):
             from pcg_mpi_solver_tpu.ops.pallas_matvec import (
                 batched_structured_matvec)
 
-            y = batched_structured_matvec(xg, blk["ck"], blk["Ke"])
+            y = batched_structured_matvec(xg, blk["ck"], blk["Ke"],
+                                          interpret=self.pallas_interpret)
             return y.reshape(x.shape)
         if chunk == 0:
             # slice-gather + einsum: contiguous slices, MXU matmul, shifted

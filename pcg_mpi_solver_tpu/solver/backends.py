@@ -42,9 +42,10 @@ def select_time_backend(model: ModelData, n_parts: int, *,
         use_pallas = kernels_f32 and hybrid_pallas_enabled(
             pm, pallas_mode, mesh)
         lp = local_parts(n_parts, mesh)
+        interp = pallas_mode == "interpret"
         mk_ops = lambda dd: HybridOps.from_hybrid(
             pm, dot_dtype=dd, axis_name=PARTS_AXIS, use_pallas=use_pallas,
-            n_local_parts=lp)
+            n_local_parts=lp, pallas_interpret=interp)
         return "hybrid", pm, mk_ops, lambda dt: device_data_hybrid(pm, dt)
 
     pm = partition_model(model, n_parts, method=partition_method)

@@ -48,13 +48,16 @@ def _pallas_enabled(mode: str, mesh, shapes=()) -> bool:
     compile probe of the ACTUAL kernel shapes succeeds, so a
     shape-dependent Mosaic lowering failure degrades to the XLA path at
     init instead of crashing the first jitted step."""
-    if mode == "on":
+    if mode in ("on", "interpret"):
+        # "interpret": run the kernel through the Pallas interpreter on
+        # any backend — CI's way to exercise the REAL solver->kernel
+        # dispatch (layout, batching, reshape order) without TPU hardware
         return True
     if mode == "off":
         return False
     if mode != "auto":
-        raise ValueError(f"SolverConfig.pallas must be 'auto'|'on'|'off', "
-                         f"got {mode!r}")
+        raise ValueError(f"SolverConfig.pallas must be "
+                         f"'auto'|'on'|'off'|'interpret', got {mode!r}")
     d = mesh.devices.flat[0]
     kind = f"{d.platform} {getattr(d, 'device_kind', '')}".lower()
     if "tpu" not in kind:
@@ -186,6 +189,7 @@ class Solver:
         else:
             self.backend = "general"
 
+        interp = solver_cfg.pallas == "interpret"
         if self.backend == "structured":
             from pcg_mpi_solver_tpu.parallel.structured import (
                 StructuredOps, device_data_structured, partition_structured)
@@ -203,11 +207,11 @@ class Solver:
                 self.pallas_variant = selected_variant()[0]
             self.ops = StructuredOps.from_partition(
                 self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS,
-                use_pallas=use_pallas)
+                use_pallas=use_pallas, pallas_interpret=interp)
             data = device_data_structured(self.pm, dtype)
             ops32_factory = lambda: StructuredOps.from_partition(
                 self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS,
-                use_pallas=use_pallas)
+                use_pallas=use_pallas, pallas_interpret=interp)
         elif self.backend == "hybrid":
             from pcg_mpi_solver_tpu.parallel.hybrid import (
                 HybridOps, device_data_hybrid, partition_hybrid)
@@ -229,11 +233,13 @@ class Solver:
             lp = local_parts(n_parts, self.mesh)
             self.ops = HybridOps.from_hybrid(
                 self.pm, dot_dtype=dot_dtype, axis_name=PARTS_AXIS,
-                use_pallas=use_pallas, n_local_parts=lp)
+                use_pallas=use_pallas, n_local_parts=lp,
+                pallas_interpret=interp)
             data = device_data_hybrid(self.pm, dtype)
             ops32_factory = lambda: HybridOps.from_hybrid(
                 self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS,
-                use_pallas=use_pallas, n_local_parts=lp)
+                use_pallas=use_pallas, n_local_parts=lp,
+                pallas_interpret=interp)
         else:
             self.pm = partition_model(model, n_parts, elem_part=elem_part,
                                       method=self.config.partition_method)

@@ -60,34 +60,64 @@ def settle_compile(max_attempts: int = 4,
     A failed remote compile (e.g. a Mosaic probe rejection) can wedge the
     tunnel's device grant for minutes (docs/RUNBOOK.md); unlike
     :func:`probe_backend` this exercises the COMPILE path specifically.
-    Each attempt runs in a SUBPROCESS with a timeout — a wedged backend
-    can hang (not error) in a native retry loop Python cannot interrupt,
-    and an in-process hang here would block the caller (the solver's
-    Pallas-probe fallback) worse than the wedge itself.  The probe shape
-    is pid/time-derived so a persistent compile-cache hit cannot fake
-    health on repeat invocations."""
+    With a LIVE in-process backend the probe compiles in-process through
+    our own client (a subprocess would contend with our own exclusive
+    device grant — the false-failure mode probe_backend's backend_live()
+    skip exists for), but inside a worker thread with a timeout, because
+    a wedged backend can hang (not error) in a native retry loop Python
+    cannot interrupt.  Without a live backend it probes in a SUBPROCESS
+    with a timeout for the same reason.  The probe shape is pid/time-
+    derived so a persistent compile-cache hit cannot fake health on
+    repeat invocations."""
     import time
 
+    live = backend_live()
     detail = "no attempt ran"
     for attempt in range(max_attempts):
         # odd sublane count -> unlikely to collide with real programs
         n = 8 * (attempt + 3) + 123 + 8 * ((os.getpid()
                                             + int(time.time())) % 1024)
-        code = (f"import jax, jax.numpy as jnp; "
-                f"jax.jit(lambda x: (x * 3 + 1).sum()).lower("
-                f"jax.ShapeDtypeStruct(({n}, 128), jnp.float32)).compile()")
-        try:
-            proc = subprocess.run([sys.executable, "-c", code],
-                                  timeout=timeout_s, capture_output=True,
-                                  text=True)
-        except subprocess.TimeoutExpired:
-            detail = f"compile probe hung past {timeout_s:.0f}s"
-        else:
-            if proc.returncode == 0:
+        if live:
+            from concurrent.futures import ThreadPoolExecutor
+            from concurrent.futures import TimeoutError as FutTimeout
+
+            def _probe():
+                import jax
+                import jax.numpy as jnp
+
+                jax.jit(lambda x: (x * 3 + 1).sum()).lower(
+                    jax.ShapeDtypeStruct((n, 128), jnp.float32)).compile()
+
+            ex = ThreadPoolExecutor(max_workers=1)
+            try:
+                ex.submit(_probe).result(timeout=timeout_s)
                 return True, f"compile service ok (attempt {attempt + 1})"
-            tail = (proc.stderr or "").strip().splitlines()[-4:]
-            detail = (f"compile probe rc={proc.returncode}: "
-                      + " | ".join(tail))
+            except FutTimeout:
+                detail = f"compile probe hung past {timeout_s:.0f}s"
+            except Exception as e:                      # noqa: BLE001
+                detail = (f"compile probe failed "
+                          f"({type(e).__name__}: {e})")
+            finally:
+                # do NOT wait: a native-hung worker thread cannot be
+                # joined; leak it and move on
+                ex.shutdown(wait=False)
+        else:
+            code = (f"import jax, jax.numpy as jnp; "
+                    f"jax.jit(lambda x: (x * 3 + 1).sum()).lower("
+                    f"jax.ShapeDtypeStruct(({n}, 128), "
+                    f"jnp.float32)).compile()")
+            try:
+                proc = subprocess.run([sys.executable, "-c", code],
+                                      timeout=timeout_s, capture_output=True,
+                                      text=True)
+            except subprocess.TimeoutExpired:
+                detail = f"compile probe hung past {timeout_s:.0f}s"
+            else:
+                if proc.returncode == 0:
+                    return True, f"compile service ok (attempt {attempt + 1})"
+                tail = (proc.stderr or "").strip().splitlines()[-4:]
+                detail = (f"compile probe rc={proc.returncode}: "
+                          + " | ".join(tail))
         if attempt + 1 < max_attempts:
             time.sleep(30.0 * (attempt + 1))
     return False, (f"compile service still failing after "

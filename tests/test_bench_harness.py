@@ -162,6 +162,9 @@ def test_settle_compile_mechanics(monkeypatch):
     the suite for the full timeout whenever the tunnel is wedged."""
     from pcg_mpi_solver_tpu.utils import backend_probe
 
+    # force the no-live-backend branch: the pytest process has a live
+    # (CPU) backend, which would route the probe in-process
+    monkeypatch.setattr(backend_probe, "backend_live", lambda: False)
     monkeypatch.setattr(backend_probe.sys, "executable", "/bin/true")
     ok, detail = backend_probe.settle_compile(max_attempts=1)
     assert ok and "attempt 1" in detail, detail
@@ -169,6 +172,17 @@ def test_settle_compile_mechanics(monkeypatch):
     monkeypatch.setattr(backend_probe.sys, "executable", "/bin/false")
     ok, detail = backend_probe.settle_compile(max_attempts=1)
     assert not ok and "rc=1" in detail, detail
+
+
+def test_settle_compile_live_backend_in_process():
+    """With a live in-process backend (this pytest process, CPU-pinned)
+    the probe must compile in-process — no subprocess that would contend
+    with an exclusive device grant — and succeed on attempt 1."""
+    from pcg_mpi_solver_tpu.utils import backend_probe
+
+    assert backend_probe.backend_live()
+    ok, detail = backend_probe.settle_compile(max_attempts=1, timeout_s=120)
+    assert ok and "attempt 1" in detail, detail
 
 
 def test_model_cache_eviction(tmp_path):

@@ -120,3 +120,65 @@ def test_pallas_matvec_zero_ck_column_isolated(kernel_fn):
         data2, xg.reshape(1, -1)))[0]
     np.testing.assert_allclose(np.asarray(y).reshape(-1), y_ref,
                                rtol=2e-5, atol=2e-5)
+
+
+def test_solver_pallas_interpret_structured_matches_xla():
+    """SolverConfig.pallas='interpret' drives the REAL solver->kernel
+    dispatch (grid reshape, leading-parts batching, f32 inner path)
+    through the Pallas interpreter — the integration CI cannot get from
+    kernel-level tests.  Must match the XLA path's iterations/solution."""
+    from pcg_mpi_solver_tpu import RunConfig, SolverConfig
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+    from pcg_mpi_solver_tpu.solver import Solver
+
+    model = make_cube_model(8, 5, 4, heterogeneous=True, seed=6,
+                            load="traction", load_value=1e6)
+    res = {}
+    for mode in ("off", "interpret"):
+        cfg = RunConfig(solver=SolverConfig(
+            tol=1e-6, max_iter=2000, dtype="float32",
+            precision_mode="mixed", pallas=mode))
+        s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1,
+                   backend="structured")
+        assert getattr(s.ops, "use_pallas", False) == (mode == "interpret")
+        r = s.step(1.0)
+        assert r.flag == 0, (mode, r)
+        res[mode] = (int(r.iters), s.displacement_global())
+    it_x, u_x = res["off"]
+    it_p, u_p = res["interpret"]
+    assert abs(it_x - it_p) <= 2, (it_x, it_p)
+    # two f32 solves to tol=1e-6: agreement is bounded by the solver
+    # tolerance times the solution scale, not by machine eps per element
+    np.testing.assert_allclose(u_p, u_x, rtol=1e-3,
+                               atol=1e-5 * float(np.abs(u_x).max()))
+
+
+def test_solver_pallas_interpret_hybrid_matches_xla():
+    """Same integration contract on the hybrid backend: the level-grid
+    stencils route through batched_structured_matvec for every eligible
+    level when pallas='interpret' (mirrors hybrid_pallas_enabled)."""
+    from pcg_mpi_solver_tpu import RunConfig, SolverConfig
+    from pcg_mpi_solver_tpu.models.octree import make_octree_model
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+    from pcg_mpi_solver_tpu.solver import Solver
+
+    model = make_octree_model(3, 3, 3, max_level=2, n_incl=2, seed=5,
+                              load="traction", load_value=1e6)
+    res = {}
+    for mode in ("off", "interpret"):
+        cfg = RunConfig(solver=SolverConfig(
+            tol=1e-6, max_iter=3000, dtype="float32",
+            precision_mode="mixed", pallas=mode))
+        s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1,
+                   backend="hybrid")
+        assert getattr(s.ops, "use_pallas", False) == (mode == "interpret")
+        if mode == "interpret":
+            assert any(s.ops.pallas_levels), s.ops.pallas_levels
+        r = s.step(1.0)
+        assert r.flag == 0, (mode, r)
+        res[mode] = (int(r.iters), s.displacement_global())
+    it_x, u_x = res["off"]
+    it_p, u_p = res["interpret"]
+    assert abs(it_x - it_p) <= 2, (it_x, it_p)
+    np.testing.assert_allclose(u_p, u_x, rtol=1e-3,
+                               atol=1e-5 * float(np.abs(u_x).max()))
