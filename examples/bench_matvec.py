@@ -58,18 +58,21 @@ def main():
     t_xla, y0 = timeit(xla, data, x)
     print(f"xla (gse):    {t_xla*1e3:8.3f} ms/matvec", flush=True)
 
-    # corner form: the fusion-friendly XLA formulation (no (24, cells)
-    # intermediates — parallel/structured.py _gse_corner)
-    corner = jax.jit(lambda d, xx: ops._gse_corner(
-        d["blocks"][0], ops._grid(xx), d["blocks"][0]["ck"]).reshape(
-            xx.shape))
-    try:
-        t_c, y_c = timeit(corner, data, x)
-        err = float(jnp.abs(y_c - y0).max() / jnp.abs(y0).max())
-        print(f"xla (corner): {t_c*1e3:8.3f} ms/matvec  "
-              f"(vs gse {t_xla/t_c:5.2f}x, maxrelerr {err:.2e})", flush=True)
-    except Exception as e:                          # noqa: BLE001
-        print(f"xla (corner): FAILED {type(e).__name__}: {e}", flush=True)
+    # alternative XLA formulations: gsplit (gse minus the gather concat —
+    # one fewer (24, cells) HBM round-trip) and corner (no (24, cells)
+    # intermediates at all; scalar-FMA-bound, 0.57x on v5e in wave 2/3)
+    for form in ("gsplit", "corner"):
+        ops_f = dataclasses.replace(ops, form=form)
+        fn = jax.jit(lambda d, xx, o=ops_f: o.matvec_local(d, xx))
+        try:
+            t_c, y_c = timeit(fn, data, x)
+            err = float(jnp.abs(y_c - y0).max() / jnp.abs(y0).max())
+            print(f"xla ({form}): {t_c*1e3:8.3f} ms/matvec  "
+                  f"(vs gse {t_xla/t_c:5.2f}x, maxrelerr {err:.2e})",
+                  flush=True)
+        except Exception as e:                      # noqa: BLE001
+            print(f"xla ({form}): FAILED {type(e).__name__}: {e}",
+                  flush=True)
 
     variants = [("pallas v1", structured_matvec_pallas),
                 ("pallas v2", structured_matvec_pallas_v2)]

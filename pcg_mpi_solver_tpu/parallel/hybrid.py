@@ -536,11 +536,17 @@ class HybridOps(Ops):
 
             return corner_matvec_grid(Ke, ck, xg)
         bx, by, bz = ck.shape[1], ck.shape[2], ck.shape[3]
-        slots = [xg[:, :, dx:dx + bx, dy:dy + by, dz:dz + bz]
-                 for dx, dy, dz in _CORNERS]
-        u = jnp.concatenate(slots, axis=1)             # (P, 24, cells)
-        v = jnp.einsum("de,pexyz->pdxyz", Ke, ck[:, None] * u,
-                       precision=self.precision)
+        if self.form == "gsplit":
+            from pcg_mpi_solver_tpu.parallel.structured import (
+                gsplit_matvec_grid)
+
+            v = gsplit_matvec_grid(Ke, ck, xg, self.precision)
+        else:
+            slots = [xg[:, :, dx:dx + bx, dy:dy + by, dz:dz + bz]
+                     for dx, dy, dz in _CORNERS]
+            u = jnp.concatenate(slots, axis=1)         # (P, 24, cells)
+            v = jnp.einsum("de,pexyz->pdxyz", Ke, ck[:, None] * u,
+                           precision=self.precision)
         terms = []
         for a, (dx, dy, dz) in enumerate(_CORNERS):
             terms.append(jnp.pad(

@@ -249,3 +249,18 @@ def test_tiled_blocks_match_dense(model):
     a_d = np.asarray(ops_d.nodal_average(data_d, e_d))
     a_t = np.asarray(ops_t.nodal_average(data_t, e_t))
     assert np.abs(a_t - a_d).max() / (np.abs(a_d).max() + 1e-30) < 1e-10
+
+
+def test_hybrid_forms_match(pair):
+    """Every stencil formulation (gse / gsplit / corner) must produce the
+    same hybrid matvec — form is pinned per-ops at construction."""
+    _, (ops_h, data_h), _, hp = pair
+    rng = np.random.default_rng(11)
+    P = data_h["eff"].shape[0]
+    x = jnp.asarray(rng.standard_normal((P, ops_h.n_loc)))
+    y_ref = np.asarray(ops_h.matvec(data_h, x))
+    scale = np.abs(y_ref).max()
+    for form in ("gsplit", "corner"):
+        ops_f = HybridOps.from_hybrid(hp, form=form)
+        y_f = np.asarray(ops_f.matvec(data_h, x))
+        assert np.abs(y_f - y_ref).max() / scale < 1e-13, form

@@ -125,8 +125,9 @@ def test_chunked_f64_matvec_matches_unchunked():
                                rtol=1e-13, atol=1e-13)
 
 
-def test_corner_form_matches_gse():
-    """The corner form (fusion-friendly, no (24, cells) intermediates)
+def test_alt_forms_match_gse():
+    """The alternative formulations (corner: fusion-friendly, no
+    (24, cells) intermediates; gsplit: concat-free accumulating einsums)
     must produce the same matvec as the default gather/einsum/scatter
     form to float tolerance.  The form is pinned per-ops at
     construction, so both formulations are explicit instances."""
@@ -139,10 +140,11 @@ def test_corner_form_matches_gse():
     sp = partition_structured(model, 2)
     data = device_data_structured(sp, jnp.float64)
     ops_gse = StructuredOps.from_partition(sp, form="gse")
-    ops_corner = StructuredOps.from_partition(sp, form="corner")
     rng = np.random.default_rng(5)
     x = jnp.asarray(rng.standard_normal((2, sp.n_loc)))
     y_gse = np.asarray(ops_gse.matvec(data, x))
-    y_corner = np.asarray(ops_corner.matvec(data, x))
     scale = np.abs(y_gse).max()
-    assert np.abs(y_corner - y_gse).max() / scale < 1e-13
+    for form in ("corner", "gsplit"):
+        ops_f = StructuredOps.from_partition(sp, form=form)
+        y_f = np.asarray(ops_f.matvec(data, x))
+        assert np.abs(y_f - y_gse).max() / scale < 1e-13, form
