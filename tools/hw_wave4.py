@@ -45,24 +45,37 @@ def main():
     args = ap.parse_args()
     path = start_queue("hw_wave4", args.deadline_min, args.log)
 
-    run_step(path, "matvec A/B v6", ["examples/bench_matvec.py", "150"],
-             timeout=2400)
-    # Octree flagship: ladder 22 -> 18 -> 12 (5.67M / 3.76M / 1.3M dofs
-    # at level 4).  Model gen alone took 134 s at 22^3 in wave 1; compile
-    # of the blocked hybrid is the open question — full-budget step.
-    run_step(path, "octree flagship (gather combine)", ["bench.py"],
-             env_extra={"BENCH_MODEL": "octree"}, timeout=4800,
+    # 1. v6 Pallas A/B + the gsplit XLA form, both first-time on HW.
+    run_step(path, "matvec A/B v6+gsplit",
+             ["examples/bench_matvec.py", "150"], timeout=2400)
+    # 2. Flagship cube with the v6 probe live (pallas=auto probes v6 now;
+    # models come from .bench_cache, saving ~17 s/rung).
+    run_step(path, "flagship (v6 probe live)", ["bench.py"], timeout=3600,
              force_gate=True)   # the A/B exits 0 even when every Mosaic
     #                             probe failed and wedged the grant
-    # Flagship cube with the v6 probe live (pallas=auto probes v6 now).
-    run_step(path, "flagship (v6 probe live)", ["bench.py"], timeout=3600,
+    # 3. Octree flagship: ladder 22 -> 18 -> 12 (5.67M / 3.76M / 1.3M dofs
+    # at level 4) under the gather combine (wave-1 compile fail was under
+    # scatter).  VERDICT r2 item 5 is open until this lands.
+    run_step(path, "octree flagship (gather combine)", ["bench.py"],
+             env_extra={"BENCH_MODEL": "octree"}, timeout=4800,
              force_gate=True)
-    # Plateau A/B: same flagship cube as the rc=0 headline, window 120
+    # 4. f64-direct TPU anchor (wave 3's ran as CPU fallback: tunnel down).
+    run_step(path, "f64 direct anchor 96", ["bench.py"],
+             env_extra={"BENCH_MODE": "direct", "BENCH_DTYPE": "float64",
+                        "BENCH_NX": "96"},
+             timeout=3600, force_gate=True)
+    # 5. Per-iteration split at flagship scale (owed since wave 1).
+    run_step(path, "iteration breakdown",
+             ["examples/bench_iter_breakdown.py", "150"], timeout=2400)
+    # 6. Plateau A/B: same flagship cube as the rc=0 headline, window 120
     # (the only setting that was lossless at small scale).  Compare
     # iters/time against the window-0 runs already in the log.
     run_step(path, "flagship plateau=120", ["bench.py"],
              env_extra={"BENCH_PLATEAU": "120"}, timeout=3600)
-    # Scatter-replacement candidates at flagship fill.
+    # 7. Hybrid per-level split (owed since wave 1).
+    run_step(path, "hybrid breakdown",
+             ["examples/bench_hybrid_breakdown.py"], timeout=2400)
+    # 8. Scatter-replacement candidates at flagship fill.
     run_step(path, "gather/scatter variants", ["examples/bench_gather.py"],
              timeout=2400)
     log_line(path, "hw_wave4 complete")
