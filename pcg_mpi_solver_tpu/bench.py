@@ -475,11 +475,13 @@ def main():
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        # honor an explicit CPU request even where a sitecustomize
-        # force-registers the accelerator plugin ahead of the env var
-        # (docs/RUNBOOK.md) — enables CPU smoke runs of the bench
-        jax.config.update("jax_platforms", "cpu")
+    from pcg_mpi_solver_tpu.utils.backend_probe import (
+        pin_cpu_backend_if_requested)
+
+    # honor an explicit CPU request even where a sitecustomize
+    # force-registers the accelerator plugin ahead of the env var
+    # (docs/RUNBOOK.md) — enables CPU smoke runs of the bench
+    pin_cpu_backend_if_requested()
 
     # Dispatch breadcrumbs on by default: a wedged remote compile/execute
     # must be localizable from the driver's captured stderr.

@@ -12,12 +12,18 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from pcg_mpi_solver_tpu.utils.backend_probe import pin_cpu_backend_if_requested
+
 PARTS_AXIS = "parts"
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> jax.sharding.Mesh:
     """1-D mesh over the parts axis."""
     if devices is None:
+        # a JAX_PLATFORMS=cpu env request must become an in-process pin
+        # BEFORE the jax.devices() touch (wedged-tunnel hang otherwise —
+        # see the helper's docstring)
+        pin_cpu_backend_if_requested()
         devices = jax.devices()
         if n_devices is not None:
             if len(devices) < n_devices:

@@ -19,6 +19,23 @@ import sys
 from typing import Tuple
 
 
+def pin_cpu_backend_if_requested() -> None:
+    """Apply the JAX_PLATFORMS=cpu env request as an IN-PROCESS config pin.
+
+    The env var alone does NOT stop a sitecustomize-registered TPU plugin
+    (axon) from initializing on the first jax.devices()/jit touch — and
+    that init HANGS uninterruptibly when the accelerator tunnel is wedged
+    (observed 2026-07-31).  Only the explicit config.update pins the
+    backend for real (jax pre-populates the config from the env var, so
+    the value can look set already — update unconditionally, it is
+    idempotent).  Call BEFORE any device touch; no-op unless the env
+    requests cpu."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def backend_live() -> bool:
     """True when a JAX backend is already initialized in this process."""
     try:
