@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pcg_mpi_solver_tpu.models.octree import make_octree_model
+from pcg_mpi_solver_tpu.bench import cached_model
 from pcg_mpi_solver_tpu.parallel.hybrid import (
     HybridOps, device_data_hybrid, partition_hybrid)
 
@@ -38,9 +38,9 @@ def main():
     level = int(sys.argv[2]) if len(sys.argv) > 2 else 4
     incl = int(sys.argv[3]) if len(sys.argv) > 3 else 6
     t0 = time.perf_counter()
-    model = make_octree_model(n0, n0, n0, max_level=level, n_incl=incl,
-                              seed=2, E=30e9, nu=0.2, load="traction",
-                              load_value=1e6)
+    model = cached_model("octree", nx0=n0, ny0=n0, nz0=n0, max_level=level,
+                         n_incl=incl, seed=2, E=30e9, nu=0.2,
+                         load="traction", load_value=1e6)
     print(f"# model {model.n_dof} dofs / {model.n_elem} elems "
           f"(gen {time.perf_counter()-t0:.1f}s)", flush=True)
     t0 = time.perf_counter()

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pcg_mpi_solver_tpu.models import make_cube_model
+from pcg_mpi_solver_tpu.bench import cached_model
 from pcg_mpi_solver_tpu.parallel.structured import (
     StructuredOps, device_data_structured, partition_structured)
 
@@ -42,8 +42,8 @@ def main():
     jax.config.update("jax_enable_x64", True)
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 150
     t0 = time.perf_counter()
-    model = make_cube_model(n, n, n, E=30e9, nu=0.2, load="traction",
-                            load_value=1e6)
+    model = cached_model("cube", nx=n, ny=n, nz=n, E=30e9, nu=0.2,
+                         load="traction", load_value=1e6)
     print(f"# model {model.n_dof} dofs (gen {time.perf_counter()-t0:.1f}s)",
           flush=True)
     sp = partition_structured(model, 1)

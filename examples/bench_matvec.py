@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pcg_mpi_solver_tpu.models import make_cube_model
+from pcg_mpi_solver_tpu.bench import cached_model
 from pcg_mpi_solver_tpu.ops.pallas_matvec import (
     structured_matvec_pallas, structured_matvec_pallas_v2,
     structured_matvec_pallas_v3, structured_matvec_pallas_v4)
@@ -36,7 +36,8 @@ def main():
     nx = int(sys.argv[1]) if len(sys.argv) > 1 else 150
     ny = int(sys.argv[2]) if len(sys.argv) > 2 else nx
     nz = int(sys.argv[3]) if len(sys.argv) > 3 else nx
-    model = make_cube_model(nx, ny, nz, heterogeneous=True)
+    model = cached_model("cube", nx=nx, ny=ny, nz=nz,
+                         heterogeneous=True)
     sp = partition_structured(model, 1)
     data = device_data_structured(sp, jnp.float32)
     ops = StructuredOps.from_partition(sp, dot_dtype=jnp.float32)

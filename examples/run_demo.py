@@ -54,10 +54,6 @@ def main():
 
     # -- 2. partition ---------------------------------------------------
     t0 = time.perf_counter()
-    from pcg_mpi_solver_tpu.utils.backend_probe import (
-        pin_cpu_backend_if_requested)
-
-    pin_cpu_backend_if_requested()   # before the first device touch
     n_dev = len(jax.devices())
     n_parts = max(n_dev, 2)
     part = make_elem_part(model, n_parts, method="auto")

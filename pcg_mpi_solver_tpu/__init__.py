@@ -29,6 +29,16 @@ Design (TPU-first, not a port):
 
 __version__ = "0.1.0"
 
+# ONE chokepoint for the wedged-tunnel guard: a JAX_PLATFORMS=cpu env
+# request becomes an in-process backend pin at package import, BEFORE any
+# entry point's first device touch (the env var alone does not stop a
+# sitecustomize-registered TPU plugin from initializing — and hanging —
+# on a wedged tunnel; see utils/backend_probe.py).  Code that changes
+# JAX_PLATFORMS at runtime (bench's CPU fallback) re-pins itself.
+from pcg_mpi_solver_tpu.utils.backend_probe import pin_cpu_backend_if_requested
+
+pin_cpu_backend_if_requested()
+
 from pcg_mpi_solver_tpu.config import SolverConfig, TimeHistoryConfig, RunConfig
 
 __all__ = [

@@ -142,9 +142,10 @@ def _probe_with_retry(budget_s=None, probe_timeout_s=180.0):
 
 
 def _model_cache_key(kind, gen_kwargs):
-    """Cache key = the FULL generator kwargs + a hash of the model-source
-    files, so neither a generator code change nor an edit to the
-    hard-coded kwargs below can serve a stale model."""
+    """Cache key = the caller's FULL generator kwargs + a hash of the
+    model-source files — so neither a generator code change, an edited
+    call-site kwarg, nor a changed GENERATOR DEFAULT (callers may pass
+    partial kwarg sets) can serve a stale model."""
     import hashlib
 
     import pcg_mpi_solver_tpu.models as m
@@ -159,21 +160,13 @@ def _model_cache_key(kind, gen_kwargs):
     return h.hexdigest()[:16]
 
 
-def _build_model(kind, nx, ny, nz, ot_n, ot_level):
-    """Build (or load from the on-disk cache) a bench model.  Octree
-    generation costs minutes at flagship scale on the 1-core bench host;
-    caching it cuts per-hardware-step latency and step-timeout pressure.
-    Disable with BENCH_MODEL_CACHE=0."""
+def cached_model(kind, **gen_kwargs):
+    """Build (or load from the on-disk cache) a model.  Octree generation
+    costs minutes at flagship scale on the 1-core bench host; caching cuts
+    per-hardware-step latency and step-timeout pressure for the bench AND
+    the examples/bench_*.py microbenchmarks (same cache, keyed on the full
+    kwargs + a models-source hash).  Disable with BENCH_MODEL_CACHE=0."""
     import pickle
-
-    if kind == "octree":
-        gen_kwargs = dict(nx0=ot_n, ny0=ot_n, nz0=ot_n, max_level=ot_level,
-                          n_incl=6, seed=2, E=30e9, nu=0.2,
-                          load="traction", load_value=1e6)
-    else:
-        gen_kwargs = dict(nx=nx, ny=ny, nz=nz, E=30e9, nu=0.2,
-                          load="traction", load_value=1e6,
-                          heterogeneous=True)
 
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              os.pardir, ".bench_cache")
@@ -214,6 +207,17 @@ def _build_model(kind, nx, ny, nz, ot_n, ot_level):
         except Exception as e:                          # noqa: BLE001
             _log(f"# model cache write failed ({type(e).__name__}); continuing")
     return model
+
+
+def _build_model(kind, nx, ny, nz, ot_n, ot_level):
+    if kind == "octree":
+        return cached_model(kind, nx0=ot_n, ny0=ot_n, nz0=ot_n,
+                            max_level=ot_level, n_incl=6, seed=2,
+                            E=30e9, nu=0.2, load="traction",
+                            load_value=1e6)
+    return cached_model(kind, nx=nx, ny=ny, nz=nz, E=30e9, nu=0.2,
+                        load="traction", load_value=1e6,
+                        heterogeneous=True)
 
 
 def _evict_model_cache(cache_dir, keep, cap_bytes=None):

@@ -91,10 +91,6 @@ def cmd_solve(args):
     cfg.profile_dir = args.profile_dir or ""
     model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
     cfg.time_history.dt = model.dt   # frame timestamps follow the model's dt
-    from pcg_mpi_solver_tpu.utils.backend_probe import (
-        pin_cpu_backend_if_requested)
-
-    pin_cpu_backend_if_requested()   # before the first device touch
     n_dev = len(jax.devices())
     n_parts = args.n_parts or n_dev
 
