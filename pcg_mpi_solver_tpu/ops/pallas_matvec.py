@@ -158,8 +158,10 @@ def selected_variant():
         return "v4", _planes_env(structured_matvec_pallas_v4)
     if v == "5":
         return "v5", _planes_env(structured_matvec_pallas_v5)
+    if v == "7":
+        return "v7", _planes_env(structured_matvec_pallas_v7)
     if v != "6":
-        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3|4|5|6, got {v!r}")
+        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3|4|5|6|7, got {v!r}")
     return "v6", _planes_env(structured_matvec_pallas_v6)
 
 
@@ -934,6 +936,153 @@ def structured_matvec_pallas_v6(xg, ck, Ke, *, interpret=False, planes=8):
         scratch_shapes=[
             pltpu.VMEM((2, 3, cpp + 8, mt128), xg.dtype),
             pltpu.VMEM((2, cpp, m128), ck.dtype),
+            pltpu.VMEM((3, mt128), xg.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(Ke, x_pad, ck_pad)
+    return y[:, :nxn, :m].reshape(3, nxn, nyn, nzn)
+
+
+# ----------------------------------------------------------------------
+# v7: v6's slab DMA, roll-only compute.
+#
+# v6 still contains two op classes Mosaic has never been observed to
+# lower in this kernel family (every variant so far died at its first
+# unproven op, serially): (a) VALUE lane-slices at unaligned offsets —
+# the u build reads xb[c, k+dx, off:off+m128] at off = dy*sy+dz, whose
+# result carries a non-canonical lane-offset layout into an elementwise
+# multiply and a 24-row stack (v4's concat rejection came from exactly
+# such offset layouts); (b) the output pad-concat at the m128 boundary.
+# v7 removes both: every lane placement — input gather AND output
+# placement — is a pltpu.roll (tpu rotate, canonical {0,0} result) of a
+# full mt128-wide row, and the zero tail of the ck mask kills the
+# cyclic wrap:
+#
+#   input:  u_row = ck_mt * roll(x_row, mt128 - off)   # u[l] = ck*x[l+off]
+#           (wrap lanes l >= mt128-off carry head values, but ck_mt is
+#           zero for l >= m, and l+off never wraps for l < m)
+#   dot:    ke[3b:3b+3] @ u  -> (3, mt128), {0,0}, no pad needed
+#   output: roll(blk, +off) accumulated into mt128-wide lo/hi
+#
+# ck is host-padded to mt128 (not m128) so its DMA stays full-width and
+# no in-kernel pad exists at all.
+# ----------------------------------------------------------------------
+
+
+def _matvec_kernel_v7(ke_ref, x_hbm, ck_hbm, y_ref,
+                      xv, ckv, acc, sems, ck_sems,
+                      *, g, cpp, m128, mt128, sy):
+    """One grid step = cpp finished output node planes.
+
+    ke_ref: (24, 24) VMEM
+    x_hbm:  (3, g*cpp + 8, m128) ANY/HBM (lane- and plane-padded, zeros)
+    ck_hbm: (g*cpp, mt128) ANY/HBM (zero-padded both axes, FULL mt width)
+    y_ref:  (3, cpp, m128) VMEM output block
+    xv:     (2, 3, cpp+8, mt128) VMEM double-buffered slab; lanes
+            [m128, mt128) stay zero from _init
+    ckv:    (2, cpp, mt128) VMEM
+    acc:    (3, mt128) VMEM — dx=1 partials carried to the next plane
+    """
+    j = jnp.asarray(pl.program_id(0), jnp.int32)  # i32 ALWAYS (see v4)
+
+    def for_chunk(slot, chunk, act):
+        # i32 ALWAYS, including literal zeros (index promotion, see v6)
+        c0 = jnp.asarray(chunk * cpp, jnp.int32)
+        z = jnp.asarray(0, jnp.int32)
+        getattr(pltpu.make_async_copy(
+            x_hbm.at[:, pl.ds(c0, cpp + 8), :],
+            xv.at[slot, :, :, pl.ds(z, m128)], sems.at[slot]), act)()
+        getattr(pltpu.make_async_copy(
+            ck_hbm.at[pl.ds(c0, cpp)],
+            ckv.at[slot], ck_sems.at[slot]), act)()
+
+    @pl.when(j == 0)
+    def _init():
+        xv[...] = jnp.zeros_like(xv)       # zero overhang tails once
+        acc[...] = jnp.zeros_like(acc)
+        for_chunk(0, 0, "start")
+
+    slot = jax.lax.rem(j, jnp.asarray(2, j.dtype))
+    for_chunk(slot, j, "wait")
+
+    @pl.when(j + 1 < g)
+    def _prefetch():
+        for_chunk(1 - slot, j + 1, "start")
+
+    ke = ke_ref[...]                                    # (24, 24)
+    xb = xv[slot]                                       # (3, cpp+8, mt128)
+    ckb = ckv[slot]                                     # (cpp, mt128)
+    carry = acc[...]                                    # (3, mt128)
+    for k in range(cpp):
+        ck = ckb[k]                                     # (mt128,), 0 tail
+        rows = []
+        for a, (dx, dy, dz) in enumerate(_CORNERS):
+            off = int(dy * sy + dz)
+            for c in range(3):
+                base = xb[c, k + dx]                    # (mt128,) full row
+                if off:
+                    base = pltpu.roll(base, mt128 - off, 0)
+                rows.append(ck * base)
+        u = jnp.stack(rows)                             # (24, mt128), {0,0}
+        lo = jnp.zeros((3, mt128), u.dtype)
+        hi = jnp.zeros((3, mt128), u.dtype)
+        for b, (dx, dy, dz) in enumerate(_CORNERS):
+            off = int(dy * sy + dz)
+            blk = jax.lax.dot_general(
+                ke[3 * b:3 * b + 3], u, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)     # (3, mt128), {0,0}
+            if off:
+                blk = pltpu.roll(blk, off, 1)           # lane placement
+            if dx == 0:
+                lo = lo + blk
+            else:
+                hi = hi + blk
+        out = carry + lo
+        for c in range(3):
+            y_ref[c, k] = out[c, :m128]
+        carry = hi
+    acc[...] = carry
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "planes"))
+def structured_matvec_pallas_v7(xg, ck, Ke, *, interpret=False, planes=8):
+    """Roll-only variant of :func:`structured_matvec_pallas_v6`.
+
+    Same signature/semantics: xg (3, nx+1, ny+1, nz+1), ck (nx, ny, nz),
+    Ke (24, 24), all f32; ``planes`` = cell planes per grid step
+    (multiple of 8)."""
+    _, nxn, nyn, nzn = xg.shape
+    nx = nxn - 1
+    m = nyn * nzn
+    m128 = -(-m // 128) * 128
+    sy = nzn
+    mt128 = m128 + (-(-(sy + 2) // 128)) * 128
+    cpp = max(1, min(planes, ((nx + 1 + 7) // 8) * 8))
+    g = -(-(nx + 1) // cpp)                 # ceil: covers all output planes
+    x_flat = xg.reshape(3, nxn, m)          # free reshape, no copy
+    x_pad = jnp.pad(x_flat, ((0, 0), (0, g * cpp + 8 - nxn), (0, m128 - m)))
+    # ck pads are loop-invariant, so XLA hoists them out of the PCG loop;
+    # FULL mt128 lane width so no pad op exists inside the kernel
+    ck_pad = jnp.pad(ck, ((0, g * cpp - nx), (0, 1), (0, 1))) \
+        .reshape(g * cpp, m)
+    ck_pad = jnp.pad(ck_pad, ((0, 0), (0, mt128 - m)))
+    kernel = functools.partial(_matvec_kernel_v7, g=g, cpp=cpp,
+                               m128=m128, mt128=mt128, sy=sy)
+    y = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # Ke
+            pl.BlockSpec(memory_space=pl.ANY),         # x (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),         # ck (manual DMA)
+        ],
+        out_specs=pl.BlockSpec((3, cpp, m128), lambda j: (0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, g * cpp, m128), xg.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, 3, cpp + 8, mt128), xg.dtype),
+            pltpu.VMEM((2, cpp, mt128), ck.dtype),
             pltpu.VMEM((3, mt128), xg.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
