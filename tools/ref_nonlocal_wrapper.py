@@ -1,0 +1,104 @@
+"""Run the REFERENCE partitioner with its nonlocal-stress path enabled,
+instrumented to dump the nonlocal weight structures it computes.
+
+The reference has a LATENT DEFECT here (same class as its never-loaded
+``Se.mat`` strain path, SURVEY.md §2c): ``config_ElemMaterial`` has the
+``NonLocStressParam`` MatProp parsing commented out
+(/root/reference/src/solver/partition_mesh.py:515-523), so running
+``partition_mesh.py N 1`` crashes with a KeyError at
+``config_NonlocalNeighbours``'s first Lc access (:1018-1019).  This
+wrapper executes the reference's OWN main sequence verbatim
+(partition_mesh.py:1389-1428) with exactly ONE injection between
+``config_ElemMaterial`` and ``config_NonlocalNeighbours``: the
+``NonLocStressParam`` dicts read from the model's own ``MatProp.mat`` —
+precisely what the commented-out parser would have produced.  Everything
+else — neighbor discovery, element-id exchanges, the Gaussian weight
+build, the csr assembly — is the reference's unmodified code.
+
+After ``exportMP`` it dumps, per partition, the in-memory
+``{ElemIdVector, NL_ElemIdVec, NLSpWeightMatrix}`` (the global column-id
+vector ``NL_ElemIdVec`` is NOT in the reference's own export, which only
+ships solver-facing local maps) to ``<scratch>/nonlocal_ref.pkl`` for
+the parity harness.
+
+Usage (under tools/mpi_shim, cwd = the stage dir with ``src`` symlink):
+    python ref_nonlocal_wrapper.py <N_parts> <out_pickle>
+"""
+
+import pickle
+import sys
+
+import numpy as np
+import scipy.io
+
+
+def main():
+    n_parts, out_path = sys.argv[1], sys.argv[2]
+    # the reference parses argv itself (initModelData): [prog, N, ExportNL]
+    sys.argv = ["partition_mesh.py", n_parts, "1"]
+
+    import importlib.util
+
+    from mpi4py import MPI
+
+    spec = importlib.util.spec_from_file_location(
+        "ref_partition_mesh", "src/solver/partition_mesh.py")
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)      # __main__-guarded: defs only
+
+    # the reference's main block binds these as module globals
+    pm.Comm = MPI.COMM_WORLD
+    pm.Rank = pm.Comm.Get_rank()
+    pm.N_Workers = pm.Comm.Get_size()
+
+    # ---- the reference's own main sequence (partition_mesh.py:1389-1428)
+    GlobData = pm.initModelData()
+    pm.Comm.barrier()
+    MPGData = {"GlobData": GlobData, "PotentialNbrDataFlag": False}
+    pm.extract_Elepart(MPGData)
+    pm.extract_PlotSettings(MPGData)
+    if pm.N_Workers > 1 and not GlobData["N_MPGs"] % 4 == 0:
+        raise Exception("N_Workers must be a multiple of 4")
+    pm.extract_ElemMeshData(MPGData)
+    pm.Comm.barrier()
+    pm.config_ElemVectors(MPGData)
+    pm.extract_NodalVectors(MPGData)
+    pm.config_TypeGroupList(MPGData)
+    pm.config_ElemMaterial(MPGData)
+
+    # ---- the ONE injection: what partition_mesh.py:515-523 would parse
+    mat_raw = scipy.io.loadmat(GlobData["MDF_Path"] + "MatProp.mat",
+                               struct_as_record=False)["Data"][0]
+    for i, mp in enumerate(MPGData["MatProp"]):
+        d = mat_raw[i].__dict__
+        raw = d["NonLocStressParam"][0]
+        nl = {}
+        for io in range(len(raw) // 2):
+            nl[str(raw[2 * io][0])] = float(raw[2 * io + 1][0][0])
+        mp["NonLocStressParam"] = nl
+    # (MeshPart['MatProp'] entries are the same dict objects — shared)
+
+    pm.config_ElemLib(MPGData)
+    pm.config_IntfcElem(MPGData)
+    pm.identify_PotentialNeighbours(MPGData)
+    pm.config_Neighbours(MPGData)
+    pm.config_NonlocalNeighbours(MPGData)
+    pm.exportMP(MPGData)
+
+    # ---- dump the reference-computed nonlocal structures for the harness
+    local = [{
+        "Id": int(mpart["Id"]),
+        "ElemIdVector": np.asarray(mpart["ElemIdVector"]),
+        "NL_ElemIdVec": np.asarray(mpart["NL_ElemIdVec"]),
+        "NLSpWeightMatrix": mpart["NLSpWeightMatrix"],
+    } for mpart in MPGData["MeshPartList"]]
+    gathered = pm.Comm.gather(local, root=0)
+    if pm.Rank == 0:
+        parts = [p for worker in gathered for p in worker]
+        with open(out_path, "wb") as f:
+            pickle.dump(parts, f)
+        print(f">nonlocal wrapper: dumped {len(parts)} partitions")
+
+
+if __name__ == "__main__":
+    main()

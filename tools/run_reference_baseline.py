@@ -42,6 +42,23 @@ REFERENCE = os.environ.get("PCG_REFERENCE_PATH", "/root/reference")
 SHIM = os.path.join(REPO, "tools", "mpi_shim")
 
 
+def make_stage(scratch):
+    """Create <scratch>/stage with a ``src`` symlink to the CURRENT
+    reference checkout, unlinking a stale link left by an earlier run
+    against a different PCG_REFERENCE_PATH (a reused --scratch must
+    never silently run the wrong oracle)."""
+    stage = os.path.join(scratch, "stage")
+    os.makedirs(stage, exist_ok=True)
+    link = os.path.join(stage, "src")
+    target = os.path.join(REFERENCE, "src")
+    if os.path.lexists(link):
+        if os.path.islink(link) and os.readlink(link) != target:
+            os.unlink(link)        # stale link from an earlier reference
+    if not os.path.lexists(link):
+        os.symlink(target, link)
+    return stage
+
+
 def _run(stage, argv, env, ranks=1):
     t0 = time.perf_counter()
     if ranks > 1:
@@ -240,15 +257,7 @@ def main():
     import tempfile
 
     scratch = args.scratch or tempfile.mkdtemp(prefix="refbase_")
-    stage = os.path.join(scratch, "stage")
-    os.makedirs(stage, exist_ok=True)
-    link = os.path.join(stage, "src")
-    target = os.path.join(REFERENCE, "src")
-    if os.path.lexists(link):
-        if os.path.islink(link) and os.readlink(link) != target:
-            os.unlink(link)        # stale link from an earlier reference
-    if not os.path.lexists(link):
-        os.symlink(target, link)
+    stage = make_stage(scratch)
 
     sys.path.insert(0, REPO)
     from pcg_mpi_solver_tpu.models import make_cube_model

@@ -1,0 +1,148 @@
+"""Nonlocal-stress ORACLE: the reference's own ``config_NonlocalNeighbours``
+(partition_mesh.py:1000-1299) vs this framework's ``ops/nonlocal_stress.py``
+on the same model.
+
+The reference's nonlocal path is latently broken in this snapshot (the
+``NonLocStressParam`` MatProp parsing is commented out,
+partition_mesh.py:515-523 — see tools/ref_nonlocal_wrapper.py), so the
+wrapper injects exactly what that parser would have produced and otherwise
+runs the reference's unmodified main sequence with ``ExportNonLocalStress=1``
+under the multi-rank mpi_shim — exercising its nonlocal AABB broadcast,
+element-id Isend/Recv exchanges, per-element box search, Gaussian weight
+build and per-partition csr assembly as an oracle.
+
+Comparison: the reference's per-partition ``NLSpWeightMatrix`` rows are
+composed into a GLOBAL (n_elem x n_elem) csr via each partition's
+``ElemIdVector`` (rows) and ``NL_ElemIdVec`` (columns) and compared against
+this framework's global row-normalized operator — same sparsity pattern,
+values to float tolerance.
+
+Prints ONE JSON line; exits nonzero on mismatch.
+
+Usage: python tools/run_reference_nonlocal.py [--n 8] [--ranks 4]
+"""
+
+import argparse
+import json
+import os
+import pickle
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.run_reference_baseline import (  # noqa: E402
+    REFERENCE, REPO, SHIM, _run, make_stage)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=8, help="cube cells per edge")
+    ap.add_argument("--ranks", type=int, default=4,
+                    help="partition workers (1 or a multiple of 4); >1 "
+                         "exercises the reference's nonlocal Isend/Recv "
+                         "element-id exchanges across real processes")
+    ap.add_argument("--parts", type=int, default=4,
+                    help="mesh partitions (N_parts)")
+    ap.add_argument("--lc", type=float, nargs=2, default=[2.3, 1.7],
+                    help="per-material nonlocal length Lc (defaults picked "
+                         "so Ko*max(Lc) is not an exact centroid distance — "
+                         "boundary-tie behavior at the box surface is not "
+                         "part of the parity contract)")
+    ap.add_argument("--scratch", default=None)
+    args = ap.parse_args()
+    if args.ranks != 1 and (args.ranks % 4 != 0
+                            or args.parts % args.ranks != 0):
+        # the reference hardcodes 4 loading ranks and requires workers to
+        # divide N_parts (partition_mesh.py:39-40,1409) — fail at argparse
+        # instead of deep inside an N-process shim run
+        ap.error(f"--ranks must be 1, or a multiple of 4 dividing --parts "
+                 f"(got ranks={args.ranks}, parts={args.parts})")
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from pcg_mpi_solver_tpu.models import make_cube_model
+    from pcg_mpi_solver_tpu.models.mdf import read_mdf, write_mdf
+    from pcg_mpi_solver_tpu.ops.nonlocal_stress import build_nonlocal_weights
+
+    scratch = args.scratch or tempfile.mkdtemp(prefix="refnl_")
+    stage = make_stage(scratch)
+
+    t0 = time.perf_counter()
+    model = make_cube_model(args.n, args.n, args.n, E=30e9, nu=0.2,
+                            load="traction", load_value=1e6,
+                            heterogeneous=True, seed=7)
+    for mp, lc in zip(model.mat_prop, args.lc):
+        mp["NonLocStressParam"] = {"Lc": float(lc)}
+    mdf_dir = os.path.join(scratch, "mdf")
+    write_mdf(model, mdf_dir)
+    archive = shutil.make_archive(os.path.join(scratch, "cube"), "zip",
+                                  mdf_dir)
+    print(f"# model: {model.n_elem} elems, Lc={args.lc} "
+          f"({time.perf_counter()-t0:.1f}s)", file=sys.stderr, flush=True)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [SHIM, stage] + env.get("PYTHONPATH", "").split(os.pathsep))
+    env.pop("JAX_PLATFORMS", None)        # reference is numpy-only
+    ref_scratch = os.path.join(scratch, "ref_scratch")
+
+    _run(stage, ["src/data/read_input_model.py", stage, "cube",
+                 ref_scratch, archive], env)
+    _run(stage, ["src/solver/run_metis.py", str(args.parts)], env)
+    dump = os.path.join(scratch, "nonlocal_ref.pkl")
+    wrapper = os.path.join(REPO, "tools", "ref_nonlocal_wrapper.py")
+    dt, _ = _run(stage, [wrapper, str(args.parts), dump], env,
+                 ranks=args.ranks)
+    print(f"# reference partition+nonlocal: {dt:.1f}s at {args.ranks} "
+          f"ranks", file=sys.stderr, flush=True)
+
+    # ---- compose the reference's global operator
+    with open(dump, "rb") as f:
+        parts = pickle.load(f)
+    import scipy.sparse as sp
+
+    n_elem = model.n_elem
+    rows, cols, vals = [], [], []
+    for p in parts:
+        W = p["NLSpWeightMatrix"].tocoo()
+        rows.append(np.asarray(p["ElemIdVector"])[W.row])
+        cols.append(np.asarray(p["NL_ElemIdVec"])[W.col])
+        vals.append(W.data)
+    W_ref = sp.csr_matrix(
+        (np.concatenate(vals),
+         (np.concatenate(rows), np.concatenate(cols))),
+        shape=(n_elem, n_elem))
+
+    # ---- this framework's operator on the same model (MDF round-trip,
+    # exactly what the reference's partitioner consumed)
+    ours = build_nonlocal_weights(read_mdf(mdf_dir))
+    W_our = ours.csr
+
+    # ---- compare: sparsity pattern + values
+    d = (W_ref - W_our).tocoo()
+    max_abs = float(np.abs(d.data).max()) if d.nnz else 0.0
+    pat_ref = set(zip(*W_ref.nonzero()))
+    pat_our = set(zip(*W_our.nonzero()))
+    only_ref = len(pat_ref - pat_our)
+    only_our = len(pat_our - pat_ref)
+    row_sums = np.asarray(W_our.sum(axis=1)).ravel()
+    result = {
+        "n_elem": n_elem, "ranks": args.ranks, "parts": args.parts,
+        "nnz_ref": int(W_ref.nnz), "nnz_ours": int(W_our.nnz),
+        "pattern_only_ref": only_ref, "pattern_only_ours": only_our,
+        "max_abs_diff": max_abs,
+        "row_normalized": bool(np.allclose(row_sums, 1.0, atol=1e-12)),
+    }
+    ok = (only_ref == 0 and only_our == 0 and max_abs < 1e-12
+          and result["row_normalized"])
+    result["parity"] = "PASS" if ok else "FAIL"
+    print(json.dumps(result))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
