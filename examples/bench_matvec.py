@@ -88,7 +88,7 @@ def main():
             structured_matvec_pallas_v4, planes=c)))
     from pcg_mpi_solver_tpu.ops.pallas_matvec import (
         structured_matvec_pallas_v5, structured_matvec_pallas_v6,
-        structured_matvec_pallas_v7)
+        structured_matvec_pallas_v7, structured_matvec_pallas_v8)
     for c in (8, 16):
         variants.append((f"pallas v5 C={c}", functools.partial(
             structured_matvec_pallas_v5, planes=c)))
@@ -98,6 +98,8 @@ def main():
         structured_matvec_pallas_v6, planes=8)))
     variants.append(("pallas v7 C=8", functools.partial(
         structured_matvec_pallas_v7, planes=8)))
+    variants.append(("pallas v8 C=8", functools.partial(
+        structured_matvec_pallas_v8, planes=8)))
     for name, fn in variants:
         try:
             t, y = timeit(fn, xg, blk["ck"][0], blk["Ke"])

@@ -160,9 +160,12 @@ def selected_variant():
         return "v5", _planes_env(structured_matvec_pallas_v5)
     if v == "7":
         return "v7", _planes_env(structured_matvec_pallas_v7)
-    if v != "6":
-        raise ValueError(f"PCG_TPU_PALLAS_V must be 1|2|3|4|5|6|7, got {v!r}")
-    return "v6", _planes_env(structured_matvec_pallas_v6)
+    if v == "6":
+        return "v6", _planes_env(structured_matvec_pallas_v6)
+    if v != "8":
+        raise ValueError(
+            f"PCG_TPU_PALLAS_V must be 1|2|3|4|5|6|7|8, got {v!r}")
+    return "v8", _planes_env(structured_matvec_pallas_v8)
 
 
 def probe_shapes(shapes, dtype=jnp.float32) -> None:
@@ -823,7 +826,8 @@ def _matvec_kernel_v6(ke_ref, x_hbm, ck_hbm, y_ref,
     ke_ref: (24, 24) VMEM
     x_hbm:  (3, g*cpp + 8, m128) ANY/HBM — lane- AND plane-padded on the
             host (see v6 header note); pad lanes/planes are zero, and
-            out-of-range corner reads only ever multiply ck = 0
+            out-of-range corner reads contribute nothing because the
+            OUTPUT block is scaled by ck = 0 there
     ck_hbm: (g*cpp, m128) ANY/HBM (zero-padded both axes)
     y_ref:  (3, cpp, m128) VMEM output block
     xv:     (2, 3, cpp+8, mt128) VMEM double-buffered slab; lanes
@@ -862,8 +866,14 @@ def _matvec_kernel_v6(ke_ref, x_hbm, ck_hbm, y_ref,
     def _prefetch():
         for_chunk(1 - slot, j + 1, "start")
 
-    # ---- compute: verbatim v5 (fresh per-corner dots, aligned pads,
-    # roll placement) — only the xb row count differs (cpp+8 vs cpp+1).
+    # ---- compute: v5's corner dots and roll placement, with ck HOISTED
+    # OUT of the contraction: ck[l] is per CELL (lane l), identical for
+    # all 24 gathered rows, so  sum_e Ke[d,e]*(ck*x_e) == ck*sum_e(...)
+    # — the output block is scaled ONCE instead of 24 input rows.  The
+    # 24 scaled input vectors were the kernel's Mosaic scoped-vmem hot
+    # spot: the unrolled plane loop's live arena overflowed VMEM at any
+    # m (chipless-compile bisection 2026-07-31); raw xb slices are views
+    # and cost nothing.
     ke = ke_ref[...]                                    # (24, 24)
     xb = xv[slot]                                       # (3, cpp+8, mt128)
     ckb = ckv[slot]                                     # (cpp, m128)
@@ -874,7 +884,7 @@ def _matvec_kernel_v6(ke_ref, x_hbm, ck_hbm, y_ref,
         for a, (dx, dy, dz) in enumerate(_CORNERS):
             off = dy * sy + dz
             for c in range(3):
-                rows.append(ck * xb[c, k + dx, off:off + m128])
+                rows.append(xb[c, k + dx, off:off + m128])
         u = jnp.stack(rows)                             # (24, m128)
         lo = jnp.zeros((3, mt128), u.dtype)
         hi = jnp.zeros((3, mt128), u.dtype)
@@ -883,6 +893,7 @@ def _matvec_kernel_v6(ke_ref, x_hbm, ck_hbm, y_ref,
             blk = jax.lax.dot_general(
                 ke[3 * b:3 * b + 3], u, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)     # (3, m128), {0,0}
+            blk = ck * blk                              # hoisted ck scale
             vp = jnp.pad(blk, ((0, 0), (0, mt128 - m128)))  # aligned concat
             if off:
                 vp = pltpu.roll(vp, off, 1)             # lane rotate
@@ -940,6 +951,11 @@ def structured_matvec_pallas_v6(xg, ck, Ke, *, interpret=False, planes=8):
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        # the unrolled plane loop's live arena exceeds the 16 MB default
+        # scoped limit at >=128^3 (chipless bisection 2026-07-31); v5e
+        # VMEM is far larger — raise the per-kernel cap
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(Ke, x_pad, ck_pad)
     return y[:, :nxn, :m].reshape(3, nxn, nyn, nzn)
@@ -1087,6 +1103,140 @@ def structured_matvec_pallas_v7(xg, ck, Ke, *, interpret=False, planes=8):
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        interpret=interpret,
+    )(Ke, x_pad, ck_pad)
+    return y[:, :nxn, :m].reshape(3, nxn, nyn, nzn)
+
+
+# ----------------------------------------------------------------------
+# v8: v6 with the plane loop GRID-IZED — grid (g, cpp), one cell plane
+# per step.
+#
+# The chipless-compile bisection (2026-07-31, tools/aot_compile_check.py)
+# pinned v6's RESOURCE_EXHAUSTED at >=128^3 to Mosaic's scoped-vmem
+# arena: the python-unrolled cpp-plane loop keeps every iteration's
+# temporaries live simultaneously (u alone is 24 x m128 x 4 B = 2.2 MB
+# at the flagship m — eight live copies blow the ~16 MB budget together
+# with the slab buffers).  Making the plane index a GRID dimension
+# bounds the arena to ONE plane's temporaries; the output block is
+# revisited across the cpp inner steps (index_map ignores the inner
+# dim — Mosaic keeps the block resident until j changes), rows are
+# written at the DYNAMIC sublane index kk and read at dynamic kk+dx —
+# both verified to lower on the v5e toolchain by the chipless probes.
+# Everything else (slab DMA, i32 indices, ck hoisted out of the
+# contraction, roll placement) is v6's.
+# ----------------------------------------------------------------------
+
+
+def _matvec_kernel_v8(ke_ref, x_hbm, ck_hbm, y_ref,
+                      xv, ckv, acc, sem, ck_sem,
+                      *, g, cpp, m128, mt128, sy):
+    """One grid step = ONE cell plane; cpp steps finish an output block.
+
+    Shapes as _matvec_kernel_v6 except the slab is SINGLE-buffered
+    ((3, cpp+8, mt128), no prefetch): the saved 4.4 MB keeps the scoped
+    request inside VMEM at flagship m, and removing the dynamic ``slot``
+    index leaves the row reads with ONE dynamic index (kk+dx) — Mosaic
+    rejects dynamic loads with two ("dynamic load with unaligned
+    indices", chipless probe 2026-07-31).  The lost copy/compute overlap
+    is one slab DMA (~5 us at flagship) per cpp planes of compute.
+    ``acc`` carries dx=1 partials from every plane to the next."""
+    j = jnp.asarray(pl.program_id(0), jnp.int32)   # chunk
+    kk = jnp.asarray(pl.program_id(1), jnp.int32)  # plane within chunk
+
+    def for_chunk(chunk, act):
+        c0 = jnp.asarray(chunk * cpp, jnp.int32)
+        z = jnp.asarray(0, jnp.int32)
+        getattr(pltpu.make_async_copy(
+            x_hbm.at[:, pl.ds(c0, cpp + 8), :],
+            xv.at[:, :, pl.ds(z, m128)], sem), act)()
+        getattr(pltpu.make_async_copy(
+            ck_hbm.at[pl.ds(c0, cpp)],
+            ckv, ck_sem), act)()
+
+    @pl.when((j == 0) & (kk == 0))
+    def _init():
+        xv[...] = jnp.zeros_like(xv)       # zero overhang tails once
+        acc[...] = jnp.zeros_like(acc)
+
+    @pl.when(kk == 0)
+    def _arrive():
+        for_chunk(j, "start")
+        for_chunk(j, "wait")
+
+    ke = ke_ref[...]                                    # (24, 24)
+    ck = ckv[kk]                                        # (m128,)
+    rows = []
+    for a, (dx, dy, dz) in enumerate(_CORNERS):
+        off = dy * sy + dz
+        for c in range(3):
+            rows.append(xv[c, kk + dx, off:off + m128])
+    u = jnp.stack(rows)                                 # (24, m128)
+    lo = jnp.zeros((3, mt128), u.dtype)
+    hi = jnp.zeros((3, mt128), u.dtype)
+    for b, (dx, dy, dz) in enumerate(_CORNERS):
+        off = dy * sy + dz
+        blk = jax.lax.dot_general(
+            ke[3 * b:3 * b + 3], u, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (3, m128), {0,0}
+        blk = ck * blk                                  # hoisted ck scale
+        vp = jnp.pad(blk, ((0, 0), (0, mt128 - m128)))  # aligned concat
+        if off:
+            vp = pltpu.roll(vp, off, 1)                 # lane rotate
+        if dx == 0:
+            lo = lo + vp
+        else:
+            hi = hi + vp
+    out = acc[...] + lo
+    for c in range(3):
+        y_ref[c, kk] = out[c, :m128]
+    acc[...] = hi
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "planes"))
+def structured_matvec_pallas_v8(xg, ck, Ke, *, interpret=False, planes=8):
+    """Plane-per-grid-step variant of :func:`structured_matvec_pallas_v6`.
+
+    Same signature/semantics: xg (3, nx+1, ny+1, nz+1), ck (nx, ny, nz),
+    Ke (24, 24), all f32; ``planes`` = cell planes per output block
+    (multiple of 8 — the output block's sublane axis)."""
+    _, nxn, nyn, nzn = xg.shape
+    nx = nxn - 1
+    m = nyn * nzn
+    m128 = -(-m // 128) * 128
+    sy = nzn
+    mt128 = m128 + (-(-(sy + 2) // 128)) * 128
+    cpp = max(1, min(planes, ((nx + 1 + 7) // 8) * 8))
+    g = -(-(nx + 1) // cpp)                 # ceil: covers all output planes
+    x_flat = xg.reshape(3, nxn, m)          # free reshape, no copy
+    x_pad = jnp.pad(x_flat, ((0, 0), (0, g * cpp + 8 - nxn), (0, m128 - m)))
+    ck_pad = jnp.pad(ck, ((0, g * cpp - nx), (0, 1), (0, 1))) \
+        .reshape(g * cpp, m)
+    ck_pad = jnp.pad(ck_pad, ((0, 0), (0, m128 - m)))
+    kernel = functools.partial(_matvec_kernel_v8, g=g, cpp=cpp,
+                               m128=m128, mt128=mt128, sy=sy)
+    y = pl.pallas_call(
+        kernel,
+        grid=(g, cpp),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # Ke
+            pl.BlockSpec(memory_space=pl.ANY),         # x (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),         # ck (manual DMA)
+        ],
+        out_specs=pl.BlockSpec((3, cpp, m128), lambda j, k: (0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, g * cpp, m128), xg.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((3, cpp + 8, mt128), xg.dtype),
+            pltpu.VMEM((cpp, m128), ck.dtype),
+            pltpu.VMEM((3, mt128), xg.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        # the scoped request at flagship m is 16.54 MB against the 16 MB
+        # default limit (chipless compile 2026-07-31); v5e VMEM is far
+        # larger — raise the per-kernel cap instead of shaving buffers
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(Ke, x_pad, ck_pad)
     return y[:, :nxn, :m].reshape(3, nxn, nyn, nzn)

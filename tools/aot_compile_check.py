@@ -1,0 +1,176 @@
+"""CHIPLESS TPU compile checks: run the full Mosaic/XLA v5e compile
+locally, no tunnel, no device.
+
+``jax.experimental.topologies`` + the locally installed libtpu give the
+exact compile pipeline the remote terminal uses ("TpuAotCompiler
+(chipless)" in its logs) — so Pallas lowering rejections and XLA
+buffer-assignment failures that previously burned hardware-session steps
+reproduce here in seconds.  Discovered 2026-07-31 after five kernel
+variants each died at their first Mosaic-unproven op ON HARDWARE.
+
+Usage:
+    python tools/aot_compile_check.py kernel [--variants 6,7] [--nx 150]
+    python tools/aot_compile_check.py f64matvec [--nx 150]
+
+``kernel``    — Pallas matvec variants at small + given shape.
+``f64matvec`` — the XLA chunked f64 matvec at the given shape (the
+                remote-compile failure mode of the f64-direct anchor).
+``pcg``       — the FULL f64 PCG while_loop program at the given shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _topo_sharding():
+    import numpy as np
+    import jax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+    mesh = Mesh(np.array(topo.devices)[:1], ("x",))
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def _compile(fn, shapes_dtypes, sharding, label):
+    import jax
+
+    args = [jax.ShapeDtypeStruct(s, d, sharding=sharding)
+            for s, d in shapes_dtypes]
+    t0 = time.perf_counter()
+    try:
+        jax.jit(fn).lower(*args).compile()
+    except Exception as e:                              # noqa: BLE001
+        msg = " ".join(str(e).split())[:400]
+        print(f"{label}: FAIL {type(e).__name__}: {msg}", flush=True)
+        return False
+    print(f"{label}: OK ({time.perf_counter()-t0:.1f}s)", flush=True)
+    return True
+
+
+def check_kernel(args):
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.ops import pallas_matvec as pm
+
+    s = _topo_sharding()
+    nx = args.nx
+    ok = True
+    for v in [int(x) for x in args.variants.split(",")]:
+        fn = getattr(pm, "structured_matvec_pallas_v%d" % v
+                     if v > 1 else "structured_matvec_pallas")
+        for dims in [(8, 6, 5), (nx, nx, nx)]:
+            nxn = tuple(d + 1 for d in dims)
+            ok &= _compile(
+                lambda xg, ck, Ke, f=fn: f(xg, ck, Ke),
+                [((3,) + nxn, jnp.float32),
+                 (dims, jnp.float32), ((24, 24), jnp.float32)],
+                s, f"v{v} {dims}")
+    return ok
+
+
+def check_f64matvec(args):
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.models import make_cube_model
+    from pcg_mpi_solver_tpu.parallel.structured import (
+        StructuredOps, partition_structured)
+
+    s = _topo_sharding()
+    n = args.nx
+    # tiny model just to build ops with the right dims; the compile input
+    # shapes are what matter, and they depend only on (nx, ny, nz)
+    model = make_cube_model(4, 4, 4)
+    sp = partition_structured(model, 1)
+    import dataclasses
+
+    ops = dataclasses.replace(
+        StructuredOps.from_partition(sp, dot_dtype=jnp.float64),
+        nxc=n, ny=n, nz=n)
+    nn = n + 1
+
+    def fn(xg_flat, ck, Ke, diag_ke):
+        data = {"blocks": [{"ck": ck, "Ke": Ke, "diag_Ke": diag_ke}]}
+        return ops.matvec_local(data, xg_flat)
+
+    return _compile(
+        fn,
+        [((1, 3 * nn * nn * nn), jnp.float64),
+         ((1, n, n, n), jnp.float64), ((24, 24), jnp.float64),
+         ((24,), jnp.float64)],
+        s, f"f64 chunked matvec {n}^3")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("what", choices=["kernel", "f64matvec", "pcg"])
+    ap.add_argument("--variants", default="6,7")
+    ap.add_argument("--nx", type=int, default=150)
+    ap.add_argument("--dtype", default="float64",
+                    help="f64matvec/pcg input dtype")
+    args = ap.parse_args()
+    # never touch the real backend: the topology API needs no client, and
+    # an accidental device touch would hang on a wedged tunnel
+    os.environ.pop("JAX_PLATFORMS", None)
+    if args.what in ("f64matvec", "pcg"):
+        # without x64, the float64 ShapeDtypeStructs canonicalize to f32
+        # and the chunked-path gate (dtype == float64) never engages —
+        # the check would silently validate a different program
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    ok = {"kernel": check_kernel, "f64matvec": check_f64matvec,
+          "pcg": check_pcg}[args.what](args)
+    sys.exit(0 if ok else 1)
+
+
+
+
+def check_pcg(args):
+    """Compile the FULL f64 PCG while_loop program (matvec + fused dots +
+    preconditioner + convergence control) at the given size — the actual
+    program whose REMOTE compile failed UNAVAILABLE at 150^3/128^3 f64
+    in waves 2-3."""
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.models import make_cube_model
+    from pcg_mpi_solver_tpu.parallel.structured import (
+        StructuredOps, partition_structured)
+    from pcg_mpi_solver_tpu.solver.pcg import pcg
+
+    s = _topo_sharding()
+    n = args.nx
+    dt = jnp.dtype(args.dtype)
+    model = make_cube_model(4, 4, 4)
+    sp = partition_structured(model, 1)
+    import dataclasses
+
+    ops = dataclasses.replace(
+        StructuredOps.from_partition(sp, dot_dtype=jnp.float64),
+        nxc=n, ny=n, nz=n)
+    nn = n + 1
+    n_loc = 3 * nn * nn * nn
+
+    def fn(x, ck, Ke, diag_ke, eff, weight, fext, inv_diag):
+        data = {"blocks": [{"ck": ck, "Ke": Ke, "diag_Ke": diag_ke}],
+                "eff": eff, "weight": weight}
+        r = pcg(ops, data, fext=fext, x0=x, inv_diag=inv_diag,
+                tol=1e-7, max_iter=2000, glob_n_dof_eff=n_loc)
+        return r.x, r.flag, r.relres, r.iters
+
+    shapes = [((1, n_loc), dt), ((1, n, n, n), dt), ((24, 24), dt),
+              ((24,), dt), ((1, n_loc), dt), ((1, n_loc), dt),
+              ((1, n_loc), dt), ((1, n_loc), dt)]
+    return _compile(fn, shapes, s, f"f64 PCG program {n}^3")
+
+
+if __name__ == "__main__":
+    main()
