@@ -123,7 +123,12 @@ def batched_structured_matvec(xg, ck, Ke, interpret=False):
 def _planes_env(fn):
     """Wrap a chunked variant so it reads its chunk size from
     PCG_TPU_PALLAS_PLANES (default 8 — the smallest Mosaic-legal
-    block)."""
+    block), and trace it with x64 DISABLED: Pallas canonicalizes
+    dynamic slice starts to the default int dtype, so under jax x64
+    every dynamic memref_slice carries i64 indices — which Mosaic
+    rejects — no matter what dtype the kernel passes (chipless x64
+    check 2026-07-31).  The kernels are f32-only, so 32-bit tracing
+    inside is always correct."""
 
     def wrapped(xg, ck, Ke, *, interpret=False):
         import os
@@ -135,7 +140,8 @@ def _planes_env(fn):
             raise ValueError(
                 f"PCG_TPU_PALLAS_PLANES must be a multiple of 8, "
                 f"got {planes}")
-        return fn(xg, ck, Ke, interpret=interpret, planes=planes)
+        with jax.enable_x64(False):
+            return fn(xg, ck, Ke, interpret=interpret, planes=planes)
 
     return wrapped
 
@@ -839,11 +845,12 @@ def _matvec_kernel_v6(ke_ref, x_hbm, ck_hbm, y_ref,
     j = jnp.asarray(pl.program_id(0), jnp.int32)  # i32 ALWAYS (see v4)
 
     def for_chunk(slot, chunk, act):
-        # i32 ALWAYS — including literal zeros: under jax x64 a python-int
-        # index traces as i64, and index PROMOTION then lifts every other
-        # index in the same memref_slice to i64, which Mosaic rejects
-        # ("operand #1 must be variadic of 32-bit signless integer" —
-        # observed on-HW 2026-07-31 from the flagship's v5 probe)
+        # NOTE on index dtypes: Pallas canonicalizes indices to the
+        # DEFAULT int dtype, so under jax x64 every dynamic memref_slice
+        # would carry i64 indices — which Mosaic rejects — regardless of
+        # what dtype is passed here.  The fix is structural: _planes_env
+        # traces every kernel under jax.enable_x64(False) (verified
+        # sufficient by the chipless x64 checks, 2026-07-31).
         c0 = jnp.asarray(chunk * cpp, jnp.int32)
         z = jnp.asarray(0, jnp.int32)
         getattr(pltpu.make_async_copy(
@@ -1145,6 +1152,8 @@ def _matvec_kernel_v8(ke_ref, x_hbm, ck_hbm, y_ref,
     kk = jnp.asarray(pl.program_id(1), jnp.int32)  # plane within chunk
 
     def for_chunk(chunk, act):
+        # index dtypes: see _matvec_kernel_v6.for_chunk (the x64 story
+        # is handled structurally by _planes_env's enable_x64(False))
         c0 = jnp.asarray(chunk * cpp, jnp.int32)
         z = jnp.asarray(0, jnp.int32)
         getattr(pltpu.make_async_copy(

@@ -116,7 +116,14 @@ def main():
     ap.add_argument("--nx", type=int, default=150)
     ap.add_argument("--dtype", default="float64",
                     help="f64matvec/pcg input dtype")
+    ap.add_argument("--pallas", default="off", choices=["off", "on"],
+                    help="pcg mode: engage the fused Pallas matvec")
     args = ap.parse_args()
+    if args.what == "pcg" and args.pallas == "on" \
+            and args.dtype != "float32":
+        # the pallas dispatch is f32-gated (structured.matvec_local);
+        # with f64 inputs the flag would silently validate the XLA path
+        ap.error("--pallas on requires --dtype float32")
     # never touch the real backend: the topology API needs no client, and
     # an accidental device touch would hang on a wedged tunnel
     os.environ.pop("JAX_PLATFORMS", None)
@@ -135,10 +142,11 @@ def main():
 
 
 def check_pcg(args):
-    """Compile the FULL f64 PCG while_loop program (matvec + fused dots +
+    """Compile the FULL PCG while_loop program (matvec + fused dots +
     preconditioner + convergence control) at the given size — the actual
     program whose REMOTE compile failed UNAVAILABLE at 150^3/128^3 f64
-    in waves 2-3."""
+    in waves 2-3.  With --dtype float32 --pallas on this is the HEADLINE
+    mixed-mode inner program with the fused v6 kernel engaged."""
     import jax.numpy as jnp
 
     from pcg_mpi_solver_tpu.models import make_cube_model
@@ -154,7 +162,8 @@ def check_pcg(args):
     import dataclasses
 
     ops = dataclasses.replace(
-        StructuredOps.from_partition(sp, dot_dtype=jnp.float64),
+        StructuredOps.from_partition(sp, dot_dtype=jnp.float64,
+                                     use_pallas=args.pallas == "on"),
         nxc=n, ny=n, nz=n)
     nn = n + 1
     n_loc = 3 * nn * nn * nn
@@ -169,7 +178,9 @@ def check_pcg(args):
     shapes = [((1, n_loc), dt), ((1, n, n, n), dt), ((24, 24), dt),
               ((24,), dt), ((1, n_loc), dt), ((1, n_loc), dt),
               ((1, n_loc), dt), ((1, n_loc), dt)]
-    return _compile(fn, shapes, s, f"f64 PCG program {n}^3")
+    label = (f"{args.dtype} PCG program"
+             + (" +pallas" if args.pallas == "on" else "") + f" {n}^3")
+    return _compile(fn, shapes, s, label)
 
 
 if __name__ == "__main__":
