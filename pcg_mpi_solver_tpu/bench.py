@@ -490,6 +490,15 @@ def main():
     # Dispatch breadcrumbs on by default: a wedged remote compile/execute
     # must be localizable from the driver's captured stderr.
     os.environ.setdefault("PCG_TPU_VERBOSE", "1")
+    # Persistent compilation cache: flagship programs compile in minutes
+    # (hybrid octree ~20 min, chipless-measured 2026-07-31) — a retry
+    # after a mid-solve tunnel drop must not pay the remote compile
+    # again.  jax binds the env var at import time, which has already
+    # happened — apply via config.update (authoritative either way).
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache_dir = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                                      os.path.join(repo, ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
     kind = os.environ.get("BENCH_MODEL", "cube")   # cube | octree
     tol = float(os.environ.get("BENCH_TOL", 1e-7))
     mode = os.environ.get("BENCH_MODE", "mixed")   # mixed | direct

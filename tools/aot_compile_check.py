@@ -40,11 +40,10 @@ def _topo_sharding():
     return NamedSharding(mesh, PartitionSpec())
 
 
-def _compile(fn, shapes_dtypes, sharding, label):
+def _compile_structs(fn, args, label):
+    """Lower+compile against prebuilt ShapeDtypeStruct pytrees."""
     import jax
 
-    args = [jax.ShapeDtypeStruct(s, d, sharding=sharding)
-            for s, d in shapes_dtypes]
     t0 = time.perf_counter()
     try:
         jax.jit(fn).lower(*args).compile()
@@ -54,6 +53,14 @@ def _compile(fn, shapes_dtypes, sharding, label):
         return False
     print(f"{label}: OK ({time.perf_counter()-t0:.1f}s)", flush=True)
     return True
+
+
+def _compile(fn, shapes_dtypes, sharding, label):
+    import jax
+
+    return _compile_structs(
+        fn, [jax.ShapeDtypeStruct(s, d, sharding=sharding)
+             for s, d in shapes_dtypes], label)
 
 
 def check_kernel(args):
@@ -111,23 +118,27 @@ def check_f64matvec(args):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("what", choices=["kernel", "f64matvec", "pcg"])
+    ap.add_argument("what", choices=["kernel", "f64matvec", "pcg", "hybridpcg"])
     ap.add_argument("--variants", default="6,7")
-    ap.add_argument("--nx", type=int, default=150)
+    ap.add_argument("--nx", type=int, default=None,
+                    help="cells per edge (default: 150; hybridpcg: 22 "
+                         "octree base cells)")
     ap.add_argument("--dtype", default="float64",
                     help="f64matvec/pcg input dtype")
     ap.add_argument("--pallas", default="off", choices=["off", "on"],
                     help="pcg mode: engage the fused Pallas matvec")
     args = ap.parse_args()
-    if args.what == "pcg" and args.pallas == "on" \
+    if args.what in ("pcg",) and args.pallas == "on" \
             and args.dtype != "float32":
         # the pallas dispatch is f32-gated (structured.matvec_local);
         # with f64 inputs the flag would silently validate the XLA path
         ap.error("--pallas on requires --dtype float32")
+    if args.nx is None and args.what != "hybridpcg":
+        args.nx = 150
     # never touch the real backend: the topology API needs no client, and
     # an accidental device touch would hang on a wedged tunnel
     os.environ.pop("JAX_PLATFORMS", None)
-    if args.what in ("f64matvec", "pcg"):
+    if args.what in ("f64matvec", "pcg", "hybridpcg"):
         # without x64, the float64 ShapeDtypeStructs canonicalize to f32
         # and the chunked-path gate (dtype == float64) never engages —
         # the check would silently validate a different program
@@ -135,7 +146,7 @@ def main():
 
         jax.config.update("jax_enable_x64", True)
     ok = {"kernel": check_kernel, "f64matvec": check_f64matvec,
-          "pcg": check_pcg}[args.what](args)
+          "pcg": check_pcg, "hybridpcg": check_hybridpcg}[args.what](args)
     sys.exit(0 if ok else 1)
 
 
@@ -181,6 +192,56 @@ def check_pcg(args):
     label = (f"{args.dtype} PCG program"
              + (" +pallas" if args.pallas == "on" else "") + f" {n}^3")
     return _compile(fn, shapes, s, label)
+
+
+
+
+def check_hybridpcg(args):
+    """Compile the hybrid (octree) f32 PCG program at a REAL graded-octree
+    flagship partition — the program whose REMOTE compile failed
+    UNAVAILABLE in wave 1 (then under the scatter combine; the gather
+    combine is now default).  Builds the real partition (cached model),
+    converts the device-data pytree to ShapeDtypeStructs, compiles
+    chiplessly."""
+    import jax
+    import jax.numpy as jnp
+
+    # topology FIRST (needs the tpu plugin visible), THEN pin the CPU
+    # backend so the numpy->jnp conversions below cannot touch the
+    # tunnel; lowering uses the topology shardings only
+    s = _topo_sharding()
+    jax.config.update("jax_platforms", "cpu")
+
+    from pcg_mpi_solver_tpu.bench import cached_model
+    from pcg_mpi_solver_tpu.parallel.hybrid import (
+        HybridOps, device_data_hybrid, partition_hybrid)
+    from pcg_mpi_solver_tpu.solver.pcg import pcg
+
+    n0 = args.nx if args.nx is not None else 22   # flagship octree
+    model = cached_model("octree", nx0=n0, ny0=n0, nz0=n0,
+                         max_level=4, n_incl=6, seed=2, E=30e9, nu=0.2,
+                         load="traction", load_value=1e6)
+    t0 = time.perf_counter()
+    hp = partition_hybrid(model, 1)
+    ops = HybridOps.from_hybrid(hp, dot_dtype=jnp.float64,
+                                use_pallas=args.pallas == "on")
+    data = device_data_hybrid(hp, jnp.float32)
+    print(f"# octree {model.n_dof} dofs, {len(hp.levels)} levels "
+          f"(partition {time.perf_counter()-t0:.0f}s)", flush=True)
+
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s), data)
+    n_loc = ops.n_loc
+
+    def fn(data, fext, x0, inv_diag):
+        r = pcg(ops, data, fext=fext, x0=x0, inv_diag=inv_diag,
+                tol=1e-7, max_iter=2000, glob_n_dof_eff=n_loc)
+        return r.x, r.flag, r.relres, r.iters
+
+    vec = jax.ShapeDtypeStruct((1, n_loc), jnp.float32, sharding=s)
+    label = (f"hybrid f32 PCG octree {n0}^3/L4"
+             + (" +pallas" if args.pallas == "on" else ""))
+    return _compile_structs(fn, [structs, vec, vec, vec], label)
 
 
 if __name__ == "__main__":

@@ -69,6 +69,9 @@ def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
                        f"({detail}); launching step regardless")
     env = dict(os.environ)
     env.setdefault("PCG_TPU_VERBOSE", "1")
+    # persistent compile cache shared across steps/waves (see bench.py)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
     # examples/*.py run with sys.path[0]=examples/, and the package is
     # not pip-installed — the repo root must come from PYTHONPATH
     env["PYTHONPATH"] = REPO + (
