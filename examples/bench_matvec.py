@@ -100,6 +100,19 @@ def main():
         structured_matvec_pallas_v7, planes=8)))
     variants.append(("pallas v8 C=8", functools.partial(
         structured_matvec_pallas_v8, planes=8)))
+    # BENCH_MATVEC_VARIANTS="v6,v8" runs only those Pallas variants: on
+    # hardware every known-failing variant burns a failed REMOTE compile
+    # that can wedge the device grant for minutes (docs/RUNBOOK.md) —
+    # v1-v5/v7 are chipless-pinned failures at flagship scale, so
+    # sessions should skip straight to the candidates.
+    import os
+
+    only = [v for v in os.environ.get("BENCH_MATVEC_VARIANTS", "").split(",")
+            if v]
+    if only:
+        variants = [(n, f) for n, f in variants
+                    if any(f"pallas {v} " in n + " " or n.endswith(v)
+                           for v in only)]
     for name, fn in variants:
         try:
             t, y = timeit(fn, xg, blk["ck"][0], blk["Ke"])

@@ -211,7 +211,7 @@ def read_mdf(mdf_path: str) -> ModelData:
             for i in range(len(adj))
         ]
 
-    return ModelData(
+    md = ModelData(
         n_elem=n_elem, n_node=n_node, n_dof=n_dof,
         node_coords=node_coords, F=F, Ud=Ud, Vd=Vd, diag_M=diag_m,
         fixed_dof=fixed_dof, dof_eff=dof_eff,
@@ -225,6 +225,18 @@ def read_mdf(mdf_path: str) -> ModelData:
         grid=grid, octree=octree,
         intfc_elems=intfc_elems,
     )
+    # grid-only bundles skip the rebuild: backend selection picks
+    # 'structured' anyway, so the multi-pass geometry scan buys nothing
+    if (octree is None and grid is None
+            and os.environ.get("PCG_TPU_RECONSTRUCT", "1") == "1"):
+        # A GENUINE reference bundle has no fast-path sidecars (they are
+        # our schema extension); rebuild the octree-lattice metadata from
+        # the schema's own geometry so it routes to the hybrid backend
+        # (reconstruct_lattice_meta engages only on exact lattice fits).
+        from pcg_mpi_solver_tpu.models.octree import reconstruct_lattice_meta
+
+        reconstruct_lattice_meta(md)
+    return md
 
 
 def write_mdf(model: ModelData, mdf_path: str) -> str:

@@ -150,7 +150,14 @@ def selected_variant():
     """(name, fn) of the kernel variant the PCG_TPU_PALLAS_V env knob
     selects — the single source of truth for dispatch AND probing.  Read
     at trace time: toggling the knob after a solver compiled does not
-    retrace (build a new Solver to switch)."""
+    retrace (build a new Solver to switch).
+
+    PROVISIONAL DEFAULT: v6 is chipless-compile-verified at the 150^3
+    flagship and interpret-parity-tested, but has no hardware-measured
+    run yet (tunnel down from 04:21Z through end of round 3).  Under
+    pallas='auto' the shape probe still guards lowering; under
+    pallas='on' users get the unmeasured kernel directly.  Revisit after
+    the on-hardware v6/v8 A/B (docs/RUNBOOK.md knob table)."""
     import os
 
     v = os.environ.get("PCG_TPU_PALLAS_V", "6")

@@ -178,7 +178,13 @@ def partition_hybrid(model: ModelData, n_parts: int,
     meta = model.octree
     bt = meta["brick_type"]
     leaves = np.asarray(meta["leaves"])
-    node_keys = np.asarray(meta["node_keys"])
+    # node_keys[i] = lattice key of node id i.  Generator-built models
+    # number nodes in sorted-key order, but RECONSTRUCTED metadata
+    # (models/octree.py reconstruct_lattice_meta) follows the bundle's
+    # own numbering — sort once and keep the id permutation.
+    raw_keys = np.asarray(meta["node_keys"])
+    key_order = np.argsort(raw_keys)
+    node_keys = raw_keys[key_order]
     sy, sz = meta["strides"]
     corners = np.asarray(meta["brick_corners"], dtype=np.int64)   # (8, 3)
     if not np.array_equal(corners, _CORNERS):
@@ -267,7 +273,8 @@ def partition_hybrid(model: ModelData, n_parts: int,
             kpos = np.searchsorted(node_keys, keys)
             kpos_c = np.minimum(kpos, len(node_keys) - 1)
             is_node = node_keys[kpos_c] == keys
-            gnid = np.where(is_node, kpos_c, -1)       # global node id or -1
+            # global node id or -1 (key_order maps sorted pos -> node id)
+            gnid = np.where(is_node, key_order[kpos_c], -1)
             loc_gids = pm.node_gid[p, : pm.nnode_p[p]]  # sorted
             lpos = np.searchsorted(loc_gids, np.where(gnid < 0, 0, gnid))
             lpos_c = np.minimum(lpos, len(loc_gids) - 1)

@@ -84,7 +84,10 @@ class ChunkedEngine:
                     max_stag_steps=scfg.max_stag_steps,
                     max_iter_nominal=scfg.max_iter,
                     carry_in=carry32, return_carry=True,
-                    plateau_window=scfg.mixed_plateau_window)
+                    plateau_window=scfg.mixed_plateau_window,
+                    progress_window=scfg.mixed_progress_window,
+                    progress_ratio=scfg.mixed_progress_ratio,
+                    progress_min_gain=scfg.mixed_progress_min_gain)
                 return res.x, carry2, res.flag
 
             self._inner_cycle_fn = smap(

@@ -292,6 +292,9 @@ class Solver:
                     max_stag_steps=solver_cfg.max_stag_steps,
                     inner_tol=solver_cfg.inner_tol,
                     plateau_window=solver_cfg.mixed_plateau_window,
+                    progress_window=solver_cfg.mixed_progress_window,
+                    progress_ratio=solver_cfg.mixed_progress_ratio,
+                    progress_min_gain=solver_cfg.mixed_progress_min_gain,
                 )
             else:
                 # preconditioner rebuild (pcg_solver.py:346-352)

@@ -48,6 +48,7 @@ def cold_carry(x0, r0, normr0, dot_dtype) -> dict:
         stag=zero_i, moresteps=zero_i,
         normrmin=jnp.asarray(normr0, dd), xmin=x0, imin=zero_i,
         since_best=zero_i, best_at_reset=jnp.asarray(normr0, dd),
+        win_start=jnp.asarray(normr0, dd), win_count=zero_i,
         normr_act=jnp.asarray(normr0, dd), exec=zero_i)
 
 
@@ -57,6 +58,7 @@ def carry_part_specs(part_spec, rep_spec) -> dict:
     P, R = part_spec, rep_spec
     return dict(x=P, r=P, p=P, rho=R, stag=R, moresteps=R,
                 normrmin=R, xmin=P, imin=R, since_best=R, best_at_reset=R,
+                win_start=R, win_count=R,
                 normr_act=R, exec=R)
 
 
@@ -105,8 +107,35 @@ def pcg(
     carry_in: Optional[dict] = None,
     return_carry: bool = False,
     plateau_window: int = 0,
+    x0_zero: bool = False,
+    progress_window: int = 0,
+    progress_ratio: float = 0.7,
+    progress_min_gain: float = 30.0,
 ):
     """Returns PCGResult, or (PCGResult, carry) with ``return_carry``.
+
+    ``progress_window`` > 0 adds a progress-RATE exit for mixed-mode inner
+    cycles (flag 3, min-residual iterate — the refinement driver restarts
+    in f64): every ``progress_window`` iterations the MONOTONE minimal
+    residual ``normrmin`` is compared against its value a window ago; if
+    the window contracted it by less than 1/``progress_ratio`` AND the
+    cycle has already contracted the rhs norm by ``progress_min_gain``
+    (i.e. the cheap early phase is long over and the iterate is plausibly
+    near its f32 floor), the remaining grind is worth less than one f64
+    restart.  The min-gain gate is what the plateau knob lacked: CG's
+    residual is non-monotone and plateaus pre-asymptotically, so a bare
+    no-improvement window false-triggers at small scale
+    (docs/BENCH_LOG.md 2026-07-31: window 30 DIVERGED at iter 31/255);
+    requiring 30x achieved contraction first makes early plateaus
+    unreachable.  Keep OFF (0) for direct/f64 solves — the reference's
+    iteration-parity contract has no such exit.
+
+    ``x0_zero`` declares (statically) that ``x0`` is all zeros, eliding the
+    initial-residual matvec: r0 = fext - A.0 = fext exactly, and
+    ||r0|| = ||fext|| = n2b (the same reduction).  One fewer stencil
+    instantiation in the compiled program — the hybrid octree stencil
+    costs minutes of compile time PER INSTANTIATION (docs/BENCH_LOG.md
+    2026-07-31) — and one fewer matvec execution at runtime.
 
     ``plateau_window`` > 0 adds a plateau exit beyond MATLAB pcg's
     stagnation test: if no meaningfully (0.1%) better minimal residual
@@ -145,6 +174,9 @@ def pcg(
         x0 = carry_in["x"]
         r0 = carry_in["r"]
         normr0 = carry_in["normr_act"].astype(ops.dot_dtype)
+    elif x0_zero:
+        r0 = fext
+        normr0 = n2b
     else:
         r0 = fext - amul(x0)
         normr0 = jnp.sqrt(ops.wdot(w, r0, r0))
@@ -172,121 +204,194 @@ def pcg(
                     else jnp.asarray(0, jnp.int32)),
         best_at_reset=(carry_in["best_at_reset"] if warm
                        else normr0.astype(ops.dot_dtype)),
+        win_start=(carry_in["win_start"] if warm
+                   else normr0.astype(ops.dot_dtype)),
+        win_count=(carry_in["win_count"] if warm
+                   else jnp.asarray(0, jnp.int32)),
+        # mode 1 = the NEXT trip performs the deferred true-residual check
+        # of the iteration committed this trip (see body); always 0 at loop
+        # exit, so it never rides the exported resume carry
+        mode=jnp.asarray(0, jnp.int32),
     )
 
     def cond(c):
         return (c["flag"] == 1) & (c["i"] < max_iter)
 
-    def body(c):
-        i = c["i"]
-        # scalar Jacobi inverse (P, n_loc) or block-Jacobi inverse
-        # (P, n_node_loc, 3, 3) — ops.apply_prec dispatches on rank
-        z = ops.apply_prec(inv_diag, c["r"])
+    def _resolve(c, x, r, p, rho, stag, normr_act, candidate, i):
+        """Shared iteration epilogue (reference pcg_solver.py:536-562):
+        stag reset / MoreSteps / min-residual / plateau bookkeeping and
+        the flag decision, with ``candidate`` marking a true-residual
+        check (then ``normr_act`` is the recomputed actual residual
+        norm, else the recurrence norm)."""
+        converged = candidate & (normr_act <= tolb)
+        # not converged on candidate: stag reset + MoreSteps bookkeeping
+        # (reference pcg_solver.py:544-552)
+        stag = jnp.where(candidate & ~converged
+                         & (stag >= max_stag_steps) & (c["moresteps"] == 0),
+                         0, stag).astype(jnp.int32)
+        moresteps = jnp.where(candidate & ~converged,
+                              c["moresteps"] + 1,
+                              c["moresteps"]).astype(jnp.int32)
+        toosmall = candidate & ~converged & (moresteps >= maxmsteps)
 
-        # The inf-preconditioner predicate must agree across shards or the
-        # while_loop exits divergently and collective counts desync; fuse its
-        # global reduction into the rho psum (still one collective).
-        inf_loc = jnp.any(jnp.isinf(z)).astype(ops.dot_dtype)
-        red = ops.wdots(w, [(z, c["r"])], extra=[inf_loc])
-        rho, flag2 = red[0], red[1] > 0
-        bad_rho = (rho == 0) | jnp.isinf(rho)
+        # minimal-residual iterate bookkeeping (pcg_solver.py:554-558)
+        better = normr_act < c["normrmin"]
+        normrmin = jnp.where(better, normr_act, c["normrmin"])
+        xmin = jnp.where(better, x, c["xmin"])
+        imin = jnp.where(better, i, c["imin"])
+        # the plateau counter demands a MEANINGFUL (0.1%) improvement
+        # since the LAST RESET (a snapshot, not the ratcheting
+        # normrmin: steady sub-0.1%-per-iteration convergence must
+        # accumulate against the snapshot and keep resetting, while
+        # hair-thin dips at the f32 floor must not)
+        improved = normr_act < c["best_at_reset"] * (1 - 1e-3)
+        since_best = jnp.where(improved, 0,
+                               c["since_best"] + 1).astype(jnp.int32)
+        best_at_reset = jnp.where(improved, normr_act,
+                                  c["best_at_reset"])
 
-        beta = (rho / c["rho"]).astype(dt)
-        if warm:
-            # Resumed iteration: the beta/p recurrence continues from the
-            # previous call's direction on the very first pass.
-            bad_beta = (beta == 0) | jnp.isinf(beta)
-            p = z + beta * c["p"]
+        stagnated = (stag >= max_stag_steps) & ~converged & ~toosmall
+        plateaued = ((since_best > plateau_window) & ~converged
+                     & ~toosmall if plateau_window else jnp.asarray(False))
+
+        if progress_window:
+            # progress-rate exit (see docstring): evaluated on the
+            # MONOTONE normrmin each time a full window elapses
+            win_count = c["win_count"] + 1
+            at_window = win_count >= progress_window
+            weak_window = normrmin > jnp.asarray(
+                progress_ratio, normrmin.dtype) * c["win_start"]
+            deep_enough = normrmin * jnp.asarray(
+                progress_min_gain, normrmin.dtype) < n2b
+            no_progress = (at_window & weak_window & deep_enough
+                           & ~converged & ~toosmall)
+            # window rolls over when it elapses without tripping
+            win_start = jnp.where(at_window, normrmin, c["win_start"])
+            win_count = jnp.where(at_window, 0, win_count).astype(jnp.int32)
         else:
-            bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
-            p = jnp.where(i == 0, z, z + beta * c["p"])
+            no_progress = jnp.asarray(False)
+            win_start, win_count = c["win_start"], c["win_count"]
 
-        q = amul(p)
-        pq = ops.wdot(w, p, q)
-        bad_pq = (pq <= 0) | jnp.isinf(pq)
-        alpha = (rho / pq).astype(dt)
-        bad_alpha = jnp.isinf(alpha)
+        flag = jnp.where(converged, 0,
+                jnp.where(toosmall | stagnated | plateaued | no_progress, 3,
+                          1)).astype(jnp.int32)
+        stop = flag != 1
+        return dict(
+            x=x, r=r, p=p, rho=rho,
+            i=jnp.where(stop, i, i + 1).astype(jnp.int32),
+            flag=flag, stag=stag, moresteps=moresteps,
+            iter_out=i,
+            normr_act=normr_act, normrmin=normrmin, xmin=xmin, imin=imin,
+            since_best=since_best, best_at_reset=best_at_reset,
+            win_start=win_start, win_count=win_count,
+            mode=jnp.asarray(0, jnp.int32),
+        )
 
-        breakdown = bad_rho | bad_beta | bad_pq | bad_alpha
-        new_flag = jnp.where(flag2, 2, jnp.where(breakdown, 4, 1)).astype(jnp.int32)
+    def body(c):
+        """One trip = one CG iteration (mode 0), or the deferred
+        true-residual check of the just-committed iteration (mode 1,
+        reference pcg_solver.py:527-533; ``i`` does not advance on the
+        committing trip, so iteration counts match the reference
+        exactly).  The matvec operand is selected BEFORE the single
+        ``amul`` below — the stencil is instantiated ONCE in the whole
+        loop body, which at octree-flagship scale is minutes of compile
+        time per instantiation (docs/BENCH_LOG.md 2026-07-31)."""
+        i = c["i"]
+        is_check = c["mode"] == 1
 
-        def on_break(c):
-            out = dict(c)
-            out["flag"] = new_flag
-            out["iter_out"] = i
-            out["rho"] = rho
-            return out
+        def pre_iterate(c):
+            # scalar Jacobi inverse (P, n_loc) or block-Jacobi inverse
+            # (P, n_node_loc, 3, 3) — ops.apply_prec dispatches on rank
+            z = ops.apply_prec(inv_diag, c["r"])
+            # The inf-preconditioner predicate must agree across shards or
+            # the while_loop exits divergently and collective counts
+            # desync; fuse its global reduction into the rho psum (still
+            # one collective).
+            inf_loc = jnp.any(jnp.isinf(z)).astype(ops.dot_dtype)
+            red = ops.wdots(w, [(z, c["r"])], extra=[inf_loc])
+            rho, flag2 = red[0], red[1] > 0
+            bad_rho = (rho == 0) | jnp.isinf(rho)
+            beta = (rho / c["rho"]).astype(dt)
+            if warm:
+                # Resumed iteration: the beta/p recurrence continues from
+                # the previous call's direction on the very first pass.
+                bad_beta = (beta == 0) | jnp.isinf(beta)
+                p = z + beta * c["p"]
+            else:
+                bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
+                p = jnp.where(i == 0, z, z + beta * c["p"])
+            return p, dict(rho=rho, flag2=flag2, bad_pre=bad_rho | bad_beta)
 
-        def on_continue(c):
-            r = c["r"] - alpha * q
-            # Fused 3-norm reduction: ||p||, ||x_old||, ||r|| in ONE psum
-            # (reference pcg_solver.py:504-507).
-            sq = ops.wdots(w, [(p, p), (c["x"], c["x"]), (r, r)])
-            normp, normx, normr = jnp.sqrt(sq[0]), jnp.sqrt(sq[1]), jnp.sqrt(sq[2])
-            stag = jnp.where(normp * jnp.abs(alpha).astype(ops.dot_dtype) < eps * normx,
-                             c["stag"] + 1, 0).astype(jnp.int32)
-            x = c["x"] + alpha * p
+        def pre_check(c):
+            false = jnp.asarray(False)
+            return c["x"], dict(rho=c["rho"], flag2=false, bad_pre=false)
 
-            candidate = (normr <= tolb) | (stag >= max_stag_steps) | (c["moresteps"] > 0)
+        operand, aux = jax.lax.cond(is_check, pre_check, pre_iterate, c)
+        q = amul(operand)     # the ONE stencil instantiation in the body
 
-            def check_true(args):
-                x, r = args
-                # Recompute the ACTUAL residual with an extra matvec before
-                # declaring convergence (reference pcg_solver.py:527-533).
-                r_true = fext - amul(x)
-                normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
-                return r_true, normr_act
+        def post_iterate(args):
+            c, p, q, aux = args
+            rho = aux["rho"]
+            pq = ops.wdot(w, p, q)
+            bad_pq = (pq <= 0) | jnp.isinf(pq)
+            alpha = (rho / pq).astype(dt)
+            bad_alpha = jnp.isinf(alpha)
 
-            def no_check(args):
-                x, r = args
-                return r, normr.astype(ops.dot_dtype)
+            breakdown = aux["bad_pre"] | bad_pq | bad_alpha
+            new_flag = jnp.where(aux["flag2"], 2,
+                                 jnp.where(breakdown, 4, 1)).astype(jnp.int32)
 
-            r, normr_act = jax.lax.cond(candidate, check_true, no_check, (x, r))
+            def on_break(c):
+                out = dict(c)
+                out["flag"] = new_flag
+                out["iter_out"] = i
+                out["rho"] = rho
+                return out
 
-            converged = candidate & (normr_act <= tolb)
-            # not converged on candidate: stag reset + MoreSteps bookkeeping
-            # (reference pcg_solver.py:544-552)
-            stag = jnp.where(candidate & ~converged
-                             & (stag >= max_stag_steps) & (c["moresteps"] == 0),
-                             0, stag)
-            moresteps = jnp.where(candidate & ~converged,
-                                  c["moresteps"] + 1, c["moresteps"]).astype(jnp.int32)
-            toosmall = candidate & ~converged & (moresteps >= maxmsteps)
+            def on_continue(c):
+                r = c["r"] - alpha * q
+                # Fused 3-norm reduction: ||p||, ||x_old||, ||r|| in ONE
+                # psum (reference pcg_solver.py:504-507).
+                sq = ops.wdots(w, [(p, p), (c["x"], c["x"]), (r, r)])
+                normp, normx, normr = (jnp.sqrt(sq[0]), jnp.sqrt(sq[1]),
+                                       jnp.sqrt(sq[2]))
+                stag = jnp.where(
+                    normp * jnp.abs(alpha).astype(ops.dot_dtype)
+                    < eps * normx,
+                    c["stag"] + 1, 0).astype(jnp.int32)
+                x = c["x"] + alpha * p
 
-            # minimal-residual iterate bookkeeping (pcg_solver.py:554-558)
-            better = normr_act < c["normrmin"]
-            normrmin = jnp.where(better, normr_act, c["normrmin"])
-            xmin = jnp.where(better, x, c["xmin"])
-            imin = jnp.where(better, i, c["imin"])
-            # the plateau counter demands a MEANINGFUL (0.1%) improvement
-            # since the LAST RESET (a snapshot, not the ratcheting
-            # normrmin: steady sub-0.1%-per-iteration convergence must
-            # accumulate against the snapshot and keep resetting, while
-            # hair-thin dips at the f32 floor must not)
-            improved = normr_act < c["best_at_reset"] * (1 - 1e-3)
-            since_best = jnp.where(improved, 0,
-                                   c["since_best"] + 1).astype(jnp.int32)
-            best_at_reset = jnp.where(improved, normr_act,
-                                      c["best_at_reset"])
+                candidate = ((normr <= tolb) | (stag >= max_stag_steps)
+                             | (c["moresteps"] > 0))
 
-            stagnated = (stag >= max_stag_steps) & ~converged & ~toosmall
-            plateaued = ((since_best > plateau_window) & ~converged
-                         & ~toosmall if plateau_window else jnp.asarray(False))
+                # Non-candidate epilogue (normr_act := recurrence norm).
+                resolved = _resolve(c, x=x, r=r, p=p, rho=rho, stag=stag,
+                                    normr_act=normr.astype(ops.dot_dtype),
+                                    candidate=jnp.asarray(False), i=i)
+                # Candidate: COMMIT the iterate but DEFER the epilogue to
+                # the next trip's true-residual check (mode 1); i, flag and
+                # all bookkeeping are untouched until then.
+                pending = dict(c, x=x, r=r, p=p, rho=rho, stag=stag,
+                               iter_out=i, mode=jnp.asarray(1, jnp.int32))
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(candidate, a, b),
+                    pending, resolved)
 
-            flag = jnp.where(converged, 0,
-                    jnp.where(toosmall | stagnated | plateaued, 3,
-                              1)).astype(jnp.int32)
-            stop = flag != 1
-            return dict(
-                x=x, r=r, p=p, rho=rho,
-                i=jnp.where(stop, i, i + 1).astype(jnp.int32),
-                flag=flag, stag=stag, moresteps=moresteps,
-                iter_out=i,
-                normr_act=normr_act, normrmin=normrmin, xmin=xmin, imin=imin,
-                since_best=since_best, best_at_reset=best_at_reset,
-            )
+            return jax.lax.cond(aux["flag2"] | breakdown, on_break,
+                                on_continue, c)
 
-        return jax.lax.cond(flag2 | breakdown, on_break, on_continue, c)
+        def post_check(args):
+            c, _x, q, _aux = args
+            # q = amul(x): recompute the ACTUAL residual before declaring
+            # convergence (reference pcg_solver.py:527-533).
+            r_true = fext - q
+            normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
+            return _resolve(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
+                            stag=c["stag"], normr_act=normr_act,
+                            candidate=jnp.asarray(True), i=i)
+
+        return jax.lax.cond(is_check, post_check, post_iterate,
+                            (c, operand, q, aux))
 
     c = jax.lax.while_loop(cond, body, carry0)
 
@@ -333,7 +438,8 @@ def pcg(
         # the min-residual fallback — resuming must continue the recurrence.
         carry = {k: c[k] for k in ("x", "r", "p", "rho", "stag", "moresteps",
                                    "normrmin", "xmin", "imin", "since_best",
-                                   "best_at_reset", "normr_act")}
+                                   "best_at_reset", "win_start", "win_count",
+                                   "normr_act")}
         # Executed body-iteration count for host-side budget accounting
         # (result.iters reports the min-residual index on failure, which
         # would undercount).
@@ -359,6 +465,9 @@ def pcg_mixed(
     inner_tol: float = 1e-5,
     max_outer: int = 12,
     plateau_window: int = 0,
+    progress_window: int = 0,
+    progress_ratio: float = 0.7,
+    progress_min_gain: float = 30.0,
 ) -> PCGResult:
     """Mixed-precision PCG by iterative refinement (TPU performance path).
 
@@ -380,57 +489,87 @@ def pcg_mixed(
     n2b = jnp.sqrt(ops64.wdot(w64, fext, fext))
     tolb = tol * n2b
 
-    r0 = fext - amul64(x0)
-    normr0 = jnp.sqrt(ops64.wdot(w64, r0, r0))
-
+    # The f64 residual is refreshed at the TOP of the loop body (for the
+    # CURRENT x) instead of pre-loop + bottom: the numerical sequence
+    # r0, inner, r1, inner, ..., rN is identical, but the f64 stencil is
+    # instantiated ONCE in the whole program instead of twice — at octree
+    # flagship scale each instantiation is minutes of compile time
+    # (docs/BENCH_LOG.md 2026-07-31).  Internal flag -1 = still running
+    # (the final residual evaluation happens in-body, so the loop cond
+    # only tests the flag).
     carry0 = dict(
         x=x0,
-        r=r0,
-        normr=normr0,
-        normr_prev=jnp.asarray(np.inf, ops64.dot_dtype),
+        normr=jnp.asarray(np.inf, ops64.dot_dtype),   # last refreshed norm
         outer=jnp.asarray(0, jnp.int32),
         total=jnp.asarray(0, jnp.int32),
-        flag=jnp.where((n2b == 0) | (normr0 <= tolb), 0, 1).astype(jnp.int32),
+        flag=jnp.where(n2b == 0, 0, -1).astype(jnp.int32),
+        # inner inf-preconditioner exit last cycle: terminal flag 2, but
+        # only AFTER this trip's refresh so the reported residual is the
+        # post-cycle one (matches the refresh-at-bottom formulation)
+        fatal2=jnp.asarray(False),
     )
 
     def cond(c):
-        return (c["flag"] == 1) & (c["outer"] < max_outer) & (c["total"] < max_iter)
+        return c["flag"] == -1
 
     def body(c):
-        scale = c["normr"]
-        rhat32 = (c["r"] / scale).astype(jnp.float32)
-        remaining = jnp.maximum(max_iter - c["total"], 1)
-        tol_cycle = refine_tol(tolb, scale, inner_tol)
-        # return_carry gives the EXECUTED body-iteration count: on flag-3
-        # exits inner.iters is the min-residual index, which would both
-        # undercount the reported work and let the budget run past
-        # max_iter.  (inner itself is still the finalized min-residual
-        # result — finalize runs before the carry branch.)
-        inner, icarry = pcg(
-            ops32, data32,
-            fext=rhat32,
-            x0=jnp.zeros_like(rhat32),
-            inv_diag=inv_diag32,
-            tol=tol_cycle,
-            max_iter=remaining,
-            glob_n_dof_eff=glob_n_dof_eff,
-            max_stag_steps=max_stag_steps,
-            max_iter_nominal=max_iter,
-            plateau_window=plateau_window,
-            return_carry=True,
-        )
-        x = c["x"] + inner.x.astype(fext.dtype) * scale
-        r = fext - amul64(x)
+        r = fext - amul64(c["x"])
         normr = jnp.sqrt(ops64.wdot(w64, r, r))
-        total = c["total"] + jnp.maximum(icarry["exec"], 1)
         converged = normr <= tolb
         # no-progress guard: refinement must contract the residual
+        # (first trip: normr_prev = inf, never trips)
         stalled = normr > 0.5 * c["normr"]
-        flag = jnp.where(converged, 0,
-                jnp.where(stalled, 3,
-                 jnp.where(inner.flag == 2, 2, 1))).astype(jnp.int32)
-        return dict(x=x, r=r, normr=normr, normr_prev=c["normr"],
-                    outer=c["outer"] + 1, total=total, flag=flag)
+        exhausted = (c["outer"] >= max_outer) | (c["total"] >= max_iter)
+        run_inner = ~(converged | stalled | c["fatal2"] | exhausted)
+
+        def do_inner(args):
+            r, normr = args
+            rhat32 = (r / normr).astype(jnp.float32)
+            remaining = jnp.maximum(max_iter - c["total"], 1)
+            tol_cycle = refine_tol(tolb, normr, inner_tol)
+            # return_carry gives the EXECUTED body-iteration count: on
+            # flag-3 exits inner.iters is the min-residual index, which
+            # would both undercount the reported work and let the budget
+            # run past max_iter.  (inner itself is still the finalized
+            # min-residual result — finalize runs before the carry
+            # branch.)
+            inner, icarry = pcg(
+                ops32, data32,
+                fext=rhat32,
+                x0=jnp.zeros_like(rhat32),
+                inv_diag=inv_diag32,
+                tol=tol_cycle,
+                max_iter=remaining,
+                glob_n_dof_eff=glob_n_dof_eff,
+                max_stag_steps=max_stag_steps,
+                max_iter_nominal=max_iter,
+                plateau_window=plateau_window,
+                return_carry=True,
+                x0_zero=True,
+                progress_window=progress_window,
+                progress_ratio=progress_ratio,
+                progress_min_gain=progress_min_gain,
+            )
+            return (inner.x.astype(fext.dtype) * normr,
+                    jnp.maximum(icarry["exec"], 1), inner.flag)
+
+        def skip_inner(args):
+            r, _ = args
+            return (jnp.zeros_like(fext), jnp.asarray(0, jnp.int32),
+                    jnp.asarray(1, jnp.int32))
+
+        xinc, exec_n, inner_flag = jax.lax.cond(
+            run_inner, do_inner, skip_inner, (r, normr))
+
+        flag = jnp.where(
+            converged, 0,
+            jnp.where(stalled, 3,
+             jnp.where(c["fatal2"], 2,
+              jnp.where(exhausted, 1, -1)))).astype(jnp.int32)
+        return dict(x=c["x"] + xinc, normr=normr,
+                    outer=c["outer"] + run_inner.astype(jnp.int32),
+                    total=c["total"] + exec_n, flag=flag,
+                    fatal2=inner_flag == 2)
 
     c = jax.lax.while_loop(cond, body, carry0)
     zero_rhs = n2b == 0

@@ -95,29 +95,38 @@ def settle_compile(max_attempts: int = 4,
         n = 8 * (attempt + 3) + 123 + 8 * ((os.getpid()
                                             + int(time.time())) % 1024)
         if live:
-            from concurrent.futures import ThreadPoolExecutor
-            from concurrent.futures import TimeoutError as FutTimeout
+            import threading
+
+            # a DAEMON thread, not a ThreadPoolExecutor worker:
+            # concurrent.futures joins its (non-daemon) workers at
+            # interpreter shutdown, so a native-hung compile probe would
+            # hang process EXIT — the exact wedged-tunnel hang this
+            # helper exists to bound
+            result = {}
+            done = threading.Event()
 
             def _probe():
-                import jax
-                import jax.numpy as jnp
+                try:
+                    import jax
+                    import jax.numpy as jnp
 
-                jax.jit(lambda x: (x * 3 + 1).sum()).lower(
-                    jax.ShapeDtypeStruct((n, 128), jnp.float32)).compile()
+                    jax.jit(lambda x: (x * 3 + 1).sum()).lower(
+                        jax.ShapeDtypeStruct((n, 128), jnp.float32)).compile()
+                    result["ok"] = True
+                except Exception as e:                  # noqa: BLE001
+                    result["err"] = e
+                done.set()
 
-            ex = ThreadPoolExecutor(max_workers=1)
-            try:
-                ex.submit(_probe).result(timeout=timeout_s)
-                return True, f"compile service ok (attempt {attempt + 1})"
-            except FutTimeout:
-                detail = f"compile probe hung past {timeout_s:.0f}s"
-            except Exception as e:                      # noqa: BLE001
+            threading.Thread(target=_probe, daemon=True).start()
+            if done.wait(timeout=timeout_s):
+                if result.get("ok"):
+                    return True, f"compile service ok (attempt {attempt + 1})"
+                e = result["err"]
                 detail = (f"compile probe failed "
                           f"({type(e).__name__}: {e})")
-            finally:
-                # do NOT wait: a native-hung worker thread cannot be
-                # joined; leak it and move on
-                ex.shutdown(wait=False)
+            else:
+                # native-hung thread: daemon, so it cannot block exit
+                detail = f"compile probe hung past {timeout_s:.0f}s"
         else:
             code = (f"import jax, jax.numpy as jnp; "
                     f"jax.jit(lambda x: (x * 3 + 1).sum()).lower("

@@ -185,6 +185,81 @@ def test_settle_compile_live_backend_in_process():
     assert ok and "attempt 1" in detail, detail
 
 
+def test_ladder_provisional_is_tiny_and_env_proof(monkeypatch):
+    """The provisional rung must ignore flagship envs (its whole job is
+    landing a line in minutes) and honor only BENCH_PROV_NX."""
+    _clear_bench_env(monkeypatch)
+    monkeypatch.setenv("BENCH_NX", "150")
+    monkeypatch.setenv("BENCH_LADDER", "150,128")
+    assert bench._ladder("cube", True, provisional=True) == [
+        (24, 24, 24, 0, 0)]
+    # even for an octree bench request the provisional stays a cube
+    # ladder shape (main() forces BENCH_MODEL=cube for the subprocess)
+    monkeypatch.setenv("BENCH_PROV_NX", "16")
+    assert bench._ladder("cube", True, provisional=True) == [
+        (16, 16, 16, 0, 0)]
+
+
+def test_emitter_exactly_once(capsys):
+    """Watchdog and main flow race to emit; exactly one line may win."""
+    em = bench._Emitter("initial")
+    em.offer("better")
+    assert em.emit() is True          # prints the best offered line
+    assert em.emit("late") is False   # second emit is refused
+    out = capsys.readouterr().out
+    assert out == "better\n"
+
+
+def test_emitter_offer_after_emit_is_noop(capsys):
+    em = bench._Emitter("a")
+    assert em.emit("final")
+    em.offer("late-offer")
+    assert em.best == "a"          # a late offer must not mutate state
+    assert capsys.readouterr().out == "final\n"
+
+
+def test_emitter_rank_priority(capsys):
+    """A provisional (rank 1) offer must never displace an accelerator
+    (rank 2) line — the watchdog races the live-baseline upgrade and the
+    TPU measurement has to win (r04 review finding)."""
+    em = bench._Emitter("sentinel")
+    em.offer("tpu-line", rank=2)
+    em.offer("provisional", rank=1)   # late watchdog offer
+    assert em.emit() is True
+    assert capsys.readouterr().out == "tpu-line\n"
+    # equal rank upgrades in place (measured-live replaces const)
+    em2 = bench._Emitter("sentinel")
+    em2.offer("const", rank=2)
+    em2.offer("live", rank=2)
+    assert em2.best == "live"
+
+
+def test_error_line_is_parseable_sentinel():
+    import json
+
+    d = json.loads(bench._error_line("boom"))
+    assert d["value"] == 0.0 and d["vs_baseline"] == 0.0
+    assert "boom" in d["detail"]["error"]
+    assert d["metric"] == "pcg_dof_iterations_per_second"
+
+
+def test_sweep_stale_tmps(tmp_path):
+    """Orphaned .tmp files older than an hour are removed on the read
+    path; fresh ones (a concurrent writer) are left alone."""
+    import os
+    import time
+
+    d = str(tmp_path)
+    old = os.path.join(d, "model_dead.tmp")
+    fresh = os.path.join(d, "model_live.tmp")
+    for p in (old, fresh):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    os.utime(old, (time.time() - 7200,) * 2)
+    bench._sweep_stale_tmps(d)
+    assert sorted(os.listdir(d)) == ["model_live.tmp"]
+
+
 def test_model_cache_eviction(tmp_path):
     """LRU eviction keeps the cache under the cap, never deletes the
     just-written entry, and evicts oldest-mtime first."""

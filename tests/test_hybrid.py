@@ -110,6 +110,124 @@ def test_solve_matches_general(model):
                                atol=1e-9 * np.abs(ug).max())
 
 
+def _strip_fastpath_meta(model):
+    import copy
+
+    m = copy.deepcopy(model)
+    m.octree = None
+    m.grid = None
+    return m
+
+
+def test_reconstruct_octree_meta_roundtrip(model):
+    """A bundle WITHOUT the Octree.npz sidecar (a genuine reference
+    bundle) must reconstruct lattice metadata from pure geometry and
+    route to the hybrid backend with iteration parity vs the general
+    path (VERDICT r03 weakness 3)."""
+    from pcg_mpi_solver_tpu.models.octree import reconstruct_lattice_meta
+
+    m = _strip_fastpath_meta(model)
+    assert reconstruct_lattice_meta(m)
+    ot, ref = m.octree, model.octree
+    assert ot["brick_type"] == ref["brick_type"]
+    assert ot["dims"] == ref["dims"]
+    assert ot["strides"] == ref["strides"]
+    np.testing.assert_array_equal(ot["leaves"], ref["leaves"])
+    np.testing.assert_array_equal(ot["node_keys"], ref["node_keys"])
+    np.testing.assert_array_equal(ot["brick_corners"], ref["brick_corners"])
+
+    # end to end: auto backend prefers hybrid on the reconstructed model
+    s = Solver(m, RunConfig(), mesh=make_mesh(4), n_parts=4)
+    assert s.backend == "hybrid"
+    res = s.step(1.0)
+    sg = Solver(model, RunConfig(), mesh=make_mesh(4), n_parts=4,
+                backend="general")
+    rg = sg.step(1.0)
+    assert res.flag == 0 and abs(int(res.iters) - int(rg.iters)) <= 1
+    np.testing.assert_allclose(
+        s.displacement_global(), sg.displacement_global(), rtol=0,
+        atol=1e-9 * np.abs(sg.displacement_global()).max())
+
+
+def test_reconstruct_handles_arbitrary_node_numbering(model):
+    """Reconstruction + partition must not assume sorted-key node
+    numbering: permute the node ids of the octree model and check the
+    hybrid solve still matches the general backend exactly."""
+    import copy
+
+    m = copy.deepcopy(model)
+    rng = np.random.default_rng(7)
+    perm = rng.permutation(m.n_node)         # new id of old node i
+    inv = np.argsort(perm)
+    m.node_coords = m.node_coords[inv]
+    old_dofs = np.asarray(m.elem_dofs_flat)
+    m.elem_dofs_flat = 3 * perm[old_dofs // 3] + old_dofs % 3
+    m.elem_nodes_flat = perm[m.elem_nodes_flat]
+    dof_perm = (3 * perm[:, None] + np.arange(3)[None]).ravel()
+    dof_inv = np.argsort(dof_perm)
+    for name in ("F", "Ud", "Vd", "diag_M"):
+        setattr(m, name, getattr(m, name)[dof_inv])
+    m.fixed_dof = np.sort(dof_perm[m.fixed_dof])
+    m.dof_eff = np.sort(dof_perm[m.dof_eff])
+    m.faces_flat = perm[m.faces_flat]
+    m.octree = None
+    m.grid = None
+    from pcg_mpi_solver_tpu.models.octree import reconstruct_lattice_meta
+
+    assert reconstruct_lattice_meta(m)
+    # node_keys now follow the permuted numbering (NOT sorted)
+    assert not np.all(np.diff(m.octree["node_keys"]) > 0)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-9, max_iter=3000, dtype="float64"),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]))
+    sh = Solver(m, cfg, mesh=make_mesh(4), n_parts=4, backend="hybrid")
+    rh = sh.step(1.0)
+    sg = Solver(m, cfg, mesh=make_mesh(4), n_parts=4, backend="general")
+    rg = sg.step(1.0)
+    assert rh.flag == 0 and abs(int(rh.iters) - int(rg.iters)) <= 1
+    np.testing.assert_allclose(
+        sh.displacement_global(), sg.displacement_global(), rtol=0,
+        atol=1e-9 * np.abs(sg.displacement_global()).max())
+
+
+def test_reconstruct_declines_foreign_corner_order(model):
+    """A bundle whose hex connectivity uses a valid but DIFFERENT corner
+    order must decline (stay on the general path) — engaging would crash
+    partition_hybrid's _CORNERS assertion (r04 review finding)."""
+    import copy
+
+    from pcg_mpi_solver_tpu.models.octree import reconstruct_lattice_meta
+
+    m = copy.deepcopy(model)
+    bt = m.octree["brick_type"]
+    m.octree = None
+    m.grid = None
+    sel = np.where(m.elem_type == bt)[0]
+    perm8 = np.array([0, 2, 1, 3, 4, 6, 5, 7])      # consistent, non-canon
+    idx = m.elem_nodes_offset[sel, None] + perm8[None]
+    m.elem_nodes_flat[m.elem_nodes_offset[sel, None] + np.arange(8)[None]] \
+        = m.elem_nodes_flat[idx].copy()
+    didx = (m.elem_dofs_offset[sel, None, None]
+            + 3 * perm8[None, :, None] + np.arange(3)[None, None])
+    base = (m.elem_dofs_offset[sel, None, None]
+            + 3 * np.arange(8)[None, :, None] + np.arange(3)[None, None])
+    m.elem_dofs_flat[base.reshape(len(sel), -1)] = \
+        m.elem_dofs_flat[didx.reshape(len(sel), -1)].copy()
+    assert not reconstruct_lattice_meta(m)
+    assert m.octree is None
+
+
+def test_reconstruct_declines_non_lattice(model):
+    """Perturbed geometry must leave the model on the general path."""
+    from pcg_mpi_solver_tpu.models.octree import reconstruct_lattice_meta
+
+    m = _strip_fastpath_meta(model)
+    m.node_coords = m.node_coords + 0.01 * np.sin(
+        np.arange(m.node_coords.size)).reshape(m.node_coords.shape)
+    assert not reconstruct_lattice_meta(m)
+    assert m.octree is None and m.grid is None
+
+
 def test_combine_gather_matches_scatter(pair):
     """The scatter-free gather-combine (default) vs the row scatter —
     identical matvec and diag up to f64 summation-order noise."""

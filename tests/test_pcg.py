@@ -142,6 +142,64 @@ def test_plateau_window_mechanism():
     assert int(res_tiny.iters) < 10
 
 
+def test_progress_exit_mechanism():
+    """The progress-rate exit (mixed-mode inner cycles): plumbing fires
+    when armed with hair-trigger thresholds, and the min-gain gate keeps
+    it unreachable before the cycle has done real work."""
+    from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+    from pcg_mpi_solver_tpu.parallel.partition import partition_model
+    from pcg_mpi_solver_tpu.solver.pcg import pcg
+
+    model = make_cube_model(6, 5, 5, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    pm = partition_model(model, 1)
+    data = device_data(pm, jnp.float32)
+    ops = Ops.from_model(pm, dot_dtype=jnp.float32)
+    eff = data["eff"]
+    fext = eff * data["F"]
+    x0 = jnp.zeros_like(fext)
+    d = eff * ops.diag(data)
+    inv_diag = jnp.where(d != 0, 1.0 / jnp.maximum(d, 1e-30), 0.0)
+    kw = dict(tol=1e-14, max_iter=1500,
+              glob_n_dof_eff=int(model.dof_eff.sum()))
+    res_off = pcg(ops, data, fext, x0, inv_diag, **kw)
+    # hair-trigger: 1-iter window, any ratio counts as weak, gate at 1.5x
+    # achieved contraction -> exits very early with the min-residual
+    # iterate (proves the window/gate plumbing end to end)
+    res_trip = pcg(ops, data, fext, x0, inv_diag, progress_window=1,
+                   progress_ratio=1e-9, progress_min_gain=1.5, **kw)
+    assert int(res_trip.flag) == 3
+    assert int(res_trip.iters) < int(res_off.iters)
+    # production thresholds: the min-gain gate (30x) plus the long window
+    # must leave this small f32-floor grind to MATLAB's own stagnation
+    # protocol — identical iteration count and flag as knob-off
+    res_prod = pcg(ops, data, fext, x0, inv_diag, progress_window=150,
+                   progress_ratio=0.7, progress_min_gain=30.0, **kw)
+    assert int(res_prod.flag) == int(res_off.flag)
+    assert int(res_prod.iters) == int(res_off.iters)
+    assert float(res_prod.relres) == float(res_off.relres)
+
+
+def test_mixed_progress_default_no_small_scale_regression():
+    """mixed_progress_window is ON by default: a small mixed solve must
+    converge identically (flag 0, same tol) with it on or off."""
+    model = make_cube_model(5, 4, 4, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    results = {}
+    for win in (0, 150):
+        cfg = RunConfig(
+            solver=SolverConfig(tol=1e-9, max_iter=4000, dtype="float32",
+                                dot_dtype="float64", precision_mode="mixed",
+                                mixed_progress_window=win),
+            time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+        )
+        s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1)
+        results[win] = s.step(1.0)
+    assert results[150].flag == 0
+    assert results[150].iters == results[0].iters
+    assert np.isclose(results[150].relres, results[0].relres, rtol=1e-6)
+
+
 def test_mixed_converges_with_plateau_default():
     model = make_cube_model(5, 4, 4, h=0.5, nu=0.3, load="traction",
                             heterogeneous=True)

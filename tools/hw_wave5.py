@@ -1,0 +1,83 @@
+"""Fifth-wave hardware queue (round 4).
+
+The round-3 tunnel outage (dead from 04:21Z to end of round) left every
+wave-4 step owed.  This queue re-runs them with the round-4 changes in
+(single-instantiation PCG body + x0_zero + refresh-at-top refinement —
+roughly half the stencil instantiations per compiled program — and the
+progress-rate inner exit default-on), ordered so the highest-value
+measurements land first and nothing that can wedge the grant precedes
+them:
+
+  1. matvec A/B — ONLY v6 + v8 (chipless-compile-verified candidates;
+     v1-v5/v7 are pinned Mosaic failures whose failed remote compiles
+     wedge the grant) vs the XLA gse/gsplit/corner forms at 150^3.
+  2. Per-iteration breakdown immediately after (third re-queue; VERDICT
+     r03 item 7 says before anything that can wedge).
+  3. Flagship cube bench (pallas auto probes v6; progress exit ON).
+  4. Progress-exit A/B: same flagship with BENCH_PROGRESS=0 — the
+     670-wasted-iteration claim (docs/BENCH_LOG.md) decides here.
+  5. Octree flagship ladder 22/18/12 at L4 (compile cache warm from
+     round-3 entries is INVALID after the PCG restructure; the 4800 s
+     budget covers one cold ~10 min compile + solve — half the old
+     ~20 min after the single-instantiation change).
+  6. f64-direct anchor at 150 (chipless compile exonerated the program;
+     ladder steps down 128/96 on failure).
+  7. Hybrid per-level breakdown.
+  8. Gather/scatter combine variants at flagship fill.
+
+Same probe/retry + wedged-grant step isolation as tools/hw_session.py.
+
+Usage: python tools/hw_wave5.py [--deadline-min 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.hw_session import log_line, run_step, start_queue  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=300)
+    ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
+    args = ap.parse_args()
+    path = start_queue("hw_wave5", args.deadline_min, args.log)
+
+    # 1. The fused-kernel A/B this repo's perf thesis rides on.
+    run_step(path, "matvec A/B v6+v8 vs XLA forms",
+             ["examples/bench_matvec.py", "150"],
+             env_extra={"BENCH_MATVEC_VARIANTS": "v6,v8"}, timeout=2400)
+    # 2. Per-op split while the grant is clean (owed since wave 1).
+    run_step(path, "iteration breakdown",
+             ["examples/bench_iter_breakdown.py", "150"], timeout=2400)
+    # 3. Flagship cube (v6 probe live, progress exit on by default).
+    run_step(path, "flagship (v6 probe, progress on)", ["bench.py"],
+             timeout=3600, force_gate=True)
+    # 4. Progress-exit A/B at the only scale where it can pay.
+    run_step(path, "flagship progress=0 A/B", ["bench.py"],
+             env_extra={"BENCH_PROGRESS": "0"}, timeout=3600)
+    # 5. Octree flagship (gather combine, halved compile after the
+    # single-instantiation restructure).
+    run_step(path, "octree flagship", ["bench.py"],
+             env_extra={"BENCH_MODEL": "octree"}, timeout=4800,
+             force_gate=True)
+    # 6. f64-direct anchor at the full 150^3 (program exonerated
+    # chiplessly at 106 s; earlier failures were service weather).
+    run_step(path, "f64 direct anchor 150", ["bench.py"],
+             env_extra={"BENCH_MODE": "direct", "BENCH_DTYPE": "float64"},
+             timeout=4800, force_gate=True)
+    # 7/8. Remaining owed microbenchmarks.
+    run_step(path, "hybrid breakdown",
+             ["examples/bench_hybrid_breakdown.py"], timeout=2400)
+    run_step(path, "gather/scatter variants", ["examples/bench_gather.py"],
+             timeout=2400)
+    log_line(path, "hw_wave5 complete")
+
+
+if __name__ == "__main__":
+    main()
