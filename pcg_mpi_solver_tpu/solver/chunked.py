@@ -40,9 +40,18 @@ class ChunkedEngine:
 
     def __init__(self, *, mesh, data_specs, part_spec, rep_spec, ops,
                  scfg, glob_n_dof_eff: int, cap: int, mixed: bool,
-                 ops32=None):
+                 ops32=None, amul_fn=None):
+        """``amul_fn``, when given, is a host-level callable
+        ``(data, v) -> eff * K.v`` backed by ONE separately-jitted
+        program the caller shares across all its out-of-loop f64 matvec
+        uses (Dirichlet lifting, r0, refine) — at octree-flagship scale
+        every stencil INSTANTIATION costs minutes of compile
+        (docs/BENCH_LOG.md 2026-07-31), so the refine step is then
+        composed from two tiny elementwise programs around it instead of
+        instantiating the stencil a second time in its own program."""
         self.mixed = mixed
         self.scfg = scfg
+        self._amul_fn = amul_fn
         cap = int(cap)
         P, R = part_spec, rep_spec
         carry_specs = carry_part_specs(P, R)
@@ -94,17 +103,33 @@ class ChunkedEngine:
                 _inner_cycle, (data_specs, P, P, R, carry_specs, R),
                 (P, carry_specs, R))
 
-            def _refine(data, fext, x, xinc32, scale):
-                data64 = data["f64"]
-                eff = data64["eff"]
-                w = data64["weight"] * eff
-                x2 = x + xinc32.astype(x.dtype) * scale
-                r2 = fext - eff * ops.matvec(data64, x2)
-                normr2 = jnp.sqrt(ops.wdot(w, r2, r2))
-                return x2, r2, normr2
+            if amul_fn is None:
+                def _refine(data, fext, x, xinc32, scale):
+                    data64 = data["f64"]
+                    eff = data64["eff"]
+                    w = data64["weight"] * eff
+                    x2 = x + xinc32.astype(x.dtype) * scale
+                    r2 = fext - eff * ops.matvec(data64, x2)
+                    normr2 = jnp.sqrt(ops.wdot(w, r2, r2))
+                    return x2, r2, normr2
 
-            self._refine_fn = smap(
-                _refine, (data_specs, P, P, P, R), (P, P, R))
+                self._refine_fn = smap(
+                    _refine, (data_specs, P, P, P, R), (P, P, R))
+            else:
+                def _refine_pre(x, xinc32, scale):
+                    return x + xinc32.astype(x.dtype) * scale
+
+                self._refine_pre_fn = smap(_refine_pre, (P, P, R), P)
+
+                def _refine_post(data, fext, kx2):
+                    data64 = data["f64"]
+                    w = data64["weight"] * data64["eff"]
+                    r2 = fext - kx2          # kx2 = eff * K.x2 (amul_fn)
+                    normr2 = jnp.sqrt(ops.wdot(w, r2, r2))
+                    return r2, normr2
+
+                self._refine_post_fn = smap(
+                    _refine_post, (data_specs, P, P), (P, R))
 
             def _final32(data, rhat32, carry32):
                 """f32 min-residual selection when an inner solve fails
@@ -186,7 +211,12 @@ class ChunkedEngine:
                     # pcg_mixed's inner finalize_bad).
                     xin = self._final32_fn(data, rhat32, c32)
                 vlog("refine dispatch (f64 true-residual matvec)")
-                x, r, normr = self._refine_fn(data, fext, x, xin, normr)
+                if self._amul_fn is None:
+                    x, r, normr = self._refine_fn(data, fext, x, xin, normr)
+                else:
+                    x = self._refine_pre_fn(x, xin, normr)
+                    r, normr = self._refine_post_fn(
+                        data, fext, self._amul_fn(data, x))
                 cur = float(normr)
                 vlog(f"refine done: relres={cur / n2b_f:.3e} total={total}")
                 if cur <= tolb:
@@ -217,12 +247,19 @@ class ChunkedEngine:
         return x_fin, flag, relres, total
 
 
-def auto_dispatch_cap(scfg, glob_n_dof: int, n_loc_dev: int) -> int:
+def auto_dispatch_cap(scfg, glob_n_dof: int, n_loc_dev: int,
+                      force_engage: bool = False) -> int:
     """Resolve SolverConfig.iters_per_dispatch (-1 = auto: engage on large
-    problems, sized so one dispatch stays well under a minute)."""
+    problems, sized so one dispatch stays well under a minute).
+
+    ``force_engage`` makes auto engage at ANY size — the hybrid backend
+    always prefers the chunked path, whose programs instantiate its
+    minutes-to-compile stencil strictly fewer times than the one-shot
+    step program (1 shared f64 + 1 f32 loop body vs 2 f64 + 1 f32 in one
+    program); chunked dispatches are iteration-identical to one-shot."""
     cap = scfg.iters_per_dispatch
     if cap < 0:
-        if glob_n_dof < 4_000_000:
+        if glob_n_dof < 4_000_000 and not force_engage:
             cap = 0
         else:
             cap = max(200, int(45.0 / (4e-9 * max(n_loc_dev, 1))))

@@ -323,7 +323,8 @@ class Solver:
 
         self._dispatch_cap = auto_dispatch_cap(
             solver_cfg, self.pm.glob_n_dof,
-            self.pm.n_loc * (self.pm.n_parts // n_dev))
+            self.pm.n_loc * (self.pm.n_parts // n_dev),
+            force_engage=self.backend == "hybrid")
         if self._dispatch_cap > 0:
             self._build_chunked(solver_cfg, glob_n_eff)
 
@@ -372,14 +373,45 @@ class Solver:
         P, R = self._part_spec, self._rep_spec
         carry_specs = carry_part_specs(P, R)
 
-        def _start(data, un_prev, delta):
+        # The ONE program holding the out-of-loop f64 stencil: Dirichlet
+        # lifting, r0, and every refinement's true-residual matvec all
+        # dispatch through it.  At octree-flagship scale each stencil
+        # INSTANTIATION costs minutes of compile (docs/BENCH_LOG.md
+        # 2026-07-31) — the old single _start program alone instantiated
+        # it twice.  The cost is a couple of unfused vector round-trips
+        # per STEP/cycle (micro-ms at 10M dofs), not per iteration.
+        def _amul64(data, v):
+            d = data["f64"] if mixed else data
+            return d["eff"] * self.ops.matvec(d, v)
+
+        self._amul64_fn = jax.jit(jax.shard_map(
+            _amul64, mesh=self.mesh, in_specs=(self._specs, P),
+            out_specs=P, check_vma=False))
+
+        def _start_pre(data, delta):
+            data64 = data["f64"] if mixed else data
+            return data64["Ud"] * delta
+
+        self._start_pre_fn = jax.jit(jax.shard_map(
+            _start_pre, mesh=self.mesh, in_specs=(self._specs, R),
+            out_specs=P, check_vma=False))
+
+        def _start_mid(data, un_prev, delta, kudi):
             data64 = data["f64"] if mixed else data
             eff = data64["eff"]
-            w = data64["weight"] * eff
-            udi = data64["Ud"] * delta
-            fext = eff * (data64["F"] * delta - self.ops.matvec(data64, udi))
+            # eff is idempotent: eff*(F*delta - K.udi) == eff*F*delta - kudi
+            fext = eff * data64["F"] * delta - kudi
             x0 = eff * un_prev
-            r0 = fext - eff * self.ops.matvec(data64, x0)
+            return fext, x0
+
+        self._start_mid_fn = jax.jit(jax.shard_map(
+            _start_mid, mesh=self.mesh, in_specs=(self._specs, P, R, P),
+            out_specs=(P, P), check_vma=False))
+
+        def _start_post(data, fext, x0, kx0):
+            data64 = data["f64"] if mixed else data
+            w = data64["weight"] * data64["eff"]
+            r0 = fext - kx0
             n2b = jnp.sqrt(self.ops.wdot(w, fext, fext))
             normr0 = jnp.sqrt(self.ops.wdot(w, r0, r0))
             carry0 = cold_carry(x0, r0, normr0, self.ops.dot_dtype)
@@ -389,18 +421,19 @@ class Solver:
                 prec = self._make_prec(self.ops32, data["f32"])
             else:
                 prec = self._make_prec(self.ops, data64)
-            return udi, fext, carry0, normr0, n2b, prec
+            return carry0, normr0, n2b, prec
 
-        self._start_fn = jax.jit(jax.shard_map(
-            _start, mesh=self.mesh,
-            in_specs=(self._specs, P, R),
-            out_specs=(P, P, carry_specs, R, R, P), check_vma=False))
+        self._start_post_fn = jax.jit(jax.shard_map(
+            _start_post, mesh=self.mesh,
+            in_specs=(self._specs, P, P, P),
+            out_specs=(carry_specs, R, R, P), check_vma=False))
 
         self._engine = ChunkedEngine(
             mesh=self.mesh, data_specs=self._specs, part_spec=P,
             rep_spec=R, ops=self.ops, scfg=scfg,
             glob_n_dof_eff=glob_n_eff, cap=self._dispatch_cap,
-            mixed=mixed, ops32=self.ops32 if mixed else None)
+            mixed=mixed, ops32=self.ops32 if mixed else None,
+            amul_fn=self._amul64_fn)
         self._finish_fn = jax.jit(lambda x, udi: x + udi)
 
     def _step_chunked(self, delta):
@@ -410,9 +443,14 @@ class Solver:
         PCG); the resumable carry makes direct-mode dispatches iteration-
         for-iteration identical to one long solve, and chunk boundaries
         align with refinement cycles in mixed mode."""
-        _vlog("start_fn dispatch (lifting + r0; first call pays compile)")
-        udi, fext, carry, normr0, n2b, prec = self._start_fn(
-            self.data, self.un, jnp.asarray(delta, self.dtype))
+        _vlog("start dispatch (lifting + r0; first call pays compile)")
+        delta_dev = jnp.asarray(delta, self.dtype)
+        udi = self._start_pre_fn(self.data, delta_dev)
+        kudi = self._amul64_fn(self.data, udi)
+        fext, x0 = self._start_mid_fn(self.data, self.un, delta_dev, kudi)
+        kx0 = self._amul64_fn(self.data, x0)
+        carry, normr0, n2b, prec = self._start_post_fn(
+            self.data, fext, x0, kx0)
         n2b_f = float(n2b)
         _vlog(f"start_fn done; ||b||={n2b_f:.3e}")
         if n2b_f == 0.0:

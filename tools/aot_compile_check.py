@@ -116,9 +116,101 @@ def check_f64matvec(args):
         s, f"f64 chunked matvec {n}^3")
 
 
+def _hybrid_setup(args):
+    """Shared setup for the hybrid checks: topology sharding, flagship
+    octree partition (cached model), ops + f32 data structs."""
+    import jax
+    import jax.numpy as jnp
+
+    # topology FIRST (needs the tpu plugin visible), THEN pin the CPU
+    # backend so conversions below cannot touch the tunnel
+    s = _topo_sharding()
+    jax.config.update("jax_platforms", "cpu")
+
+    from pcg_mpi_solver_tpu.bench import cached_model
+    from pcg_mpi_solver_tpu.parallel.hybrid import (
+        HybridOps, device_data_hybrid, partition_hybrid)
+
+    n0 = args.nx if args.nx is not None else 22   # flagship octree
+    model = cached_model("octree", nx0=n0, ny0=n0, nz0=n0,
+                         max_level=4, n_incl=6, seed=2, E=30e9, nu=0.2,
+                         load="traction", load_value=1e6)
+    t0 = time.perf_counter()
+    hp = partition_hybrid(model, 1)
+    ops = HybridOps.from_hybrid(hp, dot_dtype=jnp.float64,
+                                use_pallas=args.pallas == "on")
+    data = device_data_hybrid(hp, jnp.float32)
+    print(f"# octree {model.n_dof} dofs, {len(hp.levels)} levels "
+          f"(partition {time.perf_counter()-t0:.0f}s)", flush=True)
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s), data)
+    return s, hp, ops, structs, n0
+
+
+def check_hybridcycle(args):
+    """Compile the CHUNKED inner-cycle program — the program the bench
+    actually compiles at flagship scale (hybrid force-engages the
+    chunked path; solver/chunked.py _inner_cycle): warm resumable pcg,
+    ONE stencil instantiation in the loop body after the round-4
+    restructure."""
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.solver.pcg import cold_carry, pcg
+
+    s, hp, ops, structs, n0 = _hybrid_setup(args)
+    n_loc = ops.n_loc
+
+    def fn(data, rhat32, prec32, tol_cycle, carry32, budget):
+        res, carry2 = pcg(
+            ops, data, rhat32, carry32["x"], prec32,
+            tol=tol_cycle, max_iter=jnp.minimum(500, budget),
+            glob_n_dof_eff=n_loc, max_iter_nominal=20000,
+            carry_in=carry32, return_carry=True, progress_window=150)
+        return res.x, carry2, res.flag
+
+    vec = jax.ShapeDtypeStruct((1, n_loc), jnp.float32, sharding=s)
+    carry = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        cold_carry(jnp.zeros((1, n_loc), jnp.float32),
+                   jnp.zeros((1, n_loc), jnp.float32),
+                   jnp.asarray(1.0, ops.dot_dtype), ops.dot_dtype))
+    scal32 = jax.ShapeDtypeStruct((), jnp.float32, sharding=s)
+    bud = jax.ShapeDtypeStruct((), jnp.int32, sharding=s)
+    return _compile_structs(
+        fn, [structs, vec, vec, scal32, carry, bud],
+        f"hybrid CHUNKED inner-cycle octree {n0}^3/L4")
+
+
+def check_hybridamul64(args):
+    """Compile the shared out-of-loop f64 hybrid matvec program (driver
+    _amul64_fn) — the ONE f64 stencil instantiation the chunked driver
+    now pays (was 3: lifting + r0 in _start, plus _refine)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.parallel.hybrid import device_data_hybrid
+
+    s, hp, ops64, _structs32, n0 = _hybrid_setup(args)
+    data64 = device_data_hybrid(hp, jnp.float64)
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        data64)
+    n_loc = ops64.n_loc
+
+    def fn(data, v):
+        return data["eff"] * ops64.matvec(data, v)
+
+    vec = jax.ShapeDtypeStruct((1, n_loc), jnp.float64, sharding=s)
+    return _compile_structs(fn, [structs, vec],
+                            f"hybrid f64 amul octree {n0}^3/L4")
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("what", choices=["kernel", "f64matvec", "pcg", "hybridpcg"])
+    ap.add_argument("what", choices=["kernel", "f64matvec", "pcg",
+                                     "hybridpcg", "hybridcycle",
+                                     "hybridamul64"])
     ap.add_argument("--variants", default="6,7")
     ap.add_argument("--nx", type=int, default=None,
                     help="cells per edge (default: 150; hybridpcg: 22 "
@@ -133,12 +225,14 @@ def main():
         # the pallas dispatch is f32-gated (structured.matvec_local);
         # with f64 inputs the flag would silently validate the XLA path
         ap.error("--pallas on requires --dtype float32")
-    if args.nx is None and args.what != "hybridpcg":
+    if args.nx is None and args.what not in ("hybridpcg", "hybridcycle",
+                                             "hybridamul64"):
         args.nx = 150
     # never touch the real backend: the topology API needs no client, and
     # an accidental device touch would hang on a wedged tunnel
     os.environ.pop("JAX_PLATFORMS", None)
-    if args.what in ("f64matvec", "pcg", "hybridpcg"):
+    if args.what in ("f64matvec", "pcg", "hybridpcg", "hybridcycle",
+                     "hybridamul64"):
         # without x64, the float64 ShapeDtypeStructs canonicalize to f32
         # and the chunked-path gate (dtype == float64) never engages —
         # the check would silently validate a different program
@@ -146,7 +240,9 @@ def main():
 
         jax.config.update("jax_enable_x64", True)
     ok = {"kernel": check_kernel, "f64matvec": check_f64matvec,
-          "pcg": check_pcg, "hybridpcg": check_hybridpcg}[args.what](args)
+          "pcg": check_pcg, "hybridpcg": check_hybridpcg,
+          "hybridcycle": check_hybridcycle,
+          "hybridamul64": check_hybridamul64}[args.what](args)
     sys.exit(0 if ok else 1)
 
 
