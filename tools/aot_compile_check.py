@@ -206,11 +206,69 @@ def check_hybridamul64(args):
                             f"hybrid f64 amul octree {n0}^3/L4")
 
 
+def check_cubecycle(args):
+    """Chunked inner-cycle program for the STRUCTURED (cube) flagship —
+    the program bench.py compiles at 150^3 (10.33M dofs > 4M engages the
+    chunked path): warm resumable pcg over the slab stencil.  With
+    ``--dtype float32 --pallas on`` this is the v6-FUSED chunked cycle,
+    which has never been compiled anywhere (round 3 verified the fused
+    ONE-SHOT program only)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.models import make_cube_model
+    from pcg_mpi_solver_tpu.parallel.structured import (
+        StructuredOps, partition_structured)
+    from pcg_mpi_solver_tpu.solver.pcg import cold_carry, pcg
+
+    # topology FIRST, then pin the CPU backend: the cold_carry template
+    # below materializes REAL arrays, and an unpinned first array touch
+    # initializes the tunneled backend — hanging forever on a dead tunnel
+    s = _topo_sharding()
+    jax.config.update("jax_platforms", "cpu")
+    n = args.nx
+    dt = jnp.dtype(args.dtype)
+    model = make_cube_model(4, 4, 4)
+    sp = partition_structured(model, 1)
+    ops = dataclasses.replace(
+        StructuredOps.from_partition(sp, dot_dtype=jnp.float64,
+                                     use_pallas=args.pallas == "on"),
+        nxc=n, ny=n, nz=n)
+    nn = n + 1
+    n_loc = 3 * nn * nn * nn
+
+    def fn(x, ck, Ke, diag_ke, eff, weight, fext, inv_diag, carry, budget):
+        data = {"blocks": [{"ck": ck, "Ke": Ke, "diag_Ke": diag_ke}],
+                "eff": eff, "weight": weight}
+        res, c2 = pcg(ops, data, fext=fext, x0=carry["x"],
+                      inv_diag=inv_diag,
+                      tol=1e-5, max_iter=jnp.minimum(500, budget),
+                      glob_n_dof_eff=n_loc, max_iter_nominal=20000,
+                      carry_in=carry, return_carry=True,
+                      progress_window=150)
+        return res.x, c2, res.flag
+
+    sds = lambda shape, d: jax.ShapeDtypeStruct(shape, d, sharding=s)
+    carry = jax.tree_util.tree_map(
+        lambda a: sds(a.shape, a.dtype),
+        cold_carry(jnp.zeros((1, n_loc), dt), jnp.zeros((1, n_loc), dt),
+                   jnp.asarray(1.0, ops.dot_dtype), ops.dot_dtype))
+    shapes = [sds((1, n_loc), dt), sds((1, n, n, n), dt), sds((24, 24), dt),
+              sds((24,), dt), sds((1, n_loc), dt), sds((1, n_loc), dt),
+              sds((1, n_loc), dt), sds((1, n_loc), dt), carry,
+              sds((), jnp.int32)]
+    label = (f"{args.dtype} CHUNKED cycle"
+             + (" +pallas" if args.pallas == "on" else "") + f" {n}^3")
+    return _compile_structs(fn, shapes, label)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("what", choices=["kernel", "f64matvec", "pcg",
                                      "hybridpcg", "hybridcycle",
-                                     "hybridamul64"])
+                                     "hybridamul64", "cubecycle"])
     ap.add_argument("--variants", default="6,7")
     ap.add_argument("--nx", type=int, default=None,
                     help="cells per edge (default: 150; hybridpcg: 22 "
@@ -220,7 +278,7 @@ def main():
     ap.add_argument("--pallas", default="off", choices=["off", "on"],
                     help="pcg mode: engage the fused Pallas matvec")
     args = ap.parse_args()
-    if args.what in ("pcg",) and args.pallas == "on" \
+    if args.what in ("pcg", "cubecycle") and args.pallas == "on" \
             and args.dtype != "float32":
         # the pallas dispatch is f32-gated (structured.matvec_local);
         # with f64 inputs the flag would silently validate the XLA path
@@ -232,7 +290,7 @@ def main():
     # an accidental device touch would hang on a wedged tunnel
     os.environ.pop("JAX_PLATFORMS", None)
     if args.what in ("f64matvec", "pcg", "hybridpcg", "hybridcycle",
-                     "hybridamul64"):
+                     "hybridamul64", "cubecycle"):
         # without x64, the float64 ShapeDtypeStructs canonicalize to f32
         # and the chunked-path gate (dtype == float64) never engages —
         # the check would silently validate a different program
@@ -242,7 +300,8 @@ def main():
     ok = {"kernel": check_kernel, "f64matvec": check_f64matvec,
           "pcg": check_pcg, "hybridpcg": check_hybridpcg,
           "hybridcycle": check_hybridcycle,
-          "hybridamul64": check_hybridamul64}[args.what](args)
+          "hybridamul64": check_hybridamul64,
+          "cubecycle": check_cubecycle}[args.what](args)
     sys.exit(0 if ok else 1)
 
 

@@ -55,21 +55,29 @@ def main():
     # 2. Per-op split while the grant is clean (owed since wave 1).
     run_step(path, "iteration breakdown",
              ["examples/bench_iter_breakdown.py", "150"], timeout=2400)
+    # bench.py's internal wall budget (default 1680 s, sized for the
+    # round-end driver's ~1800 s window) must be widened to each wave
+    # step's ACTUAL timeout, or the watchdog would emit the provisional
+    # line mid-step with half the budget unused.
     # 3. Flagship cube (v6 probe live, progress exit on by default).
     run_step(path, "flagship (v6 probe, progress on)", ["bench.py"],
+             env_extra={"BENCH_WALL_BUDGET_S": "3480"},
              timeout=3600, force_gate=True)
     # 4. Progress-exit A/B at the only scale where it can pay.
     run_step(path, "flagship progress=0 A/B", ["bench.py"],
-             env_extra={"BENCH_PROGRESS": "0"}, timeout=3600)
+             env_extra={"BENCH_PROGRESS": "0",
+                        "BENCH_WALL_BUDGET_S": "3480"}, timeout=3600)
     # 5. Octree flagship (gather combine, halved compile after the
     # single-instantiation restructure).
     run_step(path, "octree flagship", ["bench.py"],
-             env_extra={"BENCH_MODEL": "octree"}, timeout=4800,
+             env_extra={"BENCH_MODEL": "octree",
+                        "BENCH_WALL_BUDGET_S": "4680"}, timeout=4800,
              force_gate=True)
     # 6. f64-direct anchor at the full 150^3 (program exonerated
     # chiplessly at 106 s; earlier failures were service weather).
     run_step(path, "f64 direct anchor 150", ["bench.py"],
-             env_extra={"BENCH_MODE": "direct", "BENCH_DTYPE": "float64"},
+             env_extra={"BENCH_MODE": "direct", "BENCH_DTYPE": "float64",
+                        "BENCH_WALL_BUDGET_S": "4680"},
              timeout=4800, force_gate=True)
     # 7/8. Remaining owed microbenchmarks.
     run_step(path, "hybrid breakdown",
