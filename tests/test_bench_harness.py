@@ -260,6 +260,80 @@ def test_sweep_stale_tmps(tmp_path):
     assert sorted(os.listdir(d)) == ["model_live.tmp"]
 
 
+class _FakeProv:
+    def __init__(self, line='{"metric": "m", "value": 1.0}'):
+        self._line = line
+        self.killed = False
+
+    def line(self, timeout_s=0.0):
+        return self._line
+
+    def kill(self):
+        self.killed = True
+
+
+def _orchestrate(monkeypatch, capsys, probe_ok, run_result, tmp_path,
+                 prov_line='{"metric": "m", "value": 1.0}'):
+    """Drive bench.main()'s orchestrator with the heavy pieces mocked."""
+    _clear_bench_env(monkeypatch)
+    monkeypatch.chdir(tmp_path)      # bench writes provisional files in cwd
+    monkeypatch.setenv("BENCH_WALL_BUDGET_S", "3600")
+    prov = _FakeProv(prov_line)
+    monkeypatch.setattr(bench, "_ProvisionalRun", lambda: prov)
+    monkeypatch.setattr(bench, "_probe_with_retry",
+                        lambda budget_s=None: (probe_ok, "mock"))
+    if isinstance(run_result, Exception):
+        def run(**kw):
+            raise run_result
+    else:
+        def run(**kw):
+            return run_result
+    monkeypatch.setattr(
+        bench, "_run_bench",
+        lambda cpu_fallback, provisional=False, deadline=None, emitter=None:
+        run())
+    bench.main()
+    return prov, capsys.readouterr().out.strip().splitlines()
+
+
+def test_orchestrator_tpu_success(monkeypatch, capsys, tmp_path):
+    """Probe ok + accelerator bench succeeds: ITS line is the one line on
+    stdout; the provisional subprocess is reaped."""
+    prov, out = _orchestrate(monkeypatch, capsys, True, '{"tpu": 1}',
+                             tmp_path)
+    assert out == ['{"tpu": 1}']
+    assert prov.killed
+
+
+def test_orchestrator_probe_dead_emits_provisional(monkeypatch, capsys,
+                                                   tmp_path):
+    prov, out = _orchestrate(monkeypatch, capsys, False, '{"tpu": 1}',
+                             tmp_path)
+    assert out == ['{"metric": "m", "value": 1.0}']
+
+
+def test_orchestrator_bench_crash_emits_provisional(monkeypatch, capsys,
+                                                    tmp_path):
+    """Accelerator path dies AFTER a good probe (tunnel death mid-solve):
+    the provisional line still lands, exit stays clean."""
+    prov, out = _orchestrate(monkeypatch, capsys, True,
+                             RuntimeError("tunnel died"), tmp_path)
+    assert out == ['{"metric": "m", "value": 1.0}']
+
+
+def test_orchestrator_everything_dead_emits_sentinel(monkeypatch, capsys,
+                                                     tmp_path):
+    """No provisional AND no accelerator: the labeled zero-value sentinel
+    is still exactly one parseable line."""
+    import json
+
+    prov, out = _orchestrate(monkeypatch, capsys, False,
+                             '{"tpu": 1}', tmp_path, prov_line=None)
+    assert len(out) == 1
+    d = json.loads(out[0])
+    assert d["value"] == 0.0 and "error" in d["detail"]
+
+
 def test_model_cache_eviction(tmp_path):
     """LRU eviction keeps the cache under the cap, never deletes the
     just-written entry, and evicts oldest-mtime first."""
