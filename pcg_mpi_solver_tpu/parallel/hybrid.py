@@ -65,6 +65,8 @@ class LevelGrid:
     """
 
     size: int                   # cell edge length in finest lattice units
+                                # (0 = merged multi-size batch, see
+                                # PCG_TPU_HYBRID_MERGE in partition_hybrid)
     nb: int                     # blocks per part (common, padded)
     bx: int                     # per-BLOCK cell dims
     by: int
@@ -200,18 +202,48 @@ def partition_hybrid(model: ModelData, n_parts: int,
     P = n_parts
     lib = model.elem_lib[bt]
     bs_knob = int(os.environ.get("PCG_TPU_HYBRID_BLOCK", "8"))
-    levels: List[LevelGrid] = []
-    for s in sorted(int(v) for v in np.unique(leaves[brick, 3])):
+    # PCG_TPU_HYBRID_MERGE (default OFF): give EVERY level the same tile
+    # dims and merge all levels into ONE block batch after the loop —
+    # legal because the stencil math is size-independent (level size
+    # enters only through nidx and ck), and slot numbering is the same
+    # level-order concatenation CombineMaps already uses.  Measured
+    # chiplessly at the 5.67M-dof flagship (2026-07-31): the merge makes
+    # COMPILE WORSE, not better (inner-cycle 473 -> 551 s, f64 amul
+    # 999 -> 1328 s — the larger uniform batch outweighs the removed
+    # per-level unroll), so it stays an off-by-default runtime A/B
+    # candidate (1 launch vs 5 per matvec; parity-asserted in
+    # tests/test_hybrid.py::test_merged_levels_match_unmerged).
+    merge = os.environ.get("PCG_TPU_HYBRID_MERGE", "0") == "1"
+    sizes = sorted(int(v) for v in np.unique(leaves[brick, 3]))
+    level_sel = []
+    for s in sizes:
         sel_lvl = brick & (leaves[:, 3] == s)
-        per_part = [np.where(sel_lvl & (elem_part == p))[0] for p in range(P)]
+        per_part = [np.where(sel_lvl & (elem_part == p))[0]
+                    for p in range(P)]
         # level-unit cell coords (octree cells of size s are s-aligned)
         lat = [leaves[e, :3] // s for e in per_part]
-
+        level_sel.append((s, per_part, lat))
+    bs_eff = bs_knob
+    if merge:
+        # shared tile edge: cap the knob by the largest per-part level
+        # extent so a force-dense setting (e.g. 10^6) cannot allocate an
+        # astronomically-sized tile
+        max_ext = 1
+        for s, per_part, lat in level_sel:
+            for p in range(P):
+                if len(per_part[p]):
+                    e = lat[p].max(axis=0) - lat[p].min(axis=0) + 1
+                    max_ext = max(max_ext, int(e.max()))
+        bs_eff = min(bs_knob, max_ext)
+    levels: List[LevelGrid] = []
+    for s, per_part, lat in level_sel:
         # choose this level's block dims: a single dense bbox block when
         # that is no larger than the bs^3 tiling would be, else bs^3
         # tiles of only the occupied blocks (absolute bs-aligned ids, so
         # dims stay common across parts).  One key-sort per part serves
-        # both the decision and the fill below.
+        # both the decision and the fill below.  Under merge, EVERY
+        # level tiles at the shared bs_eff edge.
+        bs_lvl = bs_eff if merge else bs_knob
         ext = np.zeros(3, dtype=np.int64)
         bmax = 1
         blocks = [None] * P      # (uniq_block_keys, binv) per part
@@ -220,7 +252,7 @@ def partition_hybrid(model: ModelData, n_parts: int,
                 continue
             lo_p = lat[p].min(axis=0)
             ext = np.maximum(ext, lat[p].max(axis=0) + 1 - lo_p)
-            bid = lat[p] // bs_knob
+            bid = lat[p] // bs_lvl
             uniq, binv = np.unique(
                 (bid[:, 0] << 42) + (bid[:, 1] << 21) + bid[:, 2],
                 return_inverse=True)
@@ -231,11 +263,11 @@ def partition_hybrid(model: ModelData, n_parts: int,
         # the dense layout allocates prod(ext) of the COMMON (padded)
         # extents for every part — that, not any single part's bbox, is
         # what tiling competes against
-        if int(np.prod(ext)) <= bmax * bs_knob ** 3:
+        if not merge and int(np.prod(ext)) <= bmax * bs_knob ** 3:
             nb, (bx, by, bz) = 1, (int(ext[0]), int(ext[1]), int(ext[2]))
             tiled = False
         else:
-            nb, (bx, by, bz) = bmax, (bs_knob,) * 3
+            nb, (bx, by, bz) = bmax, (bs_lvl,) * 3
             tiled = True
 
         ck = np.zeros((P, nb, bx, by, bz))
@@ -256,7 +288,7 @@ def partition_hybrid(model: ModelData, n_parts: int,
                 uniq, binv = blocks[p]
                 blk_origin = np.stack([uniq >> 42, (uniq >> 21) & ((1 << 21) - 1),
                                        uniq & ((1 << 21) - 1)],
-                                      axis=-1) * bs_knob      # (B_p, 3)
+                                      axis=-1) * bs_lvl       # (B_p, 3)
                 c = lat[p] - blk_origin[binv]
             else:
                 blk_origin = lat[p].min(axis=0)[None]          # (1, 3)
@@ -284,6 +316,20 @@ def partition_hybrid(model: ModelData, n_parts: int,
         levels.append(LevelGrid(size=s, nb=nb, bx=bx, by=by, bz=bz,
                                 origin=origin, ck=ck, ce=ce,
                                 nidx=nidx, n_cells=n_cells))
+
+    if merge and len(levels) > 1:
+        # one block batch for the whole octree (size=0 marks the merged
+        # multi-size batch; per-cell sizes live on in nidx/ck).  Slot
+        # order after concatenation equals the level-order flattening
+        # CombineMaps uses, so the maps below see identical numbering.
+        cat = lambda attr: np.concatenate(
+            [getattr(lv, attr) for lv in levels], axis=1)
+        levels = [LevelGrid(
+            size=0, nb=sum(lv.nb for lv in levels),
+            bx=levels[0].bx, by=levels[0].by, bz=levels[0].bz,
+            origin=cat("origin"), ck=cat("ck"), ce=cat("ce"),
+            nidx=cat("nidx"),
+            n_cells=np.sum([lv.n_cells for lv in levels], axis=0))]
 
     return HybridPartition(
         pm=pm,

@@ -228,6 +228,48 @@ def test_reconstruct_declines_non_lattice(model):
     assert m.octree is None and m.grid is None
 
 
+def test_merged_levels_match_unmerged(model):
+    """PCG_TPU_HYBRID_MERGE (default on) folds all level grids into ONE
+    block batch — the matvec, diagonal, node blocks and strain must be
+    identical to the per-level layout, and the merged partition must
+    carry exactly one level."""
+    import os
+
+    from pcg_mpi_solver_tpu.parallel.partition import make_elem_part
+
+    ep = make_elem_part(model, 2, method="rcb")
+    prev = os.environ.get("PCG_TPU_HYBRID_MERGE")
+    try:
+        os.environ["PCG_TPU_HYBRID_MERGE"] = "0"
+        hp_u = partition_hybrid(model, 2, elem_part=ep)
+        os.environ["PCG_TPU_HYBRID_MERGE"] = "1"
+        hp_m = partition_hybrid(model, 2, elem_part=ep)
+    finally:
+        if prev is None:
+            os.environ.pop("PCG_TPU_HYBRID_MERGE", None)
+        else:
+            os.environ["PCG_TPU_HYBRID_MERGE"] = prev
+    assert len(hp_u.levels) > 1
+    assert len(hp_m.levels) == 1 and hp_m.levels[0].size == 0
+    assert (sum(int(lv.n_cells.sum()) for lv in hp_u.levels)
+            == int(hp_m.levels[0].n_cells.sum()))
+    ops_u = HybridOps.from_hybrid(hp_u)
+    ops_m = HybridOps.from_hybrid(hp_m)
+    data_u = device_data_hybrid(hp_u)
+    data_m = device_data_hybrid(hp_m)
+    rng = np.random.default_rng(21)
+    x = jnp.asarray(rng.standard_normal((2, hp_u.pm.n_loc)))
+    y_u = np.asarray(ops_u.matvec_local(data_u, x))
+    y_m = np.asarray(ops_m.matvec_local(data_m, x))
+    assert np.abs(y_m - y_u).max() / np.abs(y_u).max() < 1e-12
+    d_u = np.asarray(ops_u.diag_local(data_u))
+    d_m = np.asarray(ops_m.diag_local(data_m))
+    assert np.abs(d_m - d_u).max() / np.abs(d_u).max() < 1e-12
+    b_u = np.asarray(ops_u._node_block_local(data_u))
+    b_m = np.asarray(ops_m._node_block_local(data_m))
+    assert np.abs(b_m - b_u).max() / (np.abs(b_u).max() + 1e-30) < 1e-12
+
+
 def test_combine_gather_matches_scatter(pair):
     """The scatter-free gather-combine (default) vs the row scatter —
     identical matvec and diag up to f64 summation-order noise."""
@@ -330,7 +372,11 @@ def test_tiled_blocks_match_dense(model):
 
     ep = make_elem_part(model, 2, method="rcb")
     prev = os.environ.get("PCG_TPU_HYBRID_BLOCK")
+    prev_m = os.environ.get("PCG_TPU_HYBRID_MERGE")
     try:
+        # this test exercises the per-level dense-vs-tiled machinery; the
+        # level merge (tested separately) would fold both into one batch
+        os.environ["PCG_TPU_HYBRID_MERGE"] = "0"
         os.environ["PCG_TPU_HYBRID_BLOCK"] = "1000000"   # force dense
         hp_d = partition_hybrid(model, 2, elem_part=ep)
         os.environ["PCG_TPU_HYBRID_BLOCK"] = "2"         # force tiling
@@ -340,6 +386,10 @@ def test_tiled_blocks_match_dense(model):
             os.environ.pop("PCG_TPU_HYBRID_BLOCK", None)
         else:
             os.environ["PCG_TPU_HYBRID_BLOCK"] = prev
+        if prev_m is None:
+            os.environ.pop("PCG_TPU_HYBRID_MERGE", None)
+        else:
+            os.environ["PCG_TPU_HYBRID_MERGE"] = prev_m
     assert all(lv.nb == 1 for lv in hp_d.levels)
     assert any(lv.nb > 1 for lv in hp_t.levels), (
         "tiling did not engage — the tiled path is untested")
