@@ -107,8 +107,9 @@ def main():
     # sessions should skip straight to the candidates.
     import os
 
-    only = [v for v in os.environ.get("BENCH_MATVEC_VARIANTS", "").split(",")
-            if v]
+    only = [v.strip() for v in
+            os.environ.get("BENCH_MATVEC_VARIANTS", "").split(",")
+            if v.strip()]
     if only:
         variants = [(n, f) for n, f in variants
                     if any(f"pallas {v} " in n + " " or n.endswith(v)

@@ -544,15 +544,21 @@ class _ProvisionalRun:
         self._line = None
         self._got = threading.Event()
         try:
+            logf = open("bench_fallback.log", "w")
+        except OSError:
+            logf = subprocess.DEVNULL
+        try:
             self._proc = subprocess.Popen(
                 [sys.executable, "-m", "pcg_mpi_solver_tpu.bench"],
-                env=env, stdout=subprocess.PIPE,
-                stderr=open("bench_fallback.log", "w"), text=True)
+                env=env, stdout=subprocess.PIPE, stderr=logf, text=True)
         except OSError as e:
             _log(f"# provisional launch failed ({e}); no fast fallback")
             self._proc = None
             self._got.set()
             return
+        finally:
+            if logf is not subprocess.DEVNULL:
+                logf.close()    # the child holds its own descriptor
         threading.Thread(target=self._reader, daemon=True).start()
 
     def _reader(self):
@@ -614,44 +620,54 @@ def main():
              "line and exiting")
         emitter.emit()
         sys.stdout.flush()
+        prov.kill()
         os._exit(0)
 
     threading.Thread(target=watchdog, daemon=True).start()
 
-    probe_budget = min(float(os.environ.get("BENCH_PROBE_BUDGET_S", 600)),
-                       max(0.0, deadline - time.monotonic() - 360.0))
-    ok, detail = _probe_with_retry(budget_s=probe_budget)
-    if not ok:
-        if os.environ.get("BENCH_CPU_FALLBACK", "1") != "1":
-            _log(f"# FATAL: {detail}\n# No perf number can be produced "
-                 "from this host.")
-            sys.exit(3)
-        _log(f"# accelerator unreachable after probe budget: {detail}\n"
-             "# emitting the CPU provisional line (clearly labeled; NOT "
-             "the TPU north-star number)")
-        ln = prov.line(timeout_s=max(5.0, deadline - time.monotonic() - 60.0))
-        emitter.emit(ln if ln is not None
-                     else _error_line(f"accelerator unreachable ({detail}) "
-                                      "and CPU provisional failed"))
-        return
-
+    # every exit path below must reap the provisional child: an orphaned
+    # 24^3 solve would keep burning the 1-core host's only CPU under
+    # whatever the external driver runs next
     try:
-        line = _run_bench(cpu_fallback=False, deadline=deadline,
-                          emitter=emitter)
-    except SystemExit:
-        raise
-    except Exception as e:                              # noqa: BLE001
-        _log(f"# accelerator bench failed ({type(e).__name__}: {e}); "
-             "emitting the CPU provisional line")
-        ln = prov.line(timeout_s=max(5.0, deadline - time.monotonic() - 60.0))
-        emitter.emit(ln if ln is not None
-                     else _error_line(f"accelerator bench failed "
-                                      f"({type(e).__name__}: {e}) and CPU "
-                                      "provisional failed"))
-        return
+        probe_budget = min(
+            float(os.environ.get("BENCH_PROBE_BUDGET_S", 600)),
+            max(0.0, deadline - time.monotonic() - 360.0))
+        ok, detail = _probe_with_retry(budget_s=probe_budget)
+        if not ok:
+            if os.environ.get("BENCH_CPU_FALLBACK", "1") != "1":
+                _log(f"# FATAL: {detail}\n# No perf number can be produced "
+                     "from this host.")
+                sys.exit(3)
+            _log(f"# accelerator unreachable after probe budget: {detail}\n"
+                 "# emitting the CPU provisional line (clearly labeled; NOT "
+                 "the TPU north-star number)")
+            ln = prov.line(
+                timeout_s=max(5.0, deadline - time.monotonic() - 60.0))
+            emitter.emit(ln if ln is not None
+                         else _error_line(
+                             f"accelerator unreachable ({detail}) "
+                             "and CPU provisional failed"))
+            return
+
+        try:
+            line = _run_bench(cpu_fallback=False, deadline=deadline,
+                              emitter=emitter)
+        except SystemExit:
+            raise
+        except Exception as e:                          # noqa: BLE001
+            _log(f"# accelerator bench failed ({type(e).__name__}: {e}); "
+                 "emitting the CPU provisional line")
+            ln = prov.line(
+                timeout_s=max(5.0, deadline - time.monotonic() - 60.0))
+            emitter.emit(ln if ln is not None
+                         else _error_line(
+                             f"accelerator bench failed "
+                             f"({type(e).__name__}: {e}) and CPU "
+                             "provisional failed"))
+            return
+        emitter.emit(line)
     finally:
         prov.kill()
-    emitter.emit(line)
 
 
 def _run_bench(cpu_fallback, provisional=False, deadline=None, emitter=None):
@@ -750,11 +766,16 @@ def _run_bench(cpu_fallback, provisional=False, deadline=None, emitter=None):
     if emitter is not None:
         emitter.offer(const_line, rank=2)   # the watchdog's fallback is
         #                                     now a REAL accelerator line
-    try:
-        with open("bench_provisional.json", "w") as f:
-            f.write(const_line + "\n")
-    except OSError:
-        pass
+    if not provisional:
+        # the fast-fallback SUBPROCESS must not write the salvage file:
+        # it shares the parent's cwd, and its tiny CPU line landing late
+        # would overwrite the parent's accelerator salvage line (stdout
+        # is the subprocess's only channel)
+        try:
+            with open("bench_provisional.json", "w") as f:
+                f.write(const_line + "\n")
+        except OSError:
+            pass
 
     if provisional:
         # the fast-fallback subprocess: the validated constant IS the
