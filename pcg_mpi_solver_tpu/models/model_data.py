@@ -75,7 +75,11 @@ class ModelData:
     # stencils, only transition cells stay on the gather/scatter path.
     #   {"leaves": (n_elem, 4) lattice origin+size in finest units,
     #    "dims": (X, Y, Z) finest-lattice extents,
-    #    "node_keys": sorted unique lattice keys of the mesh nodes,
+    #    "node_keys": (n_node,) lattice key of node id i at index i —
+    #                 ORDER UNSPECIFIED (models/octree.py generation
+    #                 happens to yield sorted keys; reconstruct_lattice_meta
+    #                 yields node-id order).  Consumers needing binary
+    #                 search must argsort first (as partition_hybrid does),
     #    "strides": (stride_y, stride_z) of the key encoding,
     #    "brick_type": type id of the pure 8-node pattern (or None),
     #    "brick_corners": (8, 3) corner offsets in that type's node order}

@@ -530,9 +530,7 @@ def pcg_mixed(
             # return_carry gives the EXECUTED body-iteration count: on
             # flag-3 exits inner.iters is the min-residual index, which
             # would both undercount the reported work and let the budget
-            # run past max_iter.  (inner itself is still the finalized
-            # min-residual result — finalize runs before the carry
-            # branch.)
+            # run past max_iter.
             inner, icarry = pcg(
                 ops32, data32,
                 fext=rhat32,
@@ -550,7 +548,25 @@ def pcg_mixed(
                 progress_ratio=progress_ratio,
                 progress_min_gain=progress_min_gain,
             )
-            return (inner.x.astype(fext.dtype) * normr,
+            # return_carry skips the min-residual finalize, so inner.x is
+            # the LAST iterate.  CG's residual is non-monotone: on a
+            # non-converged exit (flag 3 from the progress/plateau exits,
+            # or budget flag 1) a spiked last iterate hands the f64
+            # refresh a worse restart and can spuriously trip the 0.5x
+            # stalled guard.  Select the tracked min-residual iterate
+            # in-graph — normrmin/xmin ride the carry.  Unlike
+            # select_best (the chunked path's finalize, which recomputes
+            # xmin's TRUE residual), this trusts the recurrence-tracked
+            # norms: one more stencil instantiation here would cost
+            # minutes of compile at octree scale for a tie-break that
+            # the outer loop immediately re-evaluates anyway — the next
+            # trip's f64 refresh computes the true residual of whichever
+            # iterate wins, and the 0.5x stalled guard bounds the damage
+            # of a drift-optimistic pick.
+            use_min = (inner.flag != 0) & (
+                icarry["normrmin"] < icarry["normr_act"])
+            xbest = jnp.where(use_min, icarry["xmin"], inner.x)
+            return (xbest.astype(fext.dtype) * normr,
                     jnp.maximum(icarry["exec"], 1), inner.flag)
 
         def skip_inner(args):

@@ -229,7 +229,8 @@ def test_reconstruct_declines_non_lattice(model):
 
 
 def test_merged_levels_match_unmerged(model):
-    """PCG_TPU_HYBRID_MERGE (default on) folds all level grids into ONE
+    """PCG_TPU_HYBRID_MERGE (default OFF: measured compile-negative,
+    docs/BENCH_LOG.md) folds all level grids into ONE
     block batch — the matvec, diagonal, node blocks and strain must be
     identical to the per-level layout, and the merged partition must
     carry exactly one level."""
