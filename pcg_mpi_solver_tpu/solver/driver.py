@@ -173,6 +173,12 @@ class Solver:
         # trace time); the checkpoint fingerprint must record what this
         # solver actually compiled, not the env at save() time.
         self.pallas_variant = "off"
+        # f64-refresh formulation (hybrid+mixed only; see the hybrid
+        # branch below).  Recorded in the checkpoint fingerprint: the
+        # general form's summation order differs, which can drift
+        # refresh residuals in the last bits.
+        self.f64_refresh = "stencil"
+        self._refresh64_src = None
         if backend == "structured" and not can_structured:
             raise ValueError("structured backend requested but model/partition "
                              "layout does not allow it")
@@ -219,6 +225,27 @@ class Solver:
             from pcg_mpi_solver_tpu.parallel.hybrid import (
                 hybrid_pallas_enabled)
 
+            # PCG_TPU_HYBRID_F64_REFRESH=general: run the out-of-loop f64
+            # matvecs (Dirichlet lifting, r0, refinement true-residual)
+            # through a full GENERAL element gather/scatter partition
+            # instead of the f64 level-grid stencils.  The stencil f64
+            # amul is the octree flagship's single largest compile
+            # (999 s chipless, docs/BENCH_LOG.md 2026-07-31) while its
+            # runtime advantage is irrelevant at ~4 calls/solve; the
+            # general form adds only the brick type block to einsum
+            # structures the hybrid matvec compiles anyway.  Needs the
+            # SAME elem_part so the local dof numbering is identical
+            # (partition_model's numbering is block_filter-independent).
+            self.f64_refresh = "stencil"
+            if self.mixed and os.environ.get(
+                    "PCG_TPU_HYBRID_F64_REFRESH", "stencil") == "general":
+                self.f64_refresh = "general"
+                if elem_part is None:
+                    from pcg_mpi_solver_tpu.parallel.partition import (
+                        make_elem_part)
+
+                    elem_part = make_elem_part(
+                        model, n_parts, method=self.config.partition_method)
             self.pm = partition_hybrid(model, n_parts, elem_part=elem_part,
                                        method=self.config.partition_method)
             use_pallas = hybrid_pallas_enabled(
@@ -240,6 +267,20 @@ class Solver:
                 self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS,
                 use_pallas=use_pallas, n_local_parts=lp,
                 pallas_interpret=interp)
+            if self.f64_refresh == "general":
+                pm_full = partition_model(model, n_parts,
+                                          elem_part=elem_part)
+                if not (pm_full.n_loc == self.pm.n_loc
+                        and np.array_equal(pm_full.node_gid,
+                                           self.pm.node_gid)):
+                    raise RuntimeError(
+                        "general-refresh partition numbering diverged "
+                        "from the hybrid partition (same elem_part must "
+                        "yield identical local dof layouts)")
+                self._refresh64_src = (
+                    Ops.from_model(pm_full, dot_dtype=jnp.float64,
+                                   axis_name=PARTS_AXIS),
+                    device_data(pm_full, jnp.float64))
         else:
             self.pm = partition_model(model, n_parts, elem_part=elem_part,
                                       method=self.config.partition_method)
@@ -265,6 +306,13 @@ class Solver:
         from pcg_mpi_solver_tpu.parallel.distributed import put_tree
 
         self.data = put_tree(data, self.mesh, self._specs)
+        self._refresh64 = None
+        if self._refresh64_src is not None:
+            rops, rdata = self._refresh64_src
+            rspecs = _data_specs(rdata)
+            self._refresh64 = (rops, put_tree(rdata, self.mesh, rspecs),
+                               rspecs)
+            self._refresh64_src = None      # free the host copies
 
         self._part_spec = jax.sharding.PartitionSpec(PARTS_AXIS)
         self._rep_spec = jax.sharding.PartitionSpec()
@@ -380,13 +428,30 @@ class Solver:
         # 2026-07-31) — the old single _start program alone instantiated
         # it twice.  The cost is a couple of unfused vector round-trips
         # per STEP/cycle (micro-ms at 10M dofs), not per iteration.
-        def _amul64(data, v):
-            d = data["f64"] if mixed else data
-            return d["eff"] * self.ops.matvec(d, v)
+        if self._refresh64 is not None:
+            # PCG_TPU_HYBRID_F64_REFRESH=general: same contract
+            # ((data, v) -> eff * K.v in f64), different operator
+            # formulation — element gather/scatter over the full general
+            # partition (identical dof layout; asserted at build).  The
+            # passed-in data tree is ignored in favor of the refresh
+            # tree; callers keep one signature either way.
+            rops, rdev, rspecs = self._refresh64
 
-        self._amul64_fn = jax.jit(jax.shard_map(
-            _amul64, mesh=self.mesh, in_specs=(self._specs, P),
-            out_specs=P, check_vma=False))
+            def _amul64g(rd, v):
+                return rd["eff"] * rops.matvec(rd, v)
+
+            amul64g_jit = jax.jit(jax.shard_map(
+                _amul64g, mesh=self.mesh, in_specs=(rspecs, P),
+                out_specs=P, check_vma=False))
+            self._amul64_fn = lambda data, v: amul64g_jit(rdev, v)
+        else:
+            def _amul64(data, v):
+                d = data["f64"] if mixed else data
+                return d["eff"] * self.ops.matvec(d, v)
+
+            self._amul64_fn = jax.jit(jax.shard_map(
+                _amul64, mesh=self.mesh, in_specs=(self._specs, P),
+                out_specs=P, check_vma=False))
 
         def _start_pre(data, delta):
             data64 = data["f64"] if mixed else data

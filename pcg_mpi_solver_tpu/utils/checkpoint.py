@@ -95,6 +95,10 @@ def _fingerprint(solver) -> dict:
         # frozen in the partition's built maps
         "combine": getattr(solver.ops, "combine", "n/a"),
         "combine_kd": _combine_kd(solver),
+        # the general-form f64 refresh (hybrid+mixed) reorders the
+        # refresh-residual summation — pinned on the solver at
+        # construction like the kernel variant
+        "f64_refresh": getattr(solver, "f64_refresh", "stencil"),
     }
 
 
@@ -235,6 +239,9 @@ class CheckpointManager:
                                     else "n/a")
                 saved["combine_kd"] = "n/a" if saved["combine"] == "n/a" \
                     else want["combine_kd"]
+            # pre-f64_refresh checkpoints can only have come from the
+            # stencil formulation (the general form did not exist)
+            saved.setdefault("f64_refresh", "stencil")
             if saved != want:
                 diffs = {k: (saved.get(k), want[k]) for k in want
                          if saved.get(k) != want[k]}

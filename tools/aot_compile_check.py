@@ -206,6 +206,42 @@ def check_hybridamul64(args):
                             f"hybrid f64 amul octree {n0}^3/L4")
 
 
+def check_genamul64(args):
+    """Compile the GENERAL-form f64 amul at the flagship octree partition
+    (PCG_TPU_HYBRID_F64_REFRESH=general, driver _amul64g) — the
+    compile-cost alternative to the 999 s stencil amul above (VERDICT
+    r04 next #8).  Same elem_part/numbering as the hybrid partition."""
+    import jax
+    import jax.numpy as jnp
+
+    s = _topo_sharding()
+    jax.config.update("jax_platforms", "cpu")
+
+    from pcg_mpi_solver_tpu.bench import cached_model
+    from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+    from pcg_mpi_solver_tpu.parallel.partition import partition_model
+
+    n0 = args.nx if args.nx is not None else 22
+    model = cached_model("octree", nx0=n0, ny0=n0, nz0=n0,
+                         max_level=4, n_incl=6, seed=2, E=30e9, nu=0.2,
+                         load="traction", load_value=1e6)
+    t0 = time.perf_counter()
+    pm = partition_model(model, 1)
+    ops = Ops.from_model(pm, dot_dtype=jnp.float64)
+    data = device_data(pm, jnp.float64)
+    print(f"# octree {model.n_dof} dofs, {len(pm.type_blocks)} type "
+          f"blocks (partition {time.perf_counter()-t0:.0f}s)", flush=True)
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s), data)
+
+    def fn(data, v):
+        return data["eff"] * ops.matvec(data, v)
+
+    vec = jax.ShapeDtypeStruct((1, pm.n_loc), jnp.float64, sharding=s)
+    return _compile_structs(fn, [structs, vec],
+                            f"GENERAL f64 amul octree {n0}^3/L4")
+
+
 def check_cubecycle(args):
     """Chunked inner-cycle program for the STRUCTURED (cube) flagship —
     the program bench.py compiles at 150^3 (10.33M dofs > 4M engages the
@@ -268,7 +304,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("what", choices=["kernel", "f64matvec", "pcg",
                                      "hybridpcg", "hybridcycle",
-                                     "hybridamul64", "cubecycle"])
+                                     "hybridamul64", "genamul64",
+                                     "cubecycle"])
     ap.add_argument("--variants", default="6,7")
     ap.add_argument("--nx", type=int, default=None,
                     help="cells per edge (default: 150; hybridpcg: 22 "
@@ -284,13 +321,13 @@ def main():
         # with f64 inputs the flag would silently validate the XLA path
         ap.error("--pallas on requires --dtype float32")
     if args.nx is None and args.what not in ("hybridpcg", "hybridcycle",
-                                             "hybridamul64"):
+                                             "hybridamul64", "genamul64"):
         args.nx = 150
     # never touch the real backend: the topology API needs no client, and
     # an accidental device touch would hang on a wedged tunnel
     os.environ.pop("JAX_PLATFORMS", None)
     if args.what in ("f64matvec", "pcg", "hybridpcg", "hybridcycle",
-                     "hybridamul64", "cubecycle"):
+                     "hybridamul64", "genamul64", "cubecycle"):
         # without x64, the float64 ShapeDtypeStructs canonicalize to f32
         # and the chunked-path gate (dtype == float64) never engages —
         # the check would silently validate a different program
@@ -301,6 +338,7 @@ def main():
           "pcg": check_pcg, "hybridpcg": check_hybridpcg,
           "hybridcycle": check_hybridcycle,
           "hybridamul64": check_hybridamul64,
+          "genamul64": check_genamul64,
           "cubecycle": check_cubecycle}[args.what](args)
     sys.exit(0 if ok else 1)
 

@@ -42,14 +42,17 @@ MANIFEST = os.path.join(REPO, ".jax_cache_manifest.json")
 
 
 def _enable_cache():
+    """Returns the EFFECTIVE cache dir (a pre-exported
+    JAX_COMPILATION_CACHE_DIR wins — _listing must watch the dir entries
+    actually land in, not the default)."""
     import jax
 
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    eff = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", CACHE_DIR)
+    jax.config.update("jax_compilation_cache_dir", eff)
     # the marker compiles in ~1-3 s; without this it may fall under the
     # default 1 s persistence threshold and never be written at all
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return eff
 
 
 def _marker_fn(salt):
@@ -70,22 +73,22 @@ def _marker_fn(salt):
     return fn, (256, 256)
 
 
-def _listing():
+def _listing(cache_dir):
     try:
-        return sorted(os.listdir(CACHE_DIR))
+        return sorted(os.listdir(cache_dir))
     except OSError:
         return []
 
 
 def seed():
     """Chipless-compile the marker into the persistent cache."""
-    _enable_cache()
+    cache_dir = _enable_cache()
     import numpy as np
     import jax
     from jax.experimental import topologies
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-    before = _listing()
+    before = _listing(cache_dir)
     topo = topologies.get_topology_desc(platform="tpu",
                                         topology_name="v5e:2x2")
     mesh = Mesh(np.array(topo.devices)[:1], ("x",))
@@ -98,7 +101,7 @@ def seed():
     jax.jit(fn).lower(
         jax.ShapeDtypeStruct(shape, "float32", sharding=s)).compile()
     wall = time.perf_counter() - t0
-    after = _listing()
+    after = _listing(cache_dir)
     new = sorted(set(after) - set(before))
     with open(MANIFEST, "w") as f:
         json.dump({"seeded_at_utc": time.strftime(
@@ -118,7 +121,7 @@ def seed():
 
 def check():
     """Live session: compile the marker remotely, compare cache entries."""
-    _enable_cache()
+    cache_dir = _enable_cache()
     from pcg_mpi_solver_tpu.bench import _probe_with_retry
 
     ok, detail = _probe_with_retry(budget_s=float(
@@ -139,13 +142,13 @@ def check():
               "first", flush=True)
         return 1
     missing = [e for e in man.get("marker_entries", [])
-               if e not in _listing()]
+               if e not in _listing(cache_dir)]
     if missing:
         print(f"ERROR: seeded marker entries missing from the cache dir "
               f"({missing}) — .jax_cache was cleared since the seed; "
               "re-seed before checking", flush=True)
         return 1
-    before = _listing()
+    before = _listing(cache_dir)
     fn, shape = _marker_fn(man["salt"])
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -158,7 +161,7 @@ def check():
     jax.jit(fn).lower(
         jax.ShapeDtypeStruct(shape, "float32", sharding=s)).compile()
     wall = time.perf_counter() - t0
-    new = sorted(set(_listing()) - set(before))
+    new = sorted(set(_listing(cache_dir)) - set(before))
     print(f"# marker compile {wall:.1f}s; new cache entries: {new}; "
           f"seeded marker entries: {man.get('marker_entries')}", flush=True)
     if new:
@@ -167,7 +170,7 @@ def check():
         # and report a false MATCH
         for e in new:
             try:
-                os.remove(os.path.join(CACHE_DIR, e))
+                os.remove(os.path.join(cache_dir, e))
             except OSError:
                 pass
         print("CACHE_KEY_MISMATCH: the remote backend keyed the marker "
@@ -176,8 +179,30 @@ def check():
               "compiles (same-session retries still hit the entries this "
               "session writes)", flush=True)
         return 4
-    print("CACHE_KEY_MATCH: remote compile hit the chipless-seeded entry — "
-          "pre-warmed flagship programs should load in seconds", flush=True)
+    # 'no new entry' only means MATCH if this backend's cache WRITES are
+    # actually landing where we look — prove it with a second,
+    # never-seeded probe program (salt+1).  A silently-failing write
+    # (full disk, unwritable dir, redirected path) would otherwise fake
+    # the exact 'pre-warming works' verdict this tool exists to refute.
+    probe_fn, shape = _marker_fn(man["salt"] + 1.0)
+    before2 = _listing(cache_dir)
+    jax.jit(probe_fn).lower(
+        jax.ShapeDtypeStruct(shape, "float32", sharding=s)).compile()
+    probe_new = sorted(set(_listing(cache_dir)) - set(before2))
+    for e in probe_new:
+        try:
+            os.remove(os.path.join(cache_dir, e))
+        except OSError:
+            pass
+    if not probe_new:
+        print("CACHE_WRITE_BROKEN: the unseeded probe compile produced no "
+              "cache entry — writes are not landing in "
+              f"{cache_dir}; the marker's apparent hit proves nothing. "
+              "Treat pre-warmed entries as absent.", flush=True)
+        return 1
+    print("CACHE_KEY_MATCH: remote compile hit the chipless-seeded entry "
+          "(and cache writes verified live) — pre-warmed flagship "
+          "programs should load in seconds", flush=True)
     return 0
 
 
