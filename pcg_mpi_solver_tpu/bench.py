@@ -405,7 +405,7 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
          f"parts={n_parts} dtype={dtype} mode={mode} backend={backend}")
 
     solver_kw = {}
-    if "BENCH_PROGRESS" in os.environ:   # override the default-on knob
+    if "BENCH_PROGRESS" in os.environ:   # override the SolverConfig default
         solver_kw["mixed_progress_window"] = int(os.environ["BENCH_PROGRESS"])
     cfg = RunConfig(
         solver=SolverConfig(tol=tol, max_iter=20000, dtype=dtype,

@@ -37,17 +37,22 @@ class SolverConfig:
     # cube) and each premature restart discards the Krylov space.  Enable
     # only with an on-hardware A/B at the target scale.
     mixed_plateau_window: int = 0
-    # Mixed mode only, DEFAULT ON: progress-RATE exit for f32 inner
+    # Mixed mode only, default OFF (0): progress-RATE exit for f32 inner
     # cycles.  Every `mixed_progress_window` iterations the MONOTONE
     # minimal residual is compared to a window ago; if the window
     # contracted it by less than 1/mixed_progress_ratio AND the cycle has
     # already contracted the (normalized) rhs by mixed_progress_min_gain,
-    # the cycle exits to the f64 refinement restart — the observed
-    # f32-floor grind (670 wasted stagnation iterations at 10.33M dofs,
-    # docs/BENCH_LOG.md) is worth less than one f64 matvec.  Unlike the
-    # plateau knob, the min-gain gate makes pre-asymptotic (healthy)
-    # plateaus unreachable, so small solves are unaffected.  0 disables.
-    mixed_progress_window: int = 150
+    # the cycle exits to the f64 refinement restart.  The design target
+    # was the f32-floor grind at 10.33M dofs (docs/BENCH_LOG.md), but the
+    # first A/B at a scale where the exit actually FIRES measured it
+    # NEGATIVE: 96^3 / 2.74M dofs mixed, window 150: 2486 total
+    # iterations vs 2009 with the exit off (+24% — premature restarts
+    # discard more Krylov progress than the grind they cut), identical
+    # convergence otherwise; at 64^3 / 824k dofs the exit never fires
+    # (bit-identical).  2026-08-01, examples/bench_progress_ab.py.
+    # Kept as an opt-in knob for an on-hardware A/B at the true flagship
+    # scale (BENCH_PROGRESS=150), where the floor-grind geometry differs.
+    mixed_progress_window: int = 0
     mixed_progress_ratio: float = 0.7
     mixed_progress_min_gain: float = 30.0
     # MATLAB-pcg compatibility knobs (pcg_solver.py:399-404)

@@ -36,7 +36,7 @@ from pcg_mpi_solver_tpu.parallel.partition import PartitionedModel
 
 
 def device_data(pm: PartitionedModel, dtype=jnp.float64,
-                flat: Optional[bool] = None) -> dict:
+                flat: Optional[bool] = None, blocks: bool = True) -> dict:
     """Pack a PartitionedModel into the device pytree the ops consume.
 
     All leaves have a leading parts axis P (shard it over the mesh), except
@@ -44,9 +44,12 @@ def device_data(pm: PartitionedModel, dtype=jnp.float64,
     ``flat`` controls whether the flat-scatter arrays (dof/scat_perm/
     scat_ids) are included; by default they are uploaded only when the
     node-ELL fast path is unavailable (they are dead weight otherwise).
+    ``blocks=False`` skips the per-type block arrays (for consumers that
+    bring their own operator structure, e.g. the bucketed refresh amul,
+    but still need the assembly/weight/load leaves).
     """
     if flat is None:
-        flat = pm.ell is None
+        flat = pm.ell is None and blocks
 
     def _blk(tb):
         b = {
@@ -74,8 +77,12 @@ def device_data(pm: PartitionedModel, dtype=jnp.float64,
         return b
 
     d = {
-        "blocks": [_blk(tb) for tb in pm.type_blocks],
-        "ell": jnp.asarray(pm.ell, jnp.int32) if pm.ell is not None else None,
+        "blocks": [_blk(tb) for tb in pm.type_blocks] if blocks else [],
+        # the ELL scatter map is only consumed by the blocks path
+        # (_scatter_rows); without blocks it would be ~1e8 int32 of dead
+        # HBM at flagship scale
+        "ell": (jnp.asarray(pm.ell, jnp.int32)
+                if pm.ell is not None and blocks else None),
         "iface_local": jnp.asarray(pm.iface_local, jnp.int32),
         "iface_slot": jnp.asarray(pm.iface_slot, jnp.int32),
         "niface_local": jnp.asarray(pm.niface_local, jnp.int32),
@@ -469,3 +476,95 @@ class Ops:
         loc = jnp.stack([self._local_dot(w, a, b) for a, b in pairs]
                         + [jnp.asarray(e, self.dot_dtype) for e in extra])
         return self._psum(loc)
+
+
+# ---------------------------------------------------------------------------
+# Bucketed matvec: a compile-cheap operator formulation for out-of-loop use.
+#
+# The per-type loop above emits one gather/einsum/scatter structure PER
+# pattern type; at the reference's deep-graded octrees that is 200+ types
+# (/root/reference/src/solver/partition_mesh.py:1074 allows <=144 per rank,
+# multi-part models exceed it globally), and measured chipless compile cost
+# tracks the emitted structure COUNT, not FLOPs (docs/BENCH_LOG.md
+# 2026-08-01: 227 type blocks -> 1343 s f64).  Here types of equal element
+# arity are STACKED into a few power-of-4-padded buckets: one batched
+# einsum per bucket (~8 structures instead of 227).  Padding wastes < 3x
+# the non-dominant types' elements — irrelevant for the ~4 calls/solve
+# refresh amul this exists for.  The scatter is an unordered at[].add
+# (bit-order differs from the type-loop path), so this formulation is for
+# paths WITHOUT a bit-exact iteration contract (the mixed-mode f64
+# refresh; never the direct/f64 parity path).
+
+def build_bucketed_blocks(pm: PartitionedModel, dtype=jnp.float64):
+    """Stack pm.type_blocks into padded same-shape buckets.
+
+    Returns a list of dicts {"Ke": (T, d, d), "node": (P, T, nn, Nmax),
+    "sign": (P, T, d, Nmax), "ck": (P, T, Nmax)} — parts axis LEADING on
+    the per-part arrays (the driver's _data_specs shards leaf axis 0).
+    Padded slots carry ck = 0 and node = n_node_loc (the gather's zero
+    row / the scatter's dropped out-of-bounds row)."""
+    if pm.ell is None:
+        raise ValueError("bucketed matvec requires the 3-dof node layout "
+                         "(PartitionedModel.ell)")
+    groups: dict = {}
+    for tb in pm.type_blocks:
+        if tb.d != 3 * tb.n_nodes:
+            raise ValueError(f"type {tb.type_id}: d={tb.d} is not "
+                             f"3*n_nodes={tb.n_nodes} — not node layout")
+        N = tb.node.shape[2]
+        size_cls = 0
+        while 4 ** (size_cls + 2) < N:      # buckets: N <= 16, 64, 256, ...
+            size_cls += 1
+        groups.setdefault((tb.d, tb.n_nodes, size_cls), []).append(tb)
+    buckets = []
+    for (d, nn, _cls), tbs in sorted(groups.items()):
+        P = tbs[0].node.shape[0]
+        nmax = max(tb.node.shape[2] for tb in tbs)
+        T = len(tbs)
+        Ke = np.stack([tb.Ke for tb in tbs])
+        node = np.full((P, T, nn, nmax), pm.n_node_loc, dtype=np.int32)
+        sign = np.zeros((P, T, d, nmax), dtype=bool)
+        ck = np.zeros((P, T, nmax))
+        for t, tb in enumerate(tbs):
+            n = tb.node.shape[2]
+            node[:, t, :, :n] = tb.node
+            sign[:, t, :, :n] = tb.sign
+            ck[:, t, :n] = tb.ck
+        buckets.append({"Ke": jnp.asarray(Ke, dtype),
+                        "node": jnp.asarray(node),
+                        "sign": jnp.asarray(sign),
+                        "ck": jnp.asarray(ck, dtype)})
+    return buckets
+
+
+def bucketed_matvec(ops: Ops, data: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """Assembled K.x via the bucketed blocks (data["buckets"] +
+    device_data(..., blocks=False) leaves).  Same contract as
+    Ops.matvec; summation order differs (see module note above)."""
+    Pn = x.shape[0]
+    nr = ops.n_node_loc + 1
+    x3p = jnp.concatenate(
+        [x.reshape(Pn, ops.n_node_loc, 3),
+         jnp.zeros((Pn, 1, 3), x.dtype)], axis=1).reshape(Pn * nr, 3)
+    offs = (jnp.arange(Pn, dtype=jnp.int32) * nr)[:, None, None, None]
+    y3 = jnp.zeros((Pn, ops.n_node_loc, 3), x.dtype)
+    for bkt in data["buckets"]:
+        node = bkt["node"]                              # (P, T, nn, Nmax)
+        _, T, nn, N = node.shape
+        u3 = jnp.take(x3p, (node + offs).reshape(-1), axis=0,
+                      mode="clip").reshape(Pn, T, nn, N, 3)
+        # dof-row order d = 3a + c, matching TypeBlock.sign's layout
+        u = u3.transpose(0, 1, 2, 4, 3).reshape(Pn, T, 3 * nn, N)
+        u = jnp.where(bkt["sign"], -u, u)
+        v = jnp.einsum("tde,pten->ptdn", bkt["Ke"],
+                       bkt["ck"][:, :, None, :] * u,
+                       precision=ops.precision)
+        v = jnp.where(bkt["sign"], -v, v)
+        rows = (v.reshape(Pn, T, nn, 3, N).transpose(0, 1, 2, 4, 3)
+                .reshape(Pn, T * nn * N, 3))
+        ids = node.reshape(Pn, T * nn * N)
+        y3 = jax.vmap(
+            lambda yp, ip, rp: yp.at[ip].add(rp, mode="drop"))(y3, ids, rows)
+    y = y3.reshape(Pn, ops.n_loc)
+    y = ops._apply_springs(data, x, y)
+    return ops.iface_assemble(data, y)

@@ -237,9 +237,9 @@ class Solver:
             # SAME elem_part so the local dof numbering is identical
             # (partition_model's numbering is block_filter-independent).
             self.f64_refresh = "stencil"
-            if self.mixed and os.environ.get(
-                    "PCG_TPU_HYBRID_F64_REFRESH", "stencil") == "general":
-                self.f64_refresh = "general"
+            _knob = os.environ.get("PCG_TPU_HYBRID_F64_REFRESH", "stencil")
+            if self.mixed and _knob in ("general", "bucketed"):
+                self.f64_refresh = _knob
                 if elem_part is None:
                     from pcg_mpi_solver_tpu.parallel.partition import (
                         make_elem_part)
@@ -267,7 +267,7 @@ class Solver:
                 self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS,
                 use_pallas=use_pallas, n_local_parts=lp,
                 pallas_interpret=interp)
-            if self.f64_refresh == "general":
+            if self.f64_refresh in ("general", "bucketed"):
                 pm_full = partition_model(model, n_parts,
                                           elem_part=elem_part)
                 if not (pm_full.n_loc == self.pm.n_loc
@@ -277,10 +277,19 @@ class Solver:
                         "general-refresh partition numbering diverged "
                         "from the hybrid partition (same elem_part must "
                         "yield identical local dof layouts)")
+                if self.f64_refresh == "bucketed":
+                    from pcg_mpi_solver_tpu.ops.matvec import (
+                        build_bucketed_blocks)
+
+                    rdata = device_data(pm_full, jnp.float64, blocks=False)
+                    rdata["buckets"] = build_bucketed_blocks(
+                        pm_full, jnp.float64)
+                else:
+                    rdata = device_data(pm_full, jnp.float64)
                 self._refresh64_src = (
                     Ops.from_model(pm_full, dot_dtype=jnp.float64,
                                    axis_name=PARTS_AXIS),
-                    device_data(pm_full, jnp.float64))
+                    rdata)
         else:
             self.pm = partition_model(model, n_parts, elem_part=elem_part,
                                       method=self.config.partition_method)
@@ -436,9 +445,14 @@ class Solver:
             # passed-in data tree is ignored in favor of the refresh
             # tree; callers keep one signature either way.
             rops, rdev, rspecs = self._refresh64
+            if self.f64_refresh == "bucketed":
+                from pcg_mpi_solver_tpu.ops.matvec import bucketed_matvec
 
-            def _amul64g(rd, v):
-                return rd["eff"] * rops.matvec(rd, v)
+                def _amul64g(rd, v):
+                    return rd["eff"] * bucketed_matvec(rops, rd, v)
+            else:
+                def _amul64g(rd, v):
+                    return rd["eff"] * rops.matvec(rd, v)
 
             amul64g_jit = jax.jit(jax.shard_map(
                 _amul64g, mesh=self.mesh, in_specs=(rspecs, P),

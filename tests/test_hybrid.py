@@ -385,6 +385,21 @@ def test_general_f64_refresh_matches_stencil(model, monkeypatch):
     np.testing.assert_allclose(u1, u0, rtol=1e-7,
                                atol=1e-9 * max(1.0, np.abs(u0).max()))
 
+    # bucketed arm: types stacked into a few padded batched einsums
+    # (compile-structure count ~8 instead of one per type)
+    monkeypatch.setenv("PCG_TPU_HYBRID_F64_REFRESH", "bucketed")
+    s2 = Solver(model, cfg, mesh=make_mesh(4), n_parts=4, backend="hybrid")
+    assert s2.f64_refresh == "bucketed"
+    y_bkt = np.asarray(s2._amul64_fn(s2.data, v))
+    np.testing.assert_allclose(
+        y_bkt, y_sten, rtol=1e-12,
+        atol=1e-12 * max(1.0, np.abs(y_sten).max()))
+    r2 = s2.step(1.0)
+    assert r2.flag == 0 and r2.relres <= 1e-8
+    u2 = np.asarray(s2.displacement_global())
+    np.testing.assert_allclose(u2, u0, rtol=1e-7,
+                               atol=1e-9 * max(1.0, np.abs(u0).max()))
+
 
 def test_mixed_precision_hybrid(model):
     cfg = RunConfig(

@@ -166,7 +166,7 @@ def check_hybridcycle(args):
             ops, data, rhat32, carry32["x"], prec32,
             tol=tol_cycle, max_iter=jnp.minimum(500, budget),
             glob_n_dof_eff=n_loc, max_iter_nominal=20000,
-            carry_in=carry32, return_carry=True, progress_window=150)
+            carry_in=carry32, return_carry=True, progress_window=0)
         return res.x, carry2, res.flag
 
     vec = jax.ShapeDtypeStruct((1, n_loc), jnp.float32, sharding=s)
@@ -204,6 +204,47 @@ def check_hybridamul64(args):
     vec = jax.ShapeDtypeStruct((1, n_loc), jnp.float64, sharding=s)
     return _compile_structs(fn, [structs, vec],
                             f"hybrid f64 amul octree {n0}^3/L4")
+
+
+def check_bktamul64(args):
+    """Compile the BUCKETED f64 amul at the flagship octree partition
+    (PCG_TPU_HYBRID_F64_REFRESH=bucketed): same operator as genamul64
+    but with the 200+ per-type structures stacked into a few padded
+    buckets — the compile-cost hypothesis is that cost tracks structure
+    count (general 1343 s vs stencil 999 s, BENCH_LOG 2026-08-01)."""
+    import jax
+    import jax.numpy as jnp
+
+    s = _topo_sharding()
+    jax.config.update("jax_platforms", "cpu")
+
+    from pcg_mpi_solver_tpu.bench import cached_model
+    from pcg_mpi_solver_tpu.ops.matvec import (
+        Ops, bucketed_matvec, build_bucketed_blocks, device_data)
+    from pcg_mpi_solver_tpu.parallel.partition import partition_model
+
+    n0 = args.nx if args.nx is not None else 22
+    model = cached_model("octree", nx0=n0, ny0=n0, nz0=n0,
+                         max_level=4, n_incl=6, seed=2, E=30e9, nu=0.2,
+                         load="traction", load_value=1e6)
+    t0 = time.perf_counter()
+    pm = partition_model(model, 1)
+    ops = Ops.from_model(pm, dot_dtype=jnp.float64)
+    data = device_data(pm, jnp.float64, blocks=False)
+    data["buckets"] = build_bucketed_blocks(pm, jnp.float64)
+    print(f"# octree {model.n_dof} dofs, {len(pm.type_blocks)} types -> "
+          f"{len(data['buckets'])} buckets of T="
+          f"{[b['Ke'].shape[0] for b in data['buckets']]} "
+          f"(partition {time.perf_counter()-t0:.0f}s)", flush=True)
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s), data)
+
+    def fn(data, v):
+        return data["eff"] * bucketed_matvec(ops, data, v)
+
+    vec = jax.ShapeDtypeStruct((1, pm.n_loc), jnp.float64, sharding=s)
+    return _compile_structs(fn, [structs, vec],
+                            f"BUCKETED f64 amul octree {n0}^3/L4")
 
 
 def check_genamul64(args):
@@ -283,7 +324,7 @@ def check_cubecycle(args):
                       tol=1e-5, max_iter=jnp.minimum(500, budget),
                       glob_n_dof_eff=n_loc, max_iter_nominal=20000,
                       carry_in=carry, return_carry=True,
-                      progress_window=150)
+                      progress_window=0)
         return res.x, c2, res.flag
 
     sds = lambda shape, d: jax.ShapeDtypeStruct(shape, d, sharding=s)
@@ -305,7 +346,7 @@ def main():
     ap.add_argument("what", choices=["kernel", "f64matvec", "pcg",
                                      "hybridpcg", "hybridcycle",
                                      "hybridamul64", "genamul64",
-                                     "cubecycle"])
+                                     "bktamul64", "cubecycle"])
     ap.add_argument("--variants", default="6,7")
     ap.add_argument("--nx", type=int, default=None,
                     help="cells per edge (default: 150; hybridpcg: 22 "
@@ -321,13 +362,14 @@ def main():
         # with f64 inputs the flag would silently validate the XLA path
         ap.error("--pallas on requires --dtype float32")
     if args.nx is None and args.what not in ("hybridpcg", "hybridcycle",
-                                             "hybridamul64", "genamul64"):
+                                             "hybridamul64", "genamul64",
+                                             "bktamul64"):
         args.nx = 150
     # never touch the real backend: the topology API needs no client, and
     # an accidental device touch would hang on a wedged tunnel
     os.environ.pop("JAX_PLATFORMS", None)
     if args.what in ("f64matvec", "pcg", "hybridpcg", "hybridcycle",
-                     "hybridamul64", "genamul64", "cubecycle"):
+                     "hybridamul64", "genamul64", "bktamul64", "cubecycle"):
         # without x64, the float64 ShapeDtypeStructs canonicalize to f32
         # and the chunked-path gate (dtype == float64) never engages —
         # the check would silently validate a different program
@@ -339,6 +381,7 @@ def main():
           "hybridcycle": check_hybridcycle,
           "hybridamul64": check_hybridamul64,
           "genamul64": check_genamul64,
+          "bktamul64": check_bktamul64,
           "cubecycle": check_cubecycle}[args.what](args)
     sys.exit(0 if ok else 1)
 

@@ -3,19 +3,21 @@
 The round-3 tunnel outage (dead from 04:21Z to end of round) left every
 wave-4 step owed.  This queue re-runs them with the round-4 changes in
 (single-instantiation PCG body + x0_zero + refresh-at-top refinement —
-roughly half the stencil instantiations per compiled program — and the
-progress-rate inner exit default-on), ordered so the highest-value
-measurements land first and nothing that can wedge the grant precedes
-them:
+roughly half the stencil instantiations per compiled program), ordered
+so the highest-value measurements land first and nothing that can
+wedge the grant precedes them:
 
+  0. Cache-key identity check (decides whether the pre-warmed
+     .jax_cache erases the flagship compiles).
   1. matvec A/B — ONLY v6 + v8 (chipless-compile-verified candidates;
      v1-v5/v7 are pinned Mosaic failures whose failed remote compiles
      wedge the grant) vs the XLA gse/gsplit/corner forms at 150^3.
   2. Per-iteration breakdown immediately after (third re-queue; VERDICT
      r03 item 7 says before anything that can wedge).
-  3. Flagship cube bench (pallas auto probes v6; progress exit ON).
-  4. Progress-exit A/B: same flagship with BENCH_PROGRESS=0 — the
-     670-wasted-iteration claim (docs/BENCH_LOG.md) decides here.
+  3. Flagship cube bench (pallas auto probes v6; progress exit OFF —
+     the default since the negative 96^3 A/B, BENCH_LOG 2026-08-01).
+  4. Progress-exit A/B: same flagship with BENCH_PROGRESS=150 (the ON
+     arm) — the 670-wasted-iteration claim decides at true scale.
   5. Octree flagship ladder 22/18/12 at L4 (compile cache warm from
      round-3 entries is INVALID after the PCG restructure; the 4800 s
      budget covers one cold ~10 min compile + solve — half the old
@@ -71,13 +73,17 @@ def main():
     # round-end driver's ~1800 s window) must be widened to each wave
     # step's ACTUAL timeout, or the watchdog would emit the provisional
     # line mid-step with half the budget unused.
-    # 3. Flagship cube (v6 probe live, progress exit on by default).
-    run_step(path, "flagship (v6 probe, progress on)", ["bench.py"],
+    # 3. Flagship cube (v6 probe live; progress exit OFF — the default
+    # since the negative 96^3 A/B, docs/BENCH_LOG.md 2026-08-01).
+    run_step(path, "flagship (v6 probe, progress off)", ["bench.py"],
              env_extra=dict(bench_env, BENCH_WALL_BUDGET_S="3480"),
              timeout=3600, force_gate=True)
-    # 4. Progress-exit A/B at the only scale where it can pay.
-    run_step(path, "flagship progress=0 A/B", ["bench.py"],
-             env_extra=dict(bench_env, BENCH_PROGRESS="0",
+    # 4. Progress-exit A/B at the only scale where it can pay.  The CPU
+    # A/B at 96^3 measured the exit NEGATIVE (+24% iterations) and the
+    # default flipped OFF (docs/BENCH_LOG.md 2026-08-01) — this arm now
+    # A/Bs the ON side at the true flagship.
+    run_step(path, "flagship progress=150 A/B", ["bench.py"],
+             env_extra=dict(bench_env, BENCH_PROGRESS="150",
                             BENCH_WALL_BUDGET_S="3480"), timeout=3600)
     # 5. Octree flagship (gather combine, halved compile after the
     # single-instantiation restructure).
