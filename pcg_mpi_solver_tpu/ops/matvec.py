@@ -513,22 +513,35 @@ def build_bucketed_blocks(pm: PartitionedModel, dtype=jnp.float64):
                              f"3*n_nodes={tb.n_nodes} — not node layout")
         N = tb.node.shape[2]
         size_cls = 0
-        while 4 ** (size_cls + 2) < N:      # buckets: N <= 16, 64, 256, ...
+        # coarse power-of-16 classes: N <= 16, 256, 4096, 65k, 1M, ...
+        # Grouping is by size class ONLY — element arity (d, nn) is
+        # zero-PADDED to the bucket max instead of splitting buckets:
+        # measured at the flagship, (d, nn, cls) grouping still left
+        # 36-40 buckets (the reference's hanging-node transition types
+        # span many arities) while compile cost tracks bucket COUNT
+        # (general 227 structs 1343 s / 40 buckets 680 s / stencil 999 s
+        # chipless).  The dominant brick type sits alone in the top size
+        # class, so the arity padding wastes FLOPs only on the small
+        # transition types — irrelevant for an out-of-loop operator.
+        while 16 ** (size_cls + 1) < N:
             size_cls += 1
-        groups.setdefault((tb.d, tb.n_nodes, size_cls), []).append(tb)
+        groups.setdefault(size_cls, []).append(tb)
     buckets = []
-    for (d, nn, _cls), tbs in sorted(groups.items()):
+    for _cls, tbs in sorted(groups.items()):
         P = tbs[0].node.shape[0]
         nmax = max(tb.node.shape[2] for tb in tbs)
+        nn = max(tb.n_nodes for tb in tbs)
+        d = 3 * nn
         T = len(tbs)
-        Ke = np.stack([tb.Ke for tb in tbs])
+        Ke = np.zeros((T, d, d))
         node = np.full((P, T, nn, nmax), pm.n_node_loc, dtype=np.int32)
         sign = np.zeros((P, T, d, nmax), dtype=bool)
         ck = np.zeros((P, T, nmax))
         for t, tb in enumerate(tbs):
             n = tb.node.shape[2]
-            node[:, t, :, :n] = tb.node
-            sign[:, t, :, :n] = tb.sign
+            Ke[t, :tb.d, :tb.d] = tb.Ke
+            node[:, t, :tb.n_nodes, :n] = tb.node
+            sign[:, t, :tb.d, :n] = tb.sign
             ck[:, t, :n] = tb.ck
         buckets.append({"Ke": jnp.asarray(Ke, dtype),
                         "node": jnp.asarray(node),

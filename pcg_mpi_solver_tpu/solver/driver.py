@@ -225,19 +225,29 @@ class Solver:
             from pcg_mpi_solver_tpu.parallel.hybrid import (
                 hybrid_pallas_enabled)
 
-            # PCG_TPU_HYBRID_F64_REFRESH=general: run the out-of-loop f64
-            # matvecs (Dirichlet lifting, r0, refinement true-residual)
-            # through a full GENERAL element gather/scatter partition
-            # instead of the f64 level-grid stencils.  The stencil f64
-            # amul is the octree flagship's single largest compile
-            # (999 s chipless, docs/BENCH_LOG.md 2026-07-31) while its
-            # runtime advantage is irrelevant at ~4 calls/solve; the
-            # general form adds only the brick type block to einsum
-            # structures the hybrid matvec compiles anyway.  Needs the
+            # PCG_TPU_HYBRID_F64_REFRESH: formulation of the out-of-loop
+            # f64 matvecs (Dirichlet lifting, r0, refinement
+            # true-residual — ~4 calls/solve).  Default BUCKETED: a full
+            # general element partition with the 200+ per-type
+            # structures stacked into a few padded batched einsums.
+            # Chipless compile at the 5.67M-dof flagship (BENCH_LOG
+            # 2026-08-01): stencil 999 s / general 1343 s / bucketed
+            # (5 buckets) 425 s — compile cost tracks emitted structure
+            # count, and the f64 stencil amul was the flagship's single
+            # largest program.  Runtime is per-cycle, so compile
+            # dominates the session economics; "stencil" forces the old
+            # form (slightly less HBM, fastest execution).  Needs the
             # SAME elem_part so the local dof numbering is identical
             # (partition_model's numbering is block_filter-independent).
             self.f64_refresh = "stencil"
-            _knob = os.environ.get("PCG_TPU_HYBRID_F64_REFRESH", "stencil")
+            _knob = os.environ.get("PCG_TPU_HYBRID_F64_REFRESH",
+                                   "bucketed")
+            if _knob not in ("stencil", "general", "bucketed"):
+                # the mode drives checkpoint fingerprints and a 2.35x
+                # compile-cost spread — a typo must not silently pick one
+                raise ValueError(
+                    f"PCG_TPU_HYBRID_F64_REFRESH={_knob!r}: expected "
+                    "'bucketed' (default), 'stencil' or 'general'")
             if self.mixed and _knob in ("general", "bucketed"):
                 self.f64_refresh = _knob
                 if elem_part is None:
@@ -277,6 +287,18 @@ class Solver:
                         "general-refresh partition numbering diverged "
                         "from the hybrid partition (same elem_part must "
                         "yield identical local dof layouts)")
+                if self.f64_refresh == "bucketed" and pm_full.ell is None:
+                    # bucketing needs the 3-dof node layout (its gather/
+                    # scatter move node rows); models that break it
+                    # (e.g. node-less spring dofs, partition.py) degrade
+                    # to the unbucketed general form instead of failing
+                    # a construction that both older forms handled
+                    import warnings
+
+                    warnings.warn(
+                        "PCG_TPU_HYBRID_F64_REFRESH=bucketed needs the "
+                        "node layout; using 'general' for this model")
+                    self.f64_refresh = "general"
                 if self.f64_refresh == "bucketed":
                     from pcg_mpi_solver_tpu.ops.matvec import (
                         build_bucketed_blocks)

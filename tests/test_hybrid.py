@@ -361,6 +361,7 @@ def test_general_f64_refresh_matches_stencil(model, monkeypatch):
         solver=SolverConfig(tol=1e-8, max_iter=4000,
                             precision_mode="mixed"),
         time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]))
+    monkeypatch.setenv("PCG_TPU_HYBRID_F64_REFRESH", "stencil")
     s0 = Solver(model, cfg, mesh=make_mesh(4), n_parts=4, backend="hybrid")
     assert s0.f64_refresh == "stencil"
     r0 = s0.step(1.0)
