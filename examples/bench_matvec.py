@@ -88,7 +88,8 @@ def main():
             structured_matvec_pallas_v4, planes=c)))
     from pcg_mpi_solver_tpu.ops.pallas_matvec import (
         structured_matvec_pallas_v5, structured_matvec_pallas_v6,
-        structured_matvec_pallas_v7, structured_matvec_pallas_v8)
+        structured_matvec_pallas_v7, structured_matvec_pallas_v8,
+        structured_matvec_pallas_v9)
     for c in (8, 16):
         variants.append((f"pallas v5 C={c}", functools.partial(
             structured_matvec_pallas_v5, planes=c)))
@@ -100,6 +101,8 @@ def main():
         structured_matvec_pallas_v7, planes=8)))
     variants.append(("pallas v8 C=8", functools.partial(
         structured_matvec_pallas_v8, planes=8)))
+    variants.append(("pallas v9 C=8", functools.partial(
+        structured_matvec_pallas_v9, planes=8)))
     # BENCH_MATVEC_VARIANTS="v6,v8" runs only those Pallas variants: on
     # hardware every known-failing variant burns a failed REMOTE compile
     # that can wedge the device grant for minutes (docs/RUNBOOK.md) —

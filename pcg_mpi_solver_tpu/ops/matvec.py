@@ -486,11 +486,14 @@ class Ops:
 # (/root/reference/src/solver/partition_mesh.py:1074 allows <=144 per rank,
 # multi-part models exceed it globally), and measured chipless compile cost
 # tracks the emitted structure COUNT, not FLOPs (docs/BENCH_LOG.md
-# 2026-08-01: 227 type blocks -> 1343 s f64).  Here types of equal element
-# arity are STACKED into a few power-of-4-padded buckets: one batched
-# einsum per bucket (~8 structures instead of 227).  Padding wastes < 3x
-# the non-dominant types' elements — irrelevant for the ~4 calls/solve
-# refresh amul this exists for.  The scatter is an unordered at[].add
+# 2026-08-01: 227 type blocks -> 1343 s f64).  Here types are STACKED into
+# a few buckets by element-count SIZE CLASS only (power-of-16 boundaries;
+# ~5 buckets at the flagship), with element arity (d, nn) zero-padded to
+# each bucket's max: one batched einsum per bucket.  Element-count slots
+# pad to the bucket max and arity padding can cost up to ~16x on the
+# small transition types — irrelevant for the ~4 calls/solve refresh
+# amul this exists for (the dominant brick type sits alone in the top
+# size class and pays no padding).  The scatter is an unordered at[].add
 # (bit-order differs from the type-loop path), so this formulation is for
 # paths WITHOUT a bit-exact iteration contract (the mixed-mode f64
 # refresh; never the direct/f64 parity path).

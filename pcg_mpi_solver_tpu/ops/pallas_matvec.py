@@ -152,12 +152,14 @@ def selected_variant():
     at trace time: toggling the knob after a solver compiled does not
     retrace (build a new Solver to switch).
 
-    PROVISIONAL DEFAULT: v6 is chipless-compile-verified at the 150^3
-    flagship and interpret-parity-tested, but has no hardware-measured
-    run yet (tunnel down from 04:21Z through end of round 3).  Under
-    pallas='auto' the shape probe still guards lowering; under
-    pallas='on' users get the unmeasured kernel directly.  Revisit after
-    the on-hardware v6/v8 A/B (docs/RUNBOOK.md knob table)."""
+    PROVISIONAL DEFAULT: v6 passes the build-host chipless compile at
+    the 150^3 flagship but the DEPLOYED terminal Mosaic rejects its u
+    stack (concat of lane-offset-mismatched rows, HW_SESSION.log
+    2026-08-01) — under pallas='auto' the probe burns one failed remote
+    compile (~70 s) and degrades to the XLA path.  v9 removes the
+    rejected construct class entirely and is the engage candidate; the
+    default flips only after a hardware-measured v9 win (a mid-queue
+    flip would confound the wave A/B arms)."""
     import os
 
     v = os.environ.get("PCG_TPU_PALLAS_V", "6")
@@ -175,10 +177,12 @@ def selected_variant():
         return "v7", _planes_env(structured_matvec_pallas_v7)
     if v == "6":
         return "v6", _planes_env(structured_matvec_pallas_v6)
-    if v != "8":
+    if v == "8":
+        return "v8", _planes_env(structured_matvec_pallas_v8)
+    if v != "9":
         raise ValueError(
-            f"PCG_TPU_PALLAS_V must be 1|2|3|4|5|6|7|8, got {v!r}")
-    return "v8", _planes_env(structured_matvec_pallas_v8)
+            f"PCG_TPU_PALLAS_V must be 1|2|3|4|5|6|7|8|9, got {v!r}")
+    return "v9", _planes_env(structured_matvec_pallas_v9)
 
 
 def probe_shapes(shapes, dtype=jnp.float32) -> None:
@@ -1256,3 +1260,201 @@ def structured_matvec_pallas_v8(xg, ck, Ke, *, interpret=False, planes=8):
         interpret=interpret,
     )(Ke, x_pad, ck_pad)
     return y[:, :nxn, :m].reshape(3, nxn, nyn, nzn)
+
+
+# ----------------------------------------------------------------------
+# v9: dot-built gather — NO concat/stack/pad of offset vectors anywhere.
+#
+# The first HARDWARE compiles of v6/v8 (2026-08-01, terminal
+# tpu_compile_helper) rejected the u stack with a Mosaic error the
+# build-host chipless pipeline accepts: "tpu.concatenate: result/input
+# offset mismatch on non-concat dimension" — the 24 row slices
+# xb[c, k+dx, off:off+m128] carry lane-offset layouts off%128 in
+# {0, 1, 23, 24} and the DEPLOYED Mosaic has no relayout for
+# lane-offset-mismatched concat inputs (the local toolchain does).
+# The two toolchains differ; chipless-verified is necessary, not
+# sufficient.
+#
+# v9 therefore never materializes a misaligned vector at all:
+#
+#   * the slab is PLANE-MAJOR — x_hbm (g*cpp+8, 3, m128) — so a corner
+#     read is xb[k+dx]: a majormost-index memref slice yielding a
+#     (3, mt128) block at canonical {0,0} layout (same op class as
+#     xv[slot], lowered by every variant since v3);
+#   * the dy/dz lane shift is a 2-D static pltpu.roll of that block
+#     (the op every variant's OUTPUT path already lowers), applied to
+#     the full mt128 width: xroll_a[c, l] = x[c, k+dx, l+off_a] for
+#     all real l, and the cyclic wrap only touches lanes the ck mask
+#     zeroes (ck is zero for pad cells, and real cells never read past
+#     m — see the v7 header for the same argument);
+#   * the (24, m) gathered array u is never BUILT: the product
+#     v = Ke @ u is accumulated directly as eight MXU dots
+#         v += keT[a] . xroll_a,   keT[a] = Ke[:, 3a:3a+3]  as (3, 24)
+#     (contraction over the 3 components; every operand and result
+#     lives at {0,0});
+#   * output corner blocks are EXTRACTED BY DOT, not by row slicing:
+#         blk_b = sel[b] . (ck * v),   sel[b] (3, 24) one-hot rows
+#     so the placement roll and the lo/hi accumulation only ever see
+#     {0,0} (3, mt128) blocks.  No jnp.pad exists in the kernel (ck is
+#     host-padded to full mt128 width, as in v7).
+#
+# Cost vs v6: the 8 (3,24)@(24,m) output dots are replaced by
+# 8 gather dots + 8 sel dots of the same MAC count — ~2x the (already
+# tiny) FLOPs — plus 8 input rolls; the kernel stays DMA/HBM-bound by
+# design.  The slab reads cpp+1 planes per chunk (v6 read cpp+8: its
+# plane axis was tiled second-minor and DMA extents had to be 8-tile
+# multiples; plane-major has no such constraint).  The host-side
+# pad/transpose to plane-major costs one extra x round-trip per
+# matvec, same class as v6's x_pad (header note 1).
+# ----------------------------------------------------------------------
+
+
+def _matvec_kernel_v9(ket_ref, sel_ref, x_hbm, ck_hbm, y_ref,
+                      xv, ckv, acc, sems, ck_sems,
+                      *, g, cpp, m128, mt128, sy):
+    """One grid step = cpp finished output node planes.
+
+    The component axis is physically FOUR everywhere (3 dof + one zero
+    row): Mosaic tiles the second-minor axis at 4 and requires every
+    memref-slice extent along it to be tile-aligned (chipless probe
+    2026-08-01) — so x planes, ket/sel operands, the accumulators and
+    the output block all carry the dead 4th row (zero in, zero out).
+
+    ket_ref: (8, 4, 24) VMEM — ket[a,:3] = Ke[:, 3a:3a+3].T, row 3 zero
+    sel_ref: (8, 4, 24) VMEM — sel[b, c, 3b+c] = 1 (c < 3), row 3 zero
+    x_hbm:   (g*cpp + 1, 4, m128) ANY/HBM, plane-major, zero-padded
+    ck_hbm:  (g*cpp, mt128) ANY/HBM (zero-padded, FULL mt width)
+    y_ref:   (cpp, 4, m128) VMEM output block (plane-major)
+    xv:      (2, cpp+1, 4, mt128) VMEM double-buffered slab (the plane
+             axis is MAJORMOST, so the DMA extent cpp+1 needs no 8-tile
+             alignment — v6's +8 overhang is gone); lanes [m128, mt128)
+             stay zero from _init
+    ckv:     (2, cpp, mt128) VMEM
+    acc:     (4, mt128) VMEM — dx=1 partials carried to the next plane
+    """
+    j = jnp.asarray(pl.program_id(0), jnp.int32)  # i32 ALWAYS (see v4)
+
+    def for_chunk(slot, chunk, act):
+        # i32 ALWAYS, including literal zeros (index promotion, see v6)
+        c0 = jnp.asarray(chunk * cpp, jnp.int32)
+        z = jnp.asarray(0, jnp.int32)
+        getattr(pltpu.make_async_copy(
+            x_hbm.at[pl.ds(c0, cpp + 1)],
+            xv.at[slot, :, :, pl.ds(z, m128)], sems.at[slot]), act)()
+        getattr(pltpu.make_async_copy(
+            ck_hbm.at[pl.ds(c0, cpp)],
+            ckv.at[slot], ck_sems.at[slot]), act)()
+
+    @pl.when(j == 0)
+    def _init():
+        xv[...] = jnp.zeros_like(xv)       # zero overhang tails once
+        acc[...] = jnp.zeros_like(acc)
+        for_chunk(0, 0, "start")
+
+    slot = jax.lax.rem(j, jnp.asarray(2, j.dtype))
+    for_chunk(slot, j, "wait")
+
+    @pl.when(j + 1 < g)
+    def _prefetch():
+        for_chunk(1 - slot, j + 1, "start")
+
+    xb = xv[slot]                                       # (cpp+8, 4, mt128)
+    ckb = ckv[slot]                                     # (cpp, mt128)
+    carry = acc[...]                                    # (4, mt128)
+    for k in range(cpp):
+        ck = ckb[k]                                     # (mt128,), 0 tail
+        planes = (xb[k], xb[k + 1])                     # (4, mt128) {0,0}
+        v = None
+        for a, (dx, dy, dz) in enumerate(_CORNERS):
+            off = int(dy * sy + dz)
+            xr = planes[dx]
+            if off:
+                xr = pltpu.roll(xr, mt128 - off, 1)     # xr[l] = x[l+off]
+            ket = ket_ref[a]                            # (4, 24) {0,0}
+            d = jax.lax.dot_general(
+                ket, xr, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)     # (24, mt128) {0,0}
+            v = d if v is None else v + d
+        w = ck * v                                      # hoisted ck scale
+        lo = jnp.zeros((4, mt128), w.dtype)
+        hi = jnp.zeros((4, mt128), w.dtype)
+        for b, (dx, dy, dz) in enumerate(_CORNERS):
+            off = int(dy * sy + dz)
+            sel = sel_ref[b]                            # (4, 24) one-hot
+            blk = jax.lax.dot_general(
+                sel, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)     # (4, mt128) {0,0}
+            if off:
+                blk = pltpu.roll(blk, off, 1)           # lane placement
+            if dx == 0:
+                lo = lo + blk
+            else:
+                hi = hi + blk
+        out = carry + lo
+        y_ref[k] = out[:, :m128]
+        carry = hi
+    acc[...] = carry
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "planes"))
+def structured_matvec_pallas_v9(xg, ck, Ke, *, interpret=False, planes=8):
+    """Dot-built-gather variant of :func:`structured_matvec_pallas_v6`.
+
+    Same signature/semantics: xg (3, nx+1, ny+1, nz+1), ck (nx, ny, nz),
+    Ke (24, 24), all f32; ``planes`` = cell planes per grid step
+    (multiple of 8)."""
+    _, nxn, nyn, nzn = xg.shape
+    nx = nxn - 1
+    m = nyn * nzn
+    m128 = -(-m // 128) * 128
+    sy = nzn
+    mt128 = m128 + (-(-(sy + 2) // 128)) * 128
+    cpp = max(1, min(planes, ((nx + 1 + 7) // 8) * 8))
+    g = -(-(nx + 1) // cpp)                 # ceil: covers all output planes
+    x_flat = xg.reshape(3, nxn, m)          # free reshape, no copy
+    # plane-major with a zero 4th component row (tiling alignment, see
+    # kernel docstring): a corner read inside the kernel is then a
+    # majormost-index (4, mt128) block slice at {0,0}
+    x_pad = jnp.pad(x_flat, ((0, 1), (0, g * cpp + 1 - nxn),
+                             (0, m128 - m))).transpose(1, 0, 2)
+    # ck pads are loop-invariant, so XLA hoists them out of the PCG
+    # loop; FULL mt128 lane width so no pad op exists inside the kernel
+    ck_pad = jnp.pad(ck, ((0, g * cpp - nx), (0, 1), (0, 1))) \
+        .reshape(g * cpp, m)
+    ck_pad = jnp.pad(ck_pad, ((0, 0), (0, mt128 - m)))
+    ket = jnp.stack([
+        jnp.concatenate([Ke[:, 3 * a:3 * a + 3].T,
+                         jnp.zeros((1, 24), Ke.dtype)]) for a in range(8)])
+    sel_np = np.zeros((8, 4, 24), np.float32)
+    for b in range(8):
+        for c in range(3):
+            sel_np[b, c, 3 * b + c] = 1.0
+    sel = jnp.asarray(sel_np)
+    kernel = functools.partial(_matvec_kernel_v9, g=g, cpp=cpp,
+                               m128=m128, mt128=mt128, sy=sy)
+    y = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # ket
+            pl.BlockSpec(memory_space=pltpu.VMEM),     # sel
+            pl.BlockSpec(memory_space=pl.ANY),         # x (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),         # ck (manual DMA)
+        ],
+        out_specs=pl.BlockSpec((cpp, 4, m128), lambda j: (j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((g * cpp, 4, m128), xg.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, cpp + 1, 4, mt128), xg.dtype),
+            pltpu.VMEM((2, cpp, mt128), ck.dtype),
+            pltpu.VMEM((4, mt128), xg.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        # plane-major slab: each (4, mt128) plane occupies a 4-sublane
+        # tile -> ~7 MB both slots at flagship m; raise the per-kernel
+        # cap as for v6/v8
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(ket, sel, x_pad, ck_pad)
+    return y[:nxn, :3, :m].transpose(1, 0, 2).reshape(3, nxn, nyn, nzn)

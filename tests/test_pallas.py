@@ -55,7 +55,7 @@ def test_pallas_matvec_v2_matches_xla(dims):
         np.asarray(y).reshape(-1), y_ref, rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("variant", ["v3", "v4", "v5", "v6", "v7", "v8"])
+@pytest.mark.parametrize("variant", ["v3", "v4", "v5", "v6", "v7", "v8", "v9"])
 @pytest.mark.parametrize("dims,planes", [((6, 5, 4), 2), ((4, 4, 4), 4),
                                          ((7, 3, 5), 3), ((5, 4, 3), 8)])
 def test_pallas_matvec_chunked_matches_xla(variant, dims, planes):
@@ -70,7 +70,8 @@ def test_pallas_matvec_chunked_matches_xla(variant, dims, planes):
           "v5": pm.structured_matvec_pallas_v5,
           "v6": pm.structured_matvec_pallas_v6,
           "v7": pm.structured_matvec_pallas_v7,
-          "v8": pm.structured_matvec_pallas_v8}[variant]
+          "v8": pm.structured_matvec_pallas_v8,
+          "v9": pm.structured_matvec_pallas_v9}[variant]
     nx, ny, nz = dims
     model = make_cube_model(nx, ny, nz, heterogeneous=True, seed=11)
     sp = partition_structured(model, 1)
@@ -88,7 +89,7 @@ def test_pallas_matvec_chunked_matches_xla(variant, dims, planes):
         np.asarray(y).reshape(-1), y_ref, rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("kernel_fn", ["v1", "v2", "v3", "v4", "v5", "v6", "v7", "v8"])
+@pytest.mark.parametrize("kernel_fn", ["v1", "v2", "v3", "v4", "v5", "v6", "v7", "v8", "v9"])
 def test_pallas_matvec_zero_ck_column_isolated(kernel_fn):
     """Cells with ck=0 must contribute nothing (the padded-cell trick the
     sharded integration — and v2's own gather padding — relies on)."""
@@ -96,7 +97,7 @@ def test_pallas_matvec_zero_ck_column_isolated(kernel_fn):
         structured_matvec_pallas_v2, structured_matvec_pallas_v3,
         structured_matvec_pallas_v4, structured_matvec_pallas_v5,
         structured_matvec_pallas_v6, structured_matvec_pallas_v7,
-        structured_matvec_pallas_v8)
+        structured_matvec_pallas_v8, structured_matvec_pallas_v9)
 
     fn = {"v1": structured_matvec_pallas,
           "v2": structured_matvec_pallas_v2,
@@ -105,7 +106,8 @@ def test_pallas_matvec_zero_ck_column_isolated(kernel_fn):
           "v5": structured_matvec_pallas_v5,
           "v6": structured_matvec_pallas_v6,
           "v7": structured_matvec_pallas_v7,
-          "v8": structured_matvec_pallas_v8}[kernel_fn]
+          "v8": structured_matvec_pallas_v8,
+          "v9": structured_matvec_pallas_v9}[kernel_fn]
     model = make_cube_model(4, 3, 3, heterogeneous=True, seed=1)
     sp = partition_structured(model, 1)
     data = device_data_structured(sp, jnp.float32)
