@@ -1,0 +1,89 @@
+"""Post-wave v9 hardware A/B: measure the dot-built-gather kernel on the
+deployed toolchain and, if it beats the engaged XLA gse form, capture a
+v9-engaged flagship bench line.
+
+Written 2026-08-01 after the first live window showed the DEPLOYED
+terminal Mosaic rejects v6/v8 (concat lane-offset mismatch) while the
+build-host chipless pipeline accepts them; v9 removes the rejected
+construct class (docs/BENCH_LOG.md).  This queue runs AFTER
+tools/hw_wave5.py so the two cannot contend for the device grant.
+
+Steps:
+  1. matvec A/B, v9 only, at the 150^3 flagship — the first hardware
+     compile AND first hardware execution of any kernel in the family.
+  2. ONLY IF v9 compiled and beat gse: flagship bench with the v9
+     kernel engaged (PCG_TPU_PALLAS_V=9, pallas=auto probes it) so the
+     salvage file carries the better line for the round-end driver.
+
+Usage: python tools/hw_v9_ab.py [--deadline-min 240]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.hw_session import log_line, run_step, start_queue  # noqa: E402
+
+
+def _parse_ab(path, marker):
+    """(gse_ms, v9_ms or None) from the A/B step's log section."""
+    text = open(path).read()
+    sect = text[text.rindex(marker):]
+    gse = re.search(r"xla \(gse\):\s+([0-9.]+) ms/matvec", sect)
+    v9 = re.search(r"pallas v9 C=8:\s+([0-9.]+) ms/matvec", sect)
+    return (float(gse.group(1)) if gse else None,
+            float(v9.group(1)) if v9 else None)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=240)
+    ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
+    args = ap.parse_args()
+    path = start_queue("hw_v9_ab", args.deadline_min, args.log)
+
+    # NOTE the trailing colon+space: run_step also appends a
+    # "=== matvec A/B v9 done: rc=..." line, which a bare prefix would
+    # rindex instead of the step START line
+    marker = "=== matvec A/B v9: "
+    run_step(path, "matvec A/B v9", ["examples/bench_matvec.py", "150"],
+             env_extra={"BENCH_MATVEC_VARIANTS": "v9"}, timeout=2400)
+    gse_ms, v9_ms = _parse_ab(path, marker)
+    log_line(path, f"v9 A/B parse: gse={gse_ms} ms, v9={v9_ms} ms")
+    if v9_ms is None:
+        log_line(path, "v9 did not produce a hardware number "
+                       "(compile rejection or runtime failure) — "
+                       "no engaged flagship run")
+        return
+    if gse_ms is not None and v9_ms >= gse_ms:
+        log_line(path, "v9 measured but does NOT beat gse — "
+                       "no engaged flagship run")
+        return
+    # dead-tunnel steps must not re-emit salvage as fresh; a LIVE line
+    # still WRITES salvage for the round-end driver (bench.py:_write_salvage
+    # is unconditional)
+    run_step(path, "flagship (v9 engaged)", ["bench.py"],
+             env_extra={"BENCH_SALVAGE": "0", "BENCH_CPU_UPGRADE": "0",
+                        "PCG_TPU_PALLAS_V": "9",
+                        "BENCH_WALL_BUDGET_S": "3480"},
+             timeout=3600, force_gate=True)
+    log_line(path, "hw_v9_ab complete")
+
+
+if __name__ == "__main__":
+    main()
+
+
+# smoke: python - <<'EOF'
+# import tools.hw_v9_ab as m
+# open('/tmp/ablog','w').write(
+#     "x\n=== matvec A/B v9: ...\nxla (gse):      13.741 ms/matvec\n"
+#     "pallas v9 C=8:    3.2 ms/matvec  (vs xla  4.29x, maxrelerr 1e-07)\n"
+#     "=== matvec A/B v9 done: rc=0 (98s)\n")
+# assert m._parse_ab('/tmp/ablog', '=== matvec A/B v9: ') == (13.741, 3.2)
+# EOF
