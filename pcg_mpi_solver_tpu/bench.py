@@ -527,19 +527,39 @@ class _Emitter:
             if not self.done and rank >= self._rank:
                 self.best = line
                 self._rank = rank
+        if rank >= 4:
+            # persist a LIVE accelerator line the moment it exists: the
+            # watchdog's os._exit(0) raced out main's end-of-run
+            # _write_salvage on 2026-08-01 (flagship TPU line emitted to
+            # stdout, salvage file never written — deadline-45s fired
+            # 2 s before the step ended)
+            _write_salvage(line)
 
     def emit(self, line=None):
         """Print line (or the best recorded one) once; False if already
-        emitted."""
+        emitted.  Salvage-worthy lines are persisted as part of the
+        emit so NO exit path can print a live accelerator number
+        without recording it for later invocations (dedup in
+        _write_salvage makes the double write from main's explicit
+        call harmless)."""
         with self._lock:
             if self.done:
                 return False
             self.done = True
-            print(line if line is not None else self.best, flush=True)
-            return True
+            out = line if line is not None else self.best
+            rank = self._rank
+            print(out, flush=True)
+        # an explicit line is the main flow's live measurement; a
+        # best-recorded line is only persisted at rank 4 (a rank-3
+        # re-labeled salvage must not be re-written — see
+        # _salvage_worthy, which also rejects it by content)
+        if line is not None or rank >= 4:
+            _write_salvage(out)
+        return True
 
 
 _SALVAGE_PATH = "bench_salvage.json"
+_SALVAGE_LOCK = threading.Lock()
 
 
 def _git_head():
@@ -554,12 +574,17 @@ def _git_head():
 
 def _salvage_worthy(line):
     """Only real accelerator measurements are worth keeping: a positive
-    value whose platform label is not a CPU fallback/provisional."""
+    value whose platform label is not a CPU fallback/provisional, and
+    that is not itself a RE-LABELED salvage from an earlier run (else a
+    dead-tunnel round would refresh the entry's timestamp every run and
+    the max-age guard could never expire it)."""
     try:
         d = json.loads(line)
-        plat = str(d.get("detail", {}).get("platform", ""))
+        det = d.get("detail", {})
+        plat = str(det.get("platform", ""))
         return float(d.get("value", 0)) > 0 and bool(plat) \
-            and not plat.startswith("cpu")
+            and not plat.startswith("cpu") \
+            and not det.get("salvaged_from_earlier_session")
     except Exception:                                   # noqa: BLE001
         return False
 
@@ -572,26 +597,34 @@ def _write_salvage(line):
     Re-labeled unmistakably on the read side."""
     if not _salvage_worthy(line):
         return
-    entry = {"line": line, "unix_time": time.time(),
-             "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                              time.gmtime()),
-             "git_head": _git_head()}
-    data = {}
-    try:
-        with open(_SALVAGE_PATH) as f:
-            data = json.load(f)
-    except (OSError, ValueError):
-        pass
-    lines = [e for e in data.get("lines", []) if isinstance(e, dict)][-7:]
-    lines.append(entry)
-    try:
-        with open(_SALVAGE_PATH + ".tmp", "w") as f:
-            json.dump({"lines": lines}, f, indent=1)
-        os.replace(_SALVAGE_PATH + ".tmp", _SALVAGE_PATH)
-        _log(f"# accelerator line recorded in {_SALVAGE_PATH} "
-             "for salvage by later invocations")
-    except OSError as e:
-        _log(f"# salvage write failed ({e}); continuing")
+    # offer() (any thread), emit() (watchdog thread) and the main flow
+    # may all try to record the same run's line — serialize the whole
+    # read-modify-replace and dedup BEFORE the expensive entry build
+    # (git rev-parse subprocess)
+    with _SALVAGE_LOCK:
+        data = {}
+        try:
+            with open(_SALVAGE_PATH) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            pass
+        lines = [e for e in data.get("lines", [])
+                 if isinstance(e, dict)][-7:]
+        if any(e.get("line") == line for e in lines):
+            return                          # already recorded this run
+        entry = {"line": line, "unix_time": time.time(),
+                 "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime()),
+                 "git_head": _git_head()}
+        lines.append(entry)
+        try:
+            with open(_SALVAGE_PATH + ".tmp", "w") as f:
+                json.dump({"lines": lines}, f, indent=1)
+            os.replace(_SALVAGE_PATH + ".tmp", _SALVAGE_PATH)
+            _log(f"# accelerator line recorded in {_SALVAGE_PATH} "
+                 "for salvage by later invocations")
+        except OSError as e:
+            _log(f"# salvage write failed ({e}); continuing")
 
 
 def _read_salvage():
@@ -861,7 +894,6 @@ def main():
                             f"({type(e).__name__}: {e}) and every CPU "
                             "fallback failed")
             return
-        _write_salvage(line)
         emitter.emit(line)
     finally:
         prov.kill()
@@ -973,9 +1005,9 @@ def _run_bench(cpu_fallback, provisional=False, deadline=None, emitter=None):
                 f.write(const_line + "\n")
         except OSError:
             pass
-        # cross-run salvage: self-gates on the platform label, so CPU
-        # fallback/upgrade lines never land here
-        _write_salvage(const_line)
+        # cross-run salvage happens at offer(rank=4) above (self-gated
+        # on the platform label, so CPU fallback/upgrade lines never
+        # land there)
 
     if provisional:
         # the fast-fallback subprocess: the validated constant IS the

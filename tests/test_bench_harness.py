@@ -488,3 +488,48 @@ def test_model_cache_eviction(tmp_path):
     assert sorted(os.listdir(d)) == ["model_1.pkl", "model_2.pkl"]
     _evict_model_cache(d, keep=keep, cap_bytes=50)
     assert sorted(os.listdir(d)) == ["model_2.pkl"]
+
+
+def _live_line(value=1.0):
+    import json
+
+    return json.dumps({"metric": "m", "value": value, "unit": "u",
+                       "vs_baseline": 1.0,
+                       "detail": {"platform": "tpu"}})
+
+
+def test_offer_rank4_persists_salvage_immediately(monkeypatch, tmp_path):
+    """A LIVE accelerator line must hit bench_salvage.json the moment it
+    is offered: on 2026-08-01 the watchdog's os._exit(0) fired 2 s
+    before the flagship step ended, emitting the TPU line to stdout but
+    racing out main's end-of-run _write_salvage."""
+    import json
+
+    from pcg_mpi_solver_tpu import bench as b
+
+    monkeypatch.chdir(tmp_path)
+    em = b._Emitter("init")
+    em.offer(_live_line(), rank=4)
+    data = json.load(open(b._SALVAGE_PATH))
+    assert len(data["lines"]) == 1
+
+
+def test_emit_persists_salvage_and_dedups(monkeypatch, tmp_path):
+    import json
+
+    from pcg_mpi_solver_tpu import bench as b
+
+    monkeypatch.chdir(tmp_path)
+    em = b._Emitter("init")
+    ln = _live_line(2.0)
+    em.offer(ln, rank=4)        # first write
+    assert em.emit(ln) is True  # emit-side write must dedup, not append
+    data = json.load(open(b._SALVAGE_PATH))
+    assert len(data["lines"]) == 1
+    # a CPU-labeled line must never be persisted
+    em2 = b._Emitter("init")
+    em2.emit(json.dumps({"metric": "m", "value": 1.0, "unit": "u",
+                         "vs_baseline": 0.1,
+                         "detail": {"platform": "cpu (fallback)"}}))
+    data = json.load(open(b._SALVAGE_PATH))
+    assert len(data["lines"]) == 1
