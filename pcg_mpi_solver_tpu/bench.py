@@ -354,6 +354,14 @@ def _live_baseline(kind, n_dof, nx, ny, nz, ot_n, ot_level, deadline=None):
     return None
 
 
+def _accel_platform():
+    """Platform label of device 0 (separate function so tests can fake a
+    non-CPU platform without touching the real jax device list)."""
+    import jax
+
+    return jax.devices()[0].platform
+
+
 def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
     dof_iters_per_sec = model.n_dof * iters / r1.wall_s
     # idealized 8-rank reference: perfect 8x scaling of the measured hot loop
@@ -386,7 +394,7 @@ def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
 
 
 def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
-                mode, dtype):
+                mode, dtype, emitter=None):
     """Build the model/solver, warm-solve (compile), timed solve.
 
     Returns (model, solver, r1, iters, t_part, pallas_on) where pallas_on
@@ -452,6 +460,32 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
             pallas_on = False
     _log(f"# warm solve: flag={r0.flag} iters={r0.iters} "
          f"relres={r0.relres:.3e} wall={r0.wall_s:.2f}s (incl. compile)")
+    if emitter is not None and r0.flag == 0 \
+            and _accel_platform() != "cpu":
+        # Insurance against a device death DURING the timed solve: on
+        # 2026-08-01 the tunnel died mid-timed-dispatch 29 SECONDS after
+        # a COMPLETED warm solve (flag=0, 3334 iters, 83.3 s at 10.33M
+        # dofs) and the round artifact fell back to a CPU provisional.
+        # A converged warm solve is a real accelerator measurement —
+        # conservative (wall includes compile + start overhead) and
+        # labeled as such; the timed line displaces it at equal rank.
+        warm_extra = {
+            "dtype": dtype, "mode": mode, "backend": s.backend,
+            "pallas": bool(pallas_on),
+            "matvec_form": getattr(s.ops, "form", "n/a"),
+            "combine": getattr(s.ops, "combine", "n/a"),
+            "n_parts": n_parts,
+            "partition_s": round(t_part, 2),
+            "platform": _accel_platform(),
+            "timing": "warm (first solve; wall incl. compile/start "
+                      "overhead — conservative)",
+            "baseline_source": "validated-constant",
+        }
+        wline = _result_json(model, kind, r0, max(r0.iters, 1),
+                             VALIDATED_REF_NS_PER_DOF_ITER,
+                             _VALIDATED_NOTE, warm_extra)
+        _log("# warm-solve accelerator line (insurance): " + wline)
+        emitter.offer(wline, rank=4)
 
     # Measured solve from scratch state (compile cached).
     s.reset_state()
@@ -608,14 +642,26 @@ def _write_salvage(line):
                 data = json.load(f)
         except (OSError, ValueError):
             pass
-        lines = [e for e in data.get("lines", [])
-                 if isinstance(e, dict)][-7:]
+        lines = [e for e in data.get("lines", []) if isinstance(e, dict)]
         if any(e.get("line") == line for e in lines):
             return                          # already recorded this run
         entry = {"line": line, "unix_time": time.time(),
                  "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                   time.gmtime()),
                  "git_head": _git_head()}
+
+        # trim by VALUE, not recency: a fully live wave writes ~3 entries
+        # per bench step (warm insurance, const-baseline, final line), and
+        # dropping the oldest would evict the flagship line the round-end
+        # driver exists to re-emit
+        def _vsb(e):
+            try:
+                return float(json.loads(e["line"]).get("vs_baseline", 0.0))
+            except Exception:               # noqa: BLE001
+                return -1.0
+
+        while len(lines) > 7:
+            lines.remove(min(lines, key=_vsb))
         lines.append(entry)
         try:
             with open(_SALVAGE_PATH + ".tmp", "w") as f:
@@ -942,7 +988,7 @@ def _run_bench(cpu_fallback, provisional=False, deadline=None, emitter=None):
         try:
             model, solver, r1, iters, t_part, pallas_on = _solve_once(
                 kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
-                mode, dtype)
+                mode, dtype, emitter=emitter)
         except Exception as e:                      # noqa: BLE001
             if last:
                 raise

@@ -40,30 +40,31 @@ def _parse_ab(path, marker):
             float(v9.group(1)) if v9 else None)
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--deadline-min", type=float, default=240)
-    ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
-    args = ap.parse_args()
-    path = start_queue("hw_v9_ab", args.deadline_min, args.log)
-
+def run_v9_ab(path):
+    """A/B step + parse; returns (gse_ms, v9_ms).  Shared with
+    tools/hw_wave6.py so the scarce-window sequence exists once."""
     # NOTE the trailing colon+space: run_step also appends a
     # "=== matvec A/B v9 done: rc=..." line, which a bare prefix would
     # rindex instead of the step START line
-    marker = "=== matvec A/B v9: "
     run_step(path, "matvec A/B v9", ["examples/bench_matvec.py", "150"],
              env_extra={"BENCH_MATVEC_VARIANTS": "v9"}, timeout=2400)
-    gse_ms, v9_ms = _parse_ab(path, marker)
+    gse_ms, v9_ms = _parse_ab(path, "=== matvec A/B v9: ")
     log_line(path, f"v9 A/B parse: gse={gse_ms} ms, v9={v9_ms} ms")
+    return gse_ms, v9_ms
+
+
+def maybe_engage_flagship(path, gse_ms, v9_ms):
+    """Run the v9-engaged flagship bench only on a measured win; log a
+    reason that distinguishes compile-rejection from a perf loss."""
     if v9_ms is None:
         log_line(path, "v9 did not produce a hardware number "
                        "(compile rejection or runtime failure) — "
                        "no engaged flagship run")
-        return
+        return False
     if gse_ms is not None and v9_ms >= gse_ms:
-        log_line(path, "v9 measured but does NOT beat gse — "
-                       "no engaged flagship run")
-        return
+        log_line(path, f"v9 measured {v9_ms} ms but does NOT beat gse "
+                       f"({gse_ms} ms) — no engaged flagship run")
+        return False
     # dead-tunnel steps must not re-emit salvage as fresh; a LIVE line
     # still WRITES salvage for the round-end driver (bench.py:_write_salvage
     # is unconditional)
@@ -72,6 +73,17 @@ def main():
                         "PCG_TPU_PALLAS_V": "9",
                         "BENCH_WALL_BUDGET_S": "3480"},
              timeout=3600, force_gate=True)
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=240)
+    ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
+    args = ap.parse_args()
+    path = start_queue("hw_v9_ab", args.deadline_min, args.log)
+    gse_ms, v9_ms = run_v9_ab(path)
+    maybe_engage_flagship(path, gse_ms, v9_ms)
     log_line(path, "hw_v9_ab complete")
 
 

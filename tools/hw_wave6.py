@@ -1,0 +1,72 @@
+"""Sixth-wave hardware queue (round 5): the measurements still owed
+after the 2026-08-01 live window (63 min, 08:27-09:30Z) closed.
+
+That window banked the north star — the 150^3 flagship at flag=0 /
+743.8M dof-iter/s / vs_baseline 21.9 (persisted in bench_salvage.json)
+— plus the matvec A/B and the per-op breakdown.  It also proved the
+deployed terminal Mosaic rejects v6/v8, which is why this queue leads
+with the v9 kernel written in response.  Owed and ordered by
+value-per-minute-of-window (short windows die on big compiles, so the
+cheap high-information step goes first and the compile-heavy octree
+before the cheaper-but-lower-stakes f64 anchor):
+
+  1. matvec A/B v9 — first hardware compile+execution of the kernel
+     family (the perf thesis).  Minutes.
+  2. octree flagship — the reference's real problem class; no octree
+     model has ever SOLVED on the TPU (VERDICT r04 next #3).
+  3. f64-direct anchor at 150^3, ladder 128/96 (VERDICT r04 next #4).
+  4. flagship with v9 ENGAGED — only if step 1 measured v9 beating the
+     13.74 ms/matvec gse form (upgrades the salvaged artifact line).
+  5. progress=150 A/B, hybrid breakdown, gather variants (leftovers).
+
+Usage: python tools/hw_wave6.py [--deadline-min 300]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.hw_session import log_line, run_step, start_queue  # noqa: E402
+from tools.hw_v9_ab import maybe_engage_flagship, run_v9_ab  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=300)
+    ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
+    args = ap.parse_args()
+    path = start_queue("hw_wave6", args.deadline_min, args.log)
+
+    bench_env = {"BENCH_SALVAGE": "0", "BENCH_CPU_UPGRADE": "0"}
+
+    gse_ms, v9_ms = run_v9_ab(path)
+
+    run_step(path, "octree flagship", ["bench.py"],
+             env_extra=dict(bench_env, BENCH_MODEL="octree",
+                            BENCH_WALL_BUDGET_S="4680"), timeout=4800,
+             force_gate=True)
+    run_step(path, "f64 direct anchor 150", ["bench.py"],
+             env_extra=dict(bench_env, BENCH_MODE="direct",
+                            BENCH_DTYPE="float64",
+                            BENCH_WALL_BUDGET_S="4680"),
+             timeout=4800, force_gate=True)
+
+    maybe_engage_flagship(path, gse_ms, v9_ms)
+
+    run_step(path, "flagship progress=150 A/B", ["bench.py"],
+             env_extra=dict(bench_env, BENCH_PROGRESS="150",
+                            BENCH_WALL_BUDGET_S="3480"), timeout=3600,
+             force_gate=True)
+    run_step(path, "hybrid breakdown",
+             ["examples/bench_hybrid_breakdown.py"], timeout=2400)
+    run_step(path, "gather/scatter variants", ["examples/bench_gather.py"],
+             timeout=2400)
+    log_line(path, "hw_wave6 complete")
+
+
+if __name__ == "__main__":
+    main()
