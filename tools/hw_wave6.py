@@ -43,6 +43,12 @@ def main():
 
     bench_env = {"BENCH_SALVAGE": "0", "BENCH_CPU_UPGRADE": "0"}
 
+    # 0. cache-key identity (VERDICT r04 weak #4) — the seed manifest
+    # now exists (.jax_cache_manifest.json, generated 2026-08-01), so
+    # this finally ANSWERS whether chipless pre-warming helps remotely.
+    run_step(path, "cache-key identity check",
+             ["tools/cache_key_check.py"], timeout=600)
+
     gse_ms, v9_ms = run_v9_ab(path)
 
     run_step(path, "octree flagship", ["bench.py"],
