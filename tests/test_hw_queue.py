@@ -1,0 +1,76 @@
+"""Contract tests for the hardware-queue tooling (tools/hw_session.py,
+tools/hw_v9_ab.py): the session-log format run_step writes is parsed by
+the wave queues to make engage/skip decisions, so the coupling needs a
+test.  Pure subprocess/log logic — no accelerator, no solver."""
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_run_step_log_matches_ab_parser(tmp_path, monkeypatch):
+    """run_step's start/done line format must stay parseable by
+    tools/hw_v9_ab._parse_ab — the marker anchors on the START line and
+    must not match the trailing 'done:' line."""
+    from tools import hw_session
+    from tools.hw_v9_ab import _parse_ab
+
+    fake = tmp_path / "fake_ab.py"
+    fake.write_text(textwrap.dedent("""\
+        print("10328853 dofs on FakeDevice")
+        print("xla (gse):      13.741 ms/matvec")
+        print("pallas v9 C=8:    3.100 ms/matvec  (vs xla  4.43x, "
+              "maxrelerr 1.2e-07)")
+    """))
+    log = tmp_path / "log.txt"
+    monkeypatch.setattr(hw_session, "_last_step_ok", True)
+    hw_session.run_step(str(log), "matvec A/B v9", [str(fake)],
+                        timeout=60, gate_s=0)
+    gse, v9 = _parse_ab(str(log), "=== matvec A/B v9: ")
+    assert gse == 13.741 and v9 == 3.1
+
+    # a failed variant yields None for v9 and the engage gate must stay
+    # closed (tools/hw_v9_ab.maybe_engage_flagship's first branch)
+    fake.write_text('print("xla (gse):      13.741 ms/matvec")\n'
+                    'print("pallas v9 C=8: FAILED MosaicError: nope")\n')
+    hw_session.run_step(str(log), "matvec A/B v9", [str(fake)],
+                        timeout=60, gate_s=0)
+    gse, v9 = _parse_ab(str(log), "=== matvec A/B v9: ")
+    assert gse == 13.741 and v9 is None
+
+
+def test_run_step_timeout_kills_group(tmp_path, monkeypatch):
+    """A hung step must be killed at its timeout and logged as TIMEOUT,
+    and the next step must see _last_step_ok False (the wedged-grant
+    gate trigger)."""
+    from tools import hw_session
+
+    hang = tmp_path / "hang.py"
+    hang.write_text("import time\ntime.sleep(60)\n")
+    log = tmp_path / "log.txt"
+    monkeypatch.setattr(hw_session, "_last_step_ok", True)
+    hw_session.run_step(str(log), "hang step", [str(hang)],
+                        timeout=2, gate_s=0)
+    text = log.read_text()
+    assert "TIMEOUT after 2s" in text
+    assert hw_session._last_step_ok is False
+
+
+def test_run_step_ok_rcs_verdict_exits(tmp_path, monkeypatch):
+    """Steps whose nonzero exit is a VERDICT (cache_key_check rc=4 =
+    determined MISMATCH) must not trip the next step's wedged-grant
+    gate."""
+    from tools import hw_session
+
+    v = tmp_path / "verdict.py"
+    v.write_text("import sys\nsys.exit(4)\n")
+    log = tmp_path / "log.txt"
+    monkeypatch.setattr(hw_session, "_last_step_ok", True)
+    hw_session.run_step(str(log), "verdict step", [str(v)],
+                        timeout=30, gate_s=0, ok_rcs=(0, 4))
+    assert hw_session._last_step_ok is True
+    hw_session.run_step(str(log), "verdict step strict", [str(v)],
+                        timeout=30, gate_s=0)
+    assert hw_session._last_step_ok is False

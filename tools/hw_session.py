@@ -45,7 +45,7 @@ _last_step_ok = True
 
 
 def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
-             force_gate=False):
+             force_gate=False, ok_rcs=(0,)):
     """Run one checklist step.  If the PREVIOUS step failed or timed out,
     first re-probe the accelerator (bounded by ``gate_s``): a SIGKILLed
     step wedges the device grant for minutes (docs/RUNBOOK.md), and the
@@ -107,7 +107,10 @@ def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
                 pass    # a daemonized escapee; the group is dead, move on
             status = f"TIMEOUT after {timeout}s (process group killed)"
     wall = time.monotonic() - t0
-    _last_step_ok = status == "rc=0"
+    # ok_rcs: some steps use nonzero exits as VERDICTS, not failures
+    # (cache_key_check exits 4 for a successfully-determined MISMATCH) —
+    # those must not trip the next step's wedged-grant gate
+    _last_step_ok = status in tuple(f"rc={rc}" for rc in ok_rcs)
     log_line(path, f"=== {name} done: {status} ({wall:.0f}s)")
 
 
