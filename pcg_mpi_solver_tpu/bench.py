@@ -362,6 +362,26 @@ def _accel_platform():
     return jax.devices()[0].platform
 
 
+def _run_config_extra(solver, dtype, mode, pallas_on, n_parts, t_part,
+                      platform):
+    """The run-configuration detail keys shared by the warm-insurance
+    line and the final emitted line (one place, so the two cannot
+    drift)."""
+    return {
+        "dtype": dtype,
+        "mode": mode,
+        "backend": solver.backend,
+        "pallas": bool(pallas_on),
+        # ops without a form attribute (general backend) never read the
+        # form knob; the stencil ops PIN it at construction
+        "matvec_form": getattr(solver.ops, "form", "n/a"),
+        "combine": getattr(solver.ops, "combine", "n/a"),
+        "n_parts": n_parts,
+        "partition_s": round(t_part, 2),
+        "platform": platform,
+    }
+
+
 def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
     dof_iters_per_sec = model.n_dof * iters / r1.wall_s
     # idealized 8-rank reference: perfect 8x scaling of the measured hot loop
@@ -460,8 +480,8 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
             pallas_on = False
     _log(f"# warm solve: flag={r0.flag} iters={r0.iters} "
          f"relres={r0.relres:.3e} wall={r0.wall_s:.2f}s (incl. compile)")
-    if emitter is not None and r0.flag == 0 \
-            and _accel_platform() != "cpu":
+    plat = _accel_platform() if emitter is not None else "cpu"
+    if emitter is not None and r0.flag == 0 and plat != "cpu":
         # Insurance against a device death DURING the timed solve: on
         # 2026-08-01 the tunnel died mid-timed-dispatch 29 SECONDS after
         # a COMPLETED warm solve (flag=0, 3334 iters, 83.3 s at 10.33M
@@ -469,18 +489,13 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
         # A converged warm solve is a real accelerator measurement —
         # conservative (wall includes compile + start overhead) and
         # labeled as such; the timed line displaces it at equal rank.
-        warm_extra = {
-            "dtype": dtype, "mode": mode, "backend": s.backend,
-            "pallas": bool(pallas_on),
-            "matvec_form": getattr(s.ops, "form", "n/a"),
-            "combine": getattr(s.ops, "combine", "n/a"),
-            "n_parts": n_parts,
-            "partition_s": round(t_part, 2),
-            "platform": _accel_platform(),
-            "timing": "warm (first solve; wall incl. compile/start "
-                      "overhead — conservative)",
-            "baseline_source": "validated-constant",
-        }
+        warm_extra = dict(
+            _run_config_extra(s, dtype, mode, pallas_on, n_parts, t_part,
+                              plat),
+            timing="warm (first solve; wall incl. compile/start "
+                   "overhead — conservative)",
+            baseline_source="validated-constant",
+        )
         wline = _result_json(model, kind, r0, max(r0.iters, 1),
                              VALIDATED_REF_NS_PER_DOF_ITER,
                              _VALIDATED_NOTE, warm_extra)
@@ -1010,24 +1025,14 @@ def _run_bench(cpu_fallback, provisional=False, deadline=None, emitter=None):
 
         gc.collect()                                # free device buffers
 
-    extra = {
-        "dtype": dtype,
-        "mode": mode,
-        "backend": solver.backend,
-        "pallas": bool(pallas_on),
-        # ops without a form attribute (general backend) never read the
-        # form knob; the stencil ops PIN it at construction
-        "matvec_form": getattr(solver.ops, "form", "n/a"),
-        "combine": getattr(solver.ops, "combine", "n/a"),
-        "n_parts": n_parts,
-        "partition_s": round(t_part, 2),
-        "platform": jax.devices()[0].platform + (
+    extra = _run_config_extra(
+        solver, dtype, mode, pallas_on, n_parts, t_part,
+        _accel_platform() + (
             " (CPU PROVISIONAL — fast fallback so the round artifact "
             "cannot be empty; not the TPU north-star number)"
             if provisional else
             " (CPU FALLBACK — accelerator unreachable; not the TPU "
-            "north-star number)" if cpu_fallback else ""),
-    }
+            "north-star number)" if cpu_fallback else ""))
     if provisional:
         extra["provisional"] = True
 

@@ -1358,7 +1358,7 @@ def _matvec_kernel_v9(ket_ref, sel_ref, x_hbm, ck_hbm, y_ref,
     def _prefetch():
         for_chunk(1 - slot, j + 1, "start")
 
-    xb = xv[slot]                                       # (cpp+8, 4, mt128)
+    xb = xv[slot]                                       # (cpp+1, 4, mt128)
     ckb = ckv[slot]                                     # (cpp, mt128)
     carry = acc[...]                                    # (4, mt128)
     for k in range(cpp):

@@ -40,15 +40,17 @@ def _parse_ab(path, marker):
             float(v9.group(1)) if v9 else None)
 
 
+_AB_STEP = "matvec A/B v9"
+
+
 def run_v9_ab(path):
     """A/B step + parse; returns (gse_ms, v9_ms).  Shared with
     tools/hw_wave6.py so the scarce-window sequence exists once."""
-    # NOTE the trailing colon+space: run_step also appends a
-    # "=== matvec A/B v9 done: rc=..." line, which a bare prefix would
-    # rindex instead of the step START line
-    run_step(path, "matvec A/B v9", ["examples/bench_matvec.py", "150"],
+    run_step(path, _AB_STEP, ["examples/bench_matvec.py", "150"],
              env_extra={"BENCH_MATVEC_VARIANTS": "v9"}, timeout=2400)
-    gse_ms, v9_ms = _parse_ab(path, "=== matvec A/B v9: ")
+    # the trailing colon+space anchors the STEP line — run_step also
+    # appends a "... done: rc=..." line a bare prefix would rindex
+    gse_ms, v9_ms = _parse_ab(path, f"=== {_AB_STEP}: ")
     log_line(path, f"v9 A/B parse: gse={gse_ms} ms, v9={v9_ms} ms")
     return gse_ms, v9_ms
 
