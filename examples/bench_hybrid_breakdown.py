@@ -18,6 +18,11 @@ from pcg_mpi_solver_tpu.bench import cached_model
 from pcg_mpi_solver_tpu.parallel.hybrid import (
     HybridOps, device_data_hybrid, partition_hybrid)
 
+from pcg_mpi_solver_tpu.utils.backend_probe import probe_or_exit  # noqa: E402
+
+probe_or_exit()
+
+
 
 def _sync(y):
     float(jnp.asarray(jax.tree.leaves(y)[0]).ravel()[0])

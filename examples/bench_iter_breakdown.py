@@ -19,6 +19,11 @@ from pcg_mpi_solver_tpu.parallel.structured import (
     StructuredOps, device_data_structured, partition_structured)
 
 
+from pcg_mpi_solver_tpu.utils.backend_probe import probe_or_exit  # noqa: E402
+
+probe_or_exit()
+
+
 def _sync(y):
     """Force a value transfer: on tunneled devices block_until_ready can
     ack before execution finishes (same caveat examples/bench_matvec.py

@@ -21,6 +21,11 @@ from pcg_mpi_solver_tpu.parallel.structured import (
     StructuredOps, device_data_structured, partition_structured)
 
 
+from pcg_mpi_solver_tpu.utils.backend_probe import probe_or_exit  # noqa: E402
+
+probe_or_exit()
+
+
 def timeit(fn, *args, n=20):
     y = fn(*args)
     jax.block_until_ready(y)

@@ -148,3 +148,16 @@ def settle_compile(max_attempts: int = 4,
             time.sleep(30.0 * (attempt + 1))
     return False, (f"compile service still failing after "
                    f"{max_attempts} attempts ({detail})")
+
+
+def probe_or_exit(timeout_s: float = 180.0) -> None:
+    """Probe-or-die preamble for accelerator-targeting example scripts:
+    fail in ~3 min (exit 2) instead of hanging until a queue step's
+    timeout when the tunnel is down (wave-5 burned ~50 min of queue
+    budget on two probe-less examples hanging on a dead backend)."""
+    import sys
+
+    ok, detail = probe_backend(timeout_s=timeout_s)
+    if not ok:
+        print(f"accelerator unreachable: {detail}", flush=True)
+        sys.exit(2)
