@@ -39,6 +39,20 @@ from pcg_mpi_solver_tpu.utils.backend_probe import pin_cpu_backend_if_requested
 
 pin_cpu_backend_if_requested()
 
+# jax < 0.5 ships shard_map under jax.experimental with check_rep instead
+# of check_vma; alias the modern spelling so all call sites run unchanged.
+# Importing the package must NOT itself import jax (bench.py configures
+# the accelerator env after importing obs/, and the wedged-tunnel CPU pin
+# relies on env ordering) — so only patch here if jax is already loaded;
+# the jax-importing root modules (ops/matvec.py, parallel/mesh.py) install
+# the alias for every other path.
+import sys as _sys
+
+if "jax" in _sys.modules:
+    from pcg_mpi_solver_tpu.utils.compat import ensure_shard_map
+
+    ensure_shard_map()
+
 from pcg_mpi_solver_tpu.config import SolverConfig, TimeHistoryConfig, RunConfig
 
 __all__ = [

@@ -94,6 +94,11 @@ import sys
 import threading
 import time
 
+# obs/ is import-light by contract (no jax/numpy): safe before the
+# accelerator env is configured.
+from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder, StderrSink
+from pcg_mpi_solver_tpu.obs.schema import BENCH_SCHEMA
+
 # docs/BENCH_LOG.md 2026-07-30: the reference's OWN hot loop measured at
 # 232.8 ns/dof-iter on this host at 823,875 dofs; the NumpyRefSolver
 # stand-in at 235.2 (within 1%).  Used for the provisional line and
@@ -104,8 +109,18 @@ _VALIDATED_NOTE = ("pre-validated constant (docs/BENCH_LOG.md: reference's "
                    "stand-in within 1%)")
 
 
+# The bench's metrics registry: ONE logging path for the harness and the
+# Solver it drives (the Solver is constructed with recorder=_REC).  The
+# historical "# ..." note bodies are kept; the stderr sink adds the
+# [pcg-tpu HH:MM:SS] timestamp prefix every line — the _vlog contract that
+# localizes a hung remote dispatch from the driver's captured stderr.
+# Phase timings accumulate as spans (emitted as bench_phase events) and
+# land in the final line's detail.phases.
+_REC = MetricsRecorder(sinks=[StderrSink()])
+
+
 def _log(msg):
-    print(msg, file=sys.stderr, flush=True)
+    _REC.note(msg)
 
 
 def _cpu_only_env():
@@ -404,7 +419,10 @@ def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
         "ref_measured_on": ref_note,
     }
     detail.update(extra)
+    detail["phases"] = {k: round(v["total_s"], 3)
+                       for k, v in _REC.span_stats().items()}
     return json.dumps({
+        "schema": BENCH_SCHEMA,
         "metric": "pcg_dof_iterations_per_second",
         "value": round(dof_iters_per_sec, 1),
         "unit": "dof*iter/s",
@@ -427,7 +445,8 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
 
     n_dev = len(jax.devices())
     t_gen0 = time.perf_counter()
-    model = _build_model(kind, nx, ny, nz, ot_n, ot_level)
+    with _REC.span("model_gen", emit=True):
+        model = _build_model(kind, nx, ny, nz, ot_n, ot_level)
     _log(f"# model: {model.n_elem} elems / {model.n_dof} dofs "
          f"(gen {time.perf_counter()-t_gen0:.1f}s); devices={n_dev} "
          f"parts={n_parts} dtype={dtype} mode={mode} backend={backend}")
@@ -445,7 +464,9 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
         time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
     )
     t_part0 = time.perf_counter()
-    s = Solver(model, cfg, mesh=make_mesh(), n_parts=n_parts, backend=backend)
+    with _REC.span("partition_upload", emit=True):
+        s = Solver(model, cfg, mesh=make_mesh(), n_parts=n_parts,
+                   backend=backend, recorder=_REC)
     t_part = time.perf_counter() - t_part0
     _log(f"# partition+upload: {t_part:.2f}s (backend={s.backend}, "
          f"dispatch_cap={s._dispatch_cap}, "
@@ -459,13 +480,17 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
         _log(f"# pallas path {why}; retrying with pallas=off")
         cfg.solver.pallas = "off"
         del s   # free the failed solver's device buffers before re-upload
+        # the rebuilt solver's programs recompile: reset cold/warm keying
+        # so the new compiles are booked as cold, not warm
+        _REC.reset_dispatch_attribution()
         s = Solver(model, cfg, mesh=make_mesh(), n_parts=n_parts,
-                   backend=backend)
+                   backend=backend, recorder=_REC)
         return s.step(1.0)
 
     pallas_on = getattr(s.ops, "use_pallas", False)
     try:
-        r0 = s.step(1.0)
+        with _REC.span("warm_solve", emit=True):
+            r0 = s.step(1.0)
     except Exception as e:                          # noqa: BLE001
         if not pallas_on:
             raise
@@ -504,7 +529,8 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
 
     # Measured solve from scratch state (compile cached).
     s.reset_state()
-    r1 = s.step(1.0)
+    with _REC.span("timed_solve", emit=True):
+        r1 = s.step(1.0)
     iters = max(r1.iters, 1)
     _log(f"# timed solve: flag={r1.flag} iters={iters} "
          f"relres={r1.relres:.3e} wall={r1.wall_s:.3f}s "
@@ -746,6 +772,7 @@ def _error_line(why):
     """Last-ditch zero-value line: clearly labeled, parseable, and
     impossible to mistake for a measurement."""
     return json.dumps({
+        "schema": BENCH_SCHEMA,
         "metric": "pcg_dof_iterations_per_second",
         "value": 0.0,
         "unit": "dof*iter/s",
@@ -1071,8 +1098,9 @@ def _run_bench(cpu_fallback, provisional=False, deadline=None, emitter=None):
         _log("# skipping live baseline (wall budget); "
              "returning validated-constant line")
         return const_line
-    live = _live_baseline(kind, model.n_dof, rung[0], rung[1], rung[2],
-                          rung[3], rung[4], deadline=deadline)
+    with _REC.span("live_baseline", emit=True):
+        live = _live_baseline(kind, model.n_dof, rung[0], rung[1], rung[2],
+                              rung[3], rung[4], deadline=deadline)
     if live is not None:
         ref_ns, ref_note = live
         _log(f"# numpy ref ({ref_note}): {ref_ns:.3f} ns/dof-iter")

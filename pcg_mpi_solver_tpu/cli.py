@@ -49,7 +49,47 @@ def _load_settings(path, args) -> "RunConfig":
         plot_flag=bool(th.get("PlotFlag", False)),
         export_vars=th.get("ExportVars", "U"),
     )
-    return RunConfig(solver=solver, time_history=time_history)
+    cfg = RunConfig(solver=solver, time_history=time_history)
+    _apply_telemetry_flags(cfg, args)
+    return cfg
+
+
+def _apply_telemetry_flags(cfg, args) -> None:
+    """Wire the obs/ telemetry flags (shared by solve and demo) into the
+    RunConfig: --telemetry-out (JSONL sink), --trace-resid (in-graph
+    convergence ring), --profile-spans (jax.profiler annotations)."""
+    cfg.telemetry_path = getattr(args, "telemetry_out", None) or ""
+    cfg.solver.trace_resid = int(getattr(args, "trace_resid", None) or 0)
+    if getattr(args, "profile_spans", False):
+        cfg.telemetry_profile = True
+
+
+def _finish_telemetry(solver, args) -> None:
+    """End-of-run telemetry surfaces: the --summary table and the
+    recorder's sink shutdown (flushes/closes the JSONL file)."""
+    if getattr(args, "summary", False):
+        print(solver.recorder.summary())
+    if getattr(args, "telemetry_out", None):
+        print(f">telemetry: {args.telemetry_out}")
+    solver.recorder.close()
+
+
+def _add_telemetry_flags(p) -> None:
+    p.add_argument("--telemetry-out", default=None, metavar="FILE.jsonl",
+                   help="append schema-versioned telemetry events (one "
+                        "JSON object per line: step metrics, dispatch "
+                        "timings, residual traces, run summary) here")
+    p.add_argument("--trace-resid", type=int, default=0, metavar="N",
+                   help="record the last N per-iteration (normr, rho, "
+                        "stag, flag) samples on device and surface them "
+                        "once per solve (0 = off; clamped to max_iter)")
+    p.add_argument("--summary", action="store_true",
+                   help="print the per-step / per-dispatch telemetry "
+                        "table after the run")
+    p.add_argument("--profile-spans", action="store_true",
+                   help="wrap each device dispatch in a named "
+                        "jax.profiler.TraceAnnotation (also "
+                        "PCG_TPU_PROFILE_SPANS=1)")
 
 
 def cmd_ingest(args):
@@ -117,6 +157,7 @@ def cmd_solve(args):
               f"wall={r.wall_s:.2f}s")
     td = s.time_data()
     print(f">calculation time: {td['Mean_CalcTime']:.2f} sec")
+    _finish_telemetry(s, args)
     print(">success!")
 
 
@@ -178,6 +219,7 @@ def cmd_demo(args):
               f"wall={r.wall_s:.2f}s  [{s.backend} backend]")
     files = export_vtk(model, store, vtk_vars, vtk_mode)
     print(f">wrote {len(files)} vtu files to {store.vtk_path}")
+    _finish_telemetry(s, args)
     print(">success!")
 
 
@@ -232,6 +274,7 @@ def main(argv=None):
                    help="write a jax.profiler trace of the solve here "
                         "(open with TensorBoard; shows the per-op "
                         "compute/collective split; ignored with --speed-test)")
+    _add_telemetry_flags(p)
     p.set_defaults(fn=cmd_solve)
 
     p = sub.add_parser("export", help="export result frames to VTK")
@@ -260,6 +303,7 @@ def main(argv=None):
     p.add_argument("--poisson", action="store_true",
                    help="scalar Poisson/diffusion model (1 dof per node, "
                         "heterogeneous conductivity)")
+    _add_telemetry_flags(p)
     p.set_defaults(fn=cmd_demo)
 
     p = sub.add_parser("bench", help="benchmark harness (prints one JSON line)")

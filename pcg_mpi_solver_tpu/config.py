@@ -68,6 +68,13 @@ class SolverConfig:
     # single dispatches can trip execution watchdogs on remote/tunneled
     # devices; state stays on device between dispatches.
     iters_per_dispatch: int = -1
+    # In-graph convergence tracing (obs/trace.py): ring-buffer length for
+    # the per-iteration (normr, rho, stag, flag) trace threaded through
+    # the PCG carry on device.  0 = off (the compiled program is then
+    # bit-identical to no-telemetry).  When on, the ring holds the LAST
+    # `trace_resid` iterations (clamped to max_iter) and crosses to the
+    # host ONCE per solve.  CLI: --trace-resid.
+    trace_resid: int = 0
     # Fused Pallas matvec kernel for f32 structured-backend matvecs
     # (ops/pallas_matvec.py): "auto" = on TPU devices, "on", "off",
     # "interpret" = force the kernel through the Pallas interpreter on
@@ -114,6 +121,15 @@ class RunConfig:
     # steps (0 = off).  The reference is resumable only at pipeline-stage
     # granularity (SURVEY.md §5); this adds step granularity.
     checkpoint_every: int = 0
+    # Telemetry (obs/): when set, every structured event (steps, dispatch
+    # timings, residual traces, run summary) is appended to this JSONL
+    # file, one schema-versioned object per line.  CLI: --telemetry-out.
+    telemetry_path: str = ""
+    # Opt-in jax.profiler.TraceAnnotation around each device dispatch so
+    # profiler traces show named pcg-tpu/<dispatch> regions (also
+    # PCG_TPU_PROFILE_SPANS=1).  Independent of profile_dir below, which
+    # starts/stops an actual trace collection.
+    telemetry_profile: bool = False
     # When set, the solve loop runs under a jax.profiler trace written here
     # (open with TensorBoard/XProf).  This is the TPU-native replacement for
     # the reference's hand-rolled calc vs comm-wait bracketing

@@ -1,0 +1,27 @@
+"""Solver telemetry: in-graph convergence traces (``obs.trace``), a step
+metrics registry (``obs.metrics``) and the versioned artifact schemas
+(``obs.schema``).
+
+``obs.schema`` and ``obs.metrics`` are import-light (no jax/numpy) so
+``bench.py`` can use them before configuring the accelerator environment;
+``obs.trace`` imports jax and is loaded lazily here.
+"""
+
+from pcg_mpi_solver_tpu.obs.metrics import (
+    JsonlSink, MetricsRecorder, StderrSink)
+from pcg_mpi_solver_tpu.obs.schema import BENCH_SCHEMA, TELEMETRY_SCHEMA
+
+_TRACE_NAMES = ("ConvergenceTrace", "clamp_trace_len", "empty_trace",
+                "trace_host_init", "trace_init", "trace_record",
+                "trace_specs", "unpack_trace")
+
+__all__ = ["BENCH_SCHEMA", "TELEMETRY_SCHEMA", "JsonlSink",
+           "MetricsRecorder", "StderrSink", *_TRACE_NAMES]
+
+
+def __getattr__(name):
+    if name in _TRACE_NAMES:
+        from pcg_mpi_solver_tpu.obs import trace
+
+        return getattr(trace, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,312 @@
+"""Step metrics registry: counters, gauges, wall-time spans and structured
+events, fanned out to pluggable sinks (stderr, JSONL, and — opt-in — a
+``jax.profiler`` trace annotation around each device dispatch).
+
+This is the ONE logging path of the framework: the old ``_vlog`` stderr
+breadcrumbs of ``solver/driver.py`` are now ``note`` events through a
+:class:`MetricsRecorder`, with ``PCG_TPU_VERBOSE=1`` kept as the alias
+that enables the stderr sink on the default recorder.
+
+Design constraints:
+
+* Host-side only.  Nothing here touches device buffers; enabling telemetry
+  adds zero device<->host transfers per PCG iteration (the in-graph
+  residual trace lives in ``obs/trace.py`` and is fetched once per solve).
+* Import-light.  ``bench.py`` imports this module before configuring the
+  accelerator environment, so jax is imported lazily and only when the
+  opt-in profiler spans are enabled.
+* A recorder with no sinks is a cheap null object: counters/spans still
+  accumulate (for the ``--summary`` table) but nothing is formatted or
+  written.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from pcg_mpi_solver_tpu.obs.schema import TELEMETRY_SCHEMA
+
+
+def _jsonable(v):
+    """Best-effort coercion for numpy scalars/arrays without importing
+    numpy: anything with .item()/.tolist() degrades to builtins."""
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    if hasattr(v, "item"):
+        return v.item()
+    return str(v)
+
+
+class StderrSink:
+    """Human breadcrumbs on stderr.
+
+    Every event gets the historical ``[pcg-tpu HH:MM:SS]`` prefix so
+    dispatch-hang forensics on tunneled TPUs keep working (the original
+    ``_vlog`` contract); note events print their message body verbatim
+    after it (bench.py's ``# ...`` lines keep their shape).
+    """
+
+    def __init__(self, stream=None):
+        self._stream = stream
+
+    def emit(self, ev: Dict[str, Any]) -> None:
+        stream = self._stream if self._stream is not None else sys.stderr
+        kind = ev.get("kind", "?")
+        if kind == "note":
+            body = str(ev.get("msg", ""))
+        else:
+            skip = ("schema", "t", "kind")
+            parts = []
+            for k, v in ev.items():
+                if k in skip:
+                    continue
+                if isinstance(v, (list, dict)):
+                    v = f"<{len(v)} entries>"
+                elif isinstance(v, float):
+                    v = f"{v:.6g}"
+                parts.append(f"{k}={v}")
+            body = f"{kind}: " + " ".join(parts)
+        print(f"[pcg-tpu {time.strftime('%H:%M:%S')}] {body}",
+              file=stream, flush=True)
+
+    def close(self) -> None:
+        pass
+
+
+class EnvGatedStderrSink(StderrSink):
+    """StderrSink active only while ``PCG_TPU_VERBOSE=1``, sampled PER
+    EVENT — matching the removed ``_vlog``'s per-call env check, so a
+    long-lived process can turn breadcrumbs on after the Solver was
+    constructed (the hung-dispatch forensics workflow)."""
+
+    def emit(self, ev: Dict[str, Any]) -> None:
+        if os.environ.get("PCG_TPU_VERBOSE") == "1":
+            super().emit(ev)
+
+
+class JsonlSink:
+    """Schema-versioned JSONL event stream: one JSON object per line,
+    flushed per event so a killed run still leaves a parseable file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def emit(self, ev: Dict[str, Any]) -> None:
+        self._f.write(json.dumps(ev, default=_jsonable) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except ValueError:
+            pass
+
+
+class MetricsRecorder:
+    """Counters + gauges + monotonic wall-time spans + structured events.
+
+    All mutation goes through a lock: the solver may be driven from a
+    thread while exports run elsewhere.  Events are dicts with the base
+    triplet ``schema``/``t``/``kind`` (see ``obs/schema.py``).
+    """
+
+    def __init__(self, sinks=(), profile_spans: bool = False,
+                 clock=time.monotonic):
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, Any] = {}
+        self.sinks: List[Any] = list(sinks)
+        self.profile_spans = bool(profile_spans)
+        self._clock = clock
+        self._spans: Dict[str, List[float]] = {}    # name -> [count, total_s]
+        # per-dispatch-name: [calls, cold_s, warm_s] — the first call of a
+        # jitted program pays XLA compile, so cold vs warm IS the
+        # compile-time vs execute-time split per dispatch.
+        self._dispatch: Dict[str, List[float]] = {}
+        self.step_events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def default(cls, jsonl_path: Optional[str] = None,
+                profile: Optional[bool] = None) -> "MetricsRecorder":
+        """The solver-facing factory: stderr breadcrumbs gated on
+        ``PCG_TPU_VERBOSE=1`` per event (the historical knob, checked at
+        every emit like the old ``_vlog`` so it can be flipped on a LIVE
+        process), JSONL sink iff a path is given, profiler spans iff
+        requested (or ``PCG_TPU_PROFILE_SPANS=1``)."""
+        sinks: List[Any] = [EnvGatedStderrSink()]
+        if jsonl_path:
+            sinks.append(JsonlSink(jsonl_path))
+        if profile is None:
+            profile = os.environ.get("PCG_TPU_PROFILE_SPANS") == "1"
+        return cls(sinks=sinks, profile_spans=bool(profile))
+
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            self.sinks.append(sink)
+
+    def close(self) -> None:
+        for s in self.sinks:
+            close = getattr(s, "close", None)
+            if close:
+                close()
+
+    # -- registry -------------------------------------------------------
+    def inc(self, name: str, delta: float = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + delta
+
+    def gauge(self, name: str, value) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    # -- events ---------------------------------------------------------
+    def event(self, kind: str, **fields) -> Dict[str, Any]:
+        ev = {"schema": TELEMETRY_SCHEMA, "t": time.time(), "kind": kind}
+        ev.update(fields)
+        # sink emission stays UNDER the lock: concurrent emitters (solver
+        # thread + a watchdog note) must not interleave mid-line in a
+        # shared JSONL stream
+        with self._lock:
+            if kind == "step":
+                self.step_events.append(ev)
+            for s in self.sinks:
+                s.emit(ev)
+        return ev
+
+    def note(self, msg: str) -> None:
+        self.event("note", msg=msg)
+
+    # -- timing ---------------------------------------------------------
+    @contextmanager
+    def span(self, name: str, emit: bool = False):
+        """Accumulate monotonic wall time under ``name``; ``emit=True``
+        additionally emits a ``bench_phase`` event on exit."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            dt = self._clock() - t0
+            with self._lock:
+                st = self._spans.setdefault(name, [0, 0.0])
+                st[0] += 1
+                st[1] += dt
+            if emit:
+                self.event("bench_phase", name=name, wall_s=round(dt, 6))
+
+    @contextmanager
+    def dispatch(self, name: str, emit: bool = True):
+        """Wrap one jitted device dispatch: cold/warm attribution (first
+        call of a program = the call that paid XLA compile) and, when
+        ``profile_spans`` is on, a ``jax.profiler.TraceAnnotation`` so the
+        dispatch shows up as a named region in profiler traces.
+
+        Caller contract: jax dispatch is ASYNC — keep a blocking
+        device->host fetch (``int(scalar)``, ``float(scalar)``,
+        ``block_until_ready``) inside the span, otherwise wall_s measures
+        enqueue time, not execution."""
+        with self._lock:
+            st = self._dispatch.setdefault(name, [0, 0.0, 0.0])
+            cold = st[0] == 0
+            st[0] += 1
+        if self.profile_spans:
+            import jax  # deferred: bench configures env before jax init
+
+            ann = jax.profiler.TraceAnnotation(f"pcg-tpu/{name}")
+        else:
+            ann = None
+        t0 = self._clock()
+        try:
+            if ann is not None:
+                with ann:
+                    yield
+            else:
+                yield
+        finally:
+            dt = self._clock() - t0
+            with self._lock:
+                st = self._dispatch[name]
+                st[1 if cold else 2] += dt
+            self.inc(f"dispatch.{name}.calls")
+            if emit:
+                self.event("dispatch", name=name, wall_s=round(dt, 6),
+                           cold=cold)
+
+    # -- snapshots ------------------------------------------------------
+    def dispatch_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-program compile vs execute attribution: ``cold_s`` is the
+        first call (compile + one execution), ``warm_s`` the rest."""
+        with self._lock:
+            return {k: {"calls": int(v[0]), "cold_s": v[1], "warm_s": v[2]}
+                    for k, v in self._dispatch.items()}
+
+    def span_stats(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: {"calls": int(v[0]), "total_s": v[1]}
+                    for k, v in self._spans.items()}
+
+    def reset_dispatch_attribution(self) -> None:
+        """Forget per-program cold/warm state.  Call when the programs
+        behind the dispatch names are REBUILT (e.g. a solver
+        reconstruction after a failed kernel path): the next call of each
+        name pays XLA compile again and must be booked as cold.  The
+        ``dispatch.<name>.calls`` counters reset too, so snapshot() stays
+        internally consistent."""
+        with self._lock:
+            self._dispatch.clear()
+            for k in [k for k in self.counters
+                      if k.startswith("dispatch.")]:
+                del self.counters[k]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+        return {"counters": counters, "gauges": gauges,
+                "spans": self.span_stats(),
+                "dispatches": self.dispatch_stats()}
+
+    def emit_run_summary(self) -> Dict[str, Any]:
+        return self.event("run_summary", **self.snapshot())
+
+    def summary(self) -> str:
+        """Human-readable end-of-run table (the CLI ``--summary`` output)."""
+        lines = []
+        if self.step_events:
+            lines.append(f"{'step':>5} {'flag':>4} {'iters':>7} "
+                         f"{'relres':>10} {'wall_s':>9}")
+            for ev in self.step_events:
+                lines.append(
+                    f"{ev.get('step', '?'):>5} {ev.get('flag', '?'):>4} "
+                    f"{ev.get('iters', '?'):>7} "
+                    f"{ev.get('relres', float('nan')):>10.3e} "
+                    f"{ev.get('wall_s', float('nan')):>9.3f}")
+        ds = self.dispatch_stats()
+        if ds:
+            lines.append("")
+            lines.append(f"{'dispatch':<24} {'calls':>6} {'cold_s':>9} "
+                         f"{'warm_s':>9}")
+            for name in sorted(ds):
+                d = ds[name]
+                lines.append(f"{name:<24} {d['calls']:>6} "
+                             f"{d['cold_s']:>9.3f} {d['warm_s']:>9.3f}")
+        with self._lock:
+            gauges = dict(self.gauges)
+            counters = dict(self.counters)
+        extra = {k: v for k, v in counters.items()
+                 if not k.startswith("dispatch.")}
+        if gauges:
+            lines.append("")
+            lines.extend(f"gauge {k} = {gauges[k]}" for k in sorted(gauges))
+        if extra:
+            lines.extend(f"counter {k} = {extra[k]}" for k in sorted(extra))
+        return "\n".join(lines) if lines else "(no telemetry recorded)"

@@ -1,0 +1,144 @@
+"""Telemetry schemas: the versioned contracts of every JSON artifact the
+framework emits, plus the validators `tools/check_telemetry_schema.py` and
+the tests run against committed artifacts.
+
+Two families:
+
+* **Telemetry events** (`TELEMETRY_SCHEMA`): one JSON object per line in a
+  ``--telemetry-out`` JSONL stream, produced by
+  :class:`pcg_mpi_solver_tpu.obs.metrics.MetricsRecorder`.  Every event
+  carries ``schema`` / ``t`` (unix seconds) / ``kind``; the per-kind
+  required fields are in :data:`EVENT_KINDS`.  Unknown kinds and extra
+  fields are ALLOWED (forward compatibility) — consumers must ignore what
+  they don't know; validators only reject missing required fields or a
+  schema version they don't speak.
+
+* **Bench result lines** (`BENCH_SCHEMA`): the one-line JSON contract of
+  ``bench.py`` (`{"metric", "value", "unit", "vs_baseline", ...}`).  The
+  ``schema`` key is new; committed pre-schema artifacts (BENCH_r0*.json)
+  stay valid as *legacy* lines — required keys are checked either way.
+
+This module must stay import-light (no jax, no numpy): bench.py imports it
+before configuring the accelerator environment.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+# Bump the integer suffix on any BREAKING change (key removal/retyping);
+# additive fields do not bump.
+TELEMETRY_SCHEMA = "pcg-tpu-telemetry/1"
+BENCH_SCHEMA = "pcg-tpu-bench/1"
+
+KNOWN_TELEMETRY_SCHEMAS = (TELEMETRY_SCHEMA,)
+KNOWN_BENCH_SCHEMAS = (BENCH_SCHEMA,)
+
+# kind -> required field names (beyond the base schema/t/kind triplet).
+EVENT_KINDS: Dict[str, tuple] = {
+    # one line per completed solve step (quasi-static or Newmark)
+    "step": ("step", "flag", "relres", "iters", "wall_s"),
+    # one jitted device dispatch (cold = first call of this program,
+    # i.e. the call that paid compile)
+    "dispatch": ("name", "wall_s", "cold"),
+    # per-iteration residual ring buffer, one host transfer per solve
+    "resid_trace": ("step", "n_recorded", "truncated", "normr"),
+    # free-form breadcrumb (the PCG_TPU_VERBOSE lineage)
+    "note": ("msg",),
+    # explicit-dynamics scan chunk
+    "dynamics_chunk": ("steps", "wall_s"),
+    # bench harness phase timing
+    "bench_phase": ("name", "wall_s"),
+    # end-of-run counter/gauge/span snapshot
+    "run_summary": ("counters", "gauges"),
+}
+
+BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
+
+
+def validate_event(ev: Any) -> List[str]:
+    """Validate one telemetry event dict; returns a list of error strings
+    (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(ev, dict):
+        return [f"event is not an object: {type(ev).__name__}"]
+    schema = ev.get("schema")
+    if schema is None:
+        errs.append("missing 'schema'")
+    elif schema not in KNOWN_TELEMETRY_SCHEMAS:
+        errs.append(f"unknown telemetry schema {schema!r}")
+    if not isinstance(ev.get("t"), (int, float)):
+        errs.append("missing/non-numeric 't'")
+    kind = ev.get("kind")
+    if not isinstance(kind, str) or not kind:
+        errs.append("missing 'kind'")
+        return errs
+    for field in EVENT_KINDS.get(kind, ()):
+        if field not in ev:
+            errs.append(f"kind={kind}: missing required field {field!r}")
+    return errs
+
+
+def validate_bench_line(d: Any) -> List[str]:
+    """Validate one bench result object (the parsed one-line JSON)."""
+    errs: List[str] = []
+    if not isinstance(d, dict):
+        return [f"bench line is not an object: {type(d).__name__}"]
+    for field in BENCH_REQUIRED:
+        if field not in d:
+            errs.append(f"missing required key {field!r}")
+    if "value" in d and not isinstance(d["value"], (int, float)):
+        errs.append(f"'value' is not numeric: {d['value']!r}")
+    schema = d.get("schema")
+    if schema is not None and schema not in KNOWN_BENCH_SCHEMAS:
+        errs.append(f"unknown bench schema {schema!r}")
+    # schema-less lines are legacy (pre-schema artifacts) — still valid.
+    return errs
+
+
+def validate_jsonl_text(text: str) -> List[str]:
+    """Validate a telemetry JSONL payload line by line."""
+    errs: List[str] = []
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError as e:
+            errs.append(f"line {ln}: not JSON ({e})")
+            continue
+        errs.extend(f"line {ln}: {e}" for e in validate_event(ev))
+    return errs
+
+
+def _find_bench_payload(doc: Any) -> Any:
+    """Locate the metric object inside a committed BENCH_*.json artifact:
+    either the raw one-line dict, or the round wrapper
+    ``{"n", "cmd", "rc", "tail", "parsed": {...}}``."""
+    if isinstance(doc, dict) and "metric" in doc:
+        return doc
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return None
+
+
+def validate_bench_text(text: str) -> List[str]:
+    """Validate a BENCH_*.json artifact (raw line or round wrapper).
+
+    A round wrapper whose bench run failed (``rc`` != 0, ``parsed`` null —
+    BENCH_r01..r03 are committed examples) is a legitimate artifact: the
+    driver captured a crash, not a malformed metric.  Only a wrapper that
+    CLAIMS success (rc == 0) must carry a valid payload."""
+    try:
+        doc = json.loads(text)
+    except ValueError as e:
+        return [f"not JSON ({e})"]
+    payload = _find_bench_payload(doc)
+    if payload is None:
+        if (isinstance(doc, dict) and "rc" in doc and "parsed" in doc
+                and doc.get("parsed") is None and doc.get("rc") != 0):
+            return []       # failed-round wrapper: no metric to validate
+        return ["no bench metric object found (neither top-level nor "
+                "under 'parsed')"]
+    return validate_bench_line(payload)

@@ -1,0 +1,126 @@
+"""In-graph convergence tracing: a fixed-size ring buffer threaded through
+the PCG carry, recording per-iteration ``normr`` / ``rho`` / ``stag`` /
+``flag`` ON DEVICE inside the ``lax.while_loop``.
+
+The whole point is cost profile: with tracing ON the per-iteration cost is
+four dynamic-index scalar stores into device-resident arrays (no psum —
+the recorded scalars are already replicated reduction results), and the
+buffer crosses to the host ONCE per solve (it rides the resumable carry
+across dispatch chunks, so even a billion-DOF chunked solve makes one
+transfer).  With tracing OFF nothing is threaded at all: the carry pytree
+is unchanged and the compiled program is bit-identical to pre-telemetry.
+
+The ring length is static (shapes must be); when a solve runs longer than
+the ring, the oldest entries are overwritten and :func:`unpack_trace`
+returns the LAST ``length`` iterations in order, flagged ``truncated``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+TRACE_FIELDS = ("normr", "rho", "stag", "flag")
+
+
+def clamp_trace_len(length: int, max_iter: int) -> int:
+    """Ring sizes are clamped to [1, max_iter]: a ring longer than the
+    iteration budget only wastes HBM, and zero/negative lengths are the
+    caller's 'off' encoding (callers gate on > 0 before init)."""
+    return max(1, min(int(length), max(int(max_iter), 1)))
+
+
+def trace_init(length: int, dtype=jnp.float32) -> dict:
+    """Empty device ring buffer.  ``dtype`` is the float dtype for
+    normr/rho (use the solve's dot dtype; f32 for mixed-mode inner
+    iterations, whose recorded values are rescaled to absolute residuals
+    via ``trace_scale``)."""
+    length = max(1, int(length))
+    return dict(
+        normr=jnp.zeros((length,), dtype),
+        rho=jnp.zeros((length,), dtype),
+        stag=jnp.zeros((length,), jnp.int32),
+        flag=jnp.zeros((length,), jnp.int32),
+        n=jnp.asarray(0, jnp.int32),
+    )
+
+
+def trace_host_init(length: int, dtype=np.float32) -> dict:
+    """Host (numpy) twin of :func:`trace_init` for call sites that feed a
+    jitted program its initial trace from the host (chunked mixed path)."""
+    length = max(1, int(length))
+    return dict(
+        normr=np.zeros((length,), dtype),
+        rho=np.zeros((length,), dtype),
+        stag=np.zeros((length,), np.int32),
+        flag=np.zeros((length,), np.int32),
+        n=np.asarray(0, np.int32),
+    )
+
+
+def trace_specs(rep_spec) -> dict:
+    """shard_map PartitionSpecs: every ring field is a replicated scalar
+    stream (the recorded values are post-psum reduction results)."""
+    return dict(normr=rep_spec, rho=rep_spec, stag=rep_spec, flag=rep_spec,
+                n=rep_spec)
+
+
+def trace_record(tr: dict, *, normr, rho, stag, flag, scale=None) -> dict:
+    """Functional ring-buffer append (one slot per committed iteration).
+    ``scale`` rescales the recorded residual norm (mixed-mode inner solves
+    iterate on r/||r||; scale=||r|| restores absolute residuals)."""
+    length = tr["normr"].shape[0]
+    idx = jnp.mod(tr["n"], length)
+    v = normr if scale is None else normr * scale
+    return dict(
+        normr=tr["normr"].at[idx].set(v.astype(tr["normr"].dtype)),
+        rho=tr["rho"].at[idx].set(rho.astype(tr["rho"].dtype)),
+        stag=tr["stag"].at[idx].set(stag.astype(jnp.int32)),
+        flag=tr["flag"].at[idx].set(flag.astype(jnp.int32)),
+        n=tr["n"] + 1,
+    )
+
+
+class ConvergenceTrace(NamedTuple):
+    """Host-side unpacked trace, oldest -> newest."""
+
+    normr: np.ndarray          # per-iteration residual norm (absolute)
+    rho: np.ndarray            # per-iteration z.r inner product
+    stag: np.ndarray           # stagnation counter
+    flag: np.ndarray           # flag decided AT that iteration (1 = running)
+    n_recorded: int            # total iterations recorded (>= len(normr)
+    #                            when the ring wrapped)
+    truncated: bool            # True when older entries were overwritten
+
+    def to_event_fields(self, step: int) -> dict:
+        """The ``resid_trace`` telemetry event payload for this trace."""
+        return dict(step=step, n_recorded=int(self.n_recorded),
+                    truncated=bool(self.truncated),
+                    normr=[float(v) for v in self.normr],
+                    rho=[float(v) for v in self.rho],
+                    stag=[int(v) for v in self.stag],
+                    flag=[int(v) for v in self.flag])
+
+
+def empty_trace() -> ConvergenceTrace:
+    z = np.zeros((0,))
+    zi = np.zeros((0,), np.int32)
+    return ConvergenceTrace(z, z.copy(), zi, zi.copy(), 0, False)
+
+
+def unpack_trace(tr: dict) -> ConvergenceTrace:
+    """Device/host ring dict -> ordered :class:`ConvergenceTrace`.  Call
+    once per solve (this is THE host transfer when given device arrays)."""
+    n = int(np.asarray(tr["n"]))
+    arrs = {k: np.asarray(tr[k]) for k in TRACE_FIELDS}
+    length = arrs["normr"].shape[0]
+    if n <= length:
+        sel = np.arange(n)
+    else:
+        sel = (np.arange(length) + n) % length
+    return ConvergenceTrace(
+        normr=arrs["normr"][sel], rho=arrs["rho"][sel],
+        stag=arrs["stag"][sel], flag=arrs["flag"][sel],
+        n_recorded=n, truncated=n > length)

@@ -33,6 +33,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from pcg_mpi_solver_tpu.parallel.partition import PartitionedModel
+from pcg_mpi_solver_tpu.utils.compat import ensure_shard_map
+
+# jax < 0.5 compat: every jax-importing root module of the package
+# installs the jax.shard_map alias (the package __init__ must stay
+# jax-free for bench.py's env-ordering contract).
+ensure_shard_map()
 
 
 def device_data(pm: PartitionedModel, dtype=jnp.float64,
@@ -400,6 +406,25 @@ class Ops:
     def matvec(self, data: dict, x: jnp.ndarray) -> jnp.ndarray:
         """Full assembled K.x across all parts (reference calcMPFint)."""
         return self.iface_assemble(data, self.matvec_local(data, x))
+
+    def comm_estimate(self, storage_dtype=None) -> dict:
+        """Static per-PCG-iteration collective estimate from the ops
+        shapes, for the telemetry gauges (obs/metrics.py): each iteration
+        runs 3 scalar/fused psums (rho+inf, pq, fused 3-norm — 6 reduced
+        scalars total) plus the interface-assembly psum inside the matvec,
+        whose payload is the shared-dof vector.  ``bytes_per_iter_est`` is
+        the per-device psum payload, not link traffic (the actual wire
+        cost depends on the all-reduce algorithm and topology)."""
+        itemsize = jnp.dtype(storage_dtype if storage_dtype is not None
+                             else self.dot_dtype).itemsize
+        dot_bytes = jnp.dtype(self.dot_dtype).itemsize
+        n_iface = int(self.n_iface)
+        return {
+            "psums_per_iter": 4 if n_iface else 3,
+            "iface_dofs": n_iface,
+            "reduce_scalars_per_iter": 6,
+            "bytes_per_iter_est": n_iface * itemsize + 6 * dot_bytes,
+        }
 
     def diag(self, data: dict) -> jnp.ndarray:
         return self.iface_assemble(data, self.diag_local(data))

@@ -13,6 +13,11 @@ import jax
 import numpy as np
 
 from pcg_mpi_solver_tpu.utils.backend_probe import pin_cpu_backend_if_requested
+from pcg_mpi_solver_tpu.utils.compat import ensure_shard_map
+
+# jax < 0.5 compat: alias jax.shard_map before any call site runs (the
+# package __init__ must stay jax-free; see ops/matvec.py).
+ensure_shard_map()
 
 PARTS_AXIS = "parts"
 

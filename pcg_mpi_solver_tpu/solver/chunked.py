@@ -18,11 +18,13 @@ finalize programs and the host-side budget loop.  Used by
 
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
+from pcg_mpi_solver_tpu.obs.trace import trace_host_init, trace_specs
 from pcg_mpi_solver_tpu.solver.pcg import (
     carry_part_specs, cold_carry, pcg, refine_tol, select_best)
 
@@ -40,7 +42,8 @@ class ChunkedEngine:
 
     def __init__(self, *, mesh, data_specs, part_spec, rep_spec, ops,
                  scfg, glob_n_dof_eff: int, cap: int, mixed: bool,
-                 ops32=None, amul_fn=None):
+                 ops32=None, amul_fn=None, trace_len: int = 0,
+                 recorder=None):
         """``amul_fn``, when given, is a host-level callable
         ``(data, v) -> eff * K.v`` backed by ONE separately-jitted
         program the caller shares across all its out-of-loop f64 matvec
@@ -48,13 +51,26 @@ class ChunkedEngine:
         every stencil INSTANTIATION costs minutes of compile
         (docs/BENCH_LOG.md 2026-07-31), so the refine step is then
         composed from two tiny elementwise programs around it instead of
-        instantiating the stencil a second time in its own program."""
+        instantiating the stencil a second time in its own program.
+
+        ``trace_len`` > 0 threads the in-graph convergence ring
+        (obs/trace.py) through the dispatch carries: in direct mode the
+        ring rides the caller-built cold carry (``cold_carry(...,
+        trace=...)``), in mixed mode the engine owns it and hands it from
+        cycle to cycle.  Either way the ring stays device-resident across
+        all dispatches of a solve and is surfaced once, as
+        ``self.last_trace``, after :meth:`run` terminates.  ``recorder``
+        (obs/metrics.py MetricsRecorder) gets a ``dispatch`` span around
+        every jitted call; None disables that instrumentation."""
         self.mixed = mixed
         self.scfg = scfg
         self._amul_fn = amul_fn
+        self.trace_len = int(trace_len)
+        self._rec = recorder
+        self.last_trace = None
         cap = int(cap)
         P, R = part_spec, rep_spec
-        carry_specs = carry_part_specs(P, R)
+        carry_specs = carry_part_specs(P, R, trace=self.trace_len > 0)
 
         def smap(f, in_specs, out_specs):
             return jax.jit(jax.shard_map(
@@ -71,20 +87,24 @@ class ChunkedEngine:
             #   refine:      f64 solution update + true-residual recompute.
             dd32 = jnp.float32
 
-            def _inner_start(data, r, normr, n2b):
+            traced = self.trace_len > 0
+
+            def _inner_start(data, r, normr, n2b, trace=None):
                 tol_cycle = refine_tol(scfg.tol * n2b, normr, scfg.inner_tol)
                 rhat32 = (r / normr).astype(dd32)
                 # ||rhat||_w = ||r||_w / normr = 1 exactly; no matvec needed.
                 one = jnp.asarray(1.0, ops32.dot_dtype)
                 carry0 = cold_carry(jnp.zeros_like(rhat32), rhat32, one,
-                                    ops32.dot_dtype)
+                                    ops32.dot_dtype, trace=trace)
                 return rhat32, tol_cycle, carry0
 
+            in_start = (data_specs, P, R, R) + (
+                (trace_specs(R),) if traced else ())
             self._inner_start_fn = smap(
-                _inner_start, (data_specs, P, R, R), (P, R, carry_specs))
+                _inner_start, in_start, (P, R, carry_specs))
 
             def _inner_cycle(data, rhat32, prec32, tol_cycle, carry32,
-                             budget):
+                             budget, scale=None):
                 res, carry2 = pcg(
                     ops32, data["f32"], rhat32, carry32["x"], prec32,
                     tol=tol_cycle,
@@ -96,12 +116,16 @@ class ChunkedEngine:
                     plateau_window=scfg.mixed_plateau_window,
                     progress_window=scfg.mixed_progress_window,
                     progress_ratio=scfg.mixed_progress_ratio,
-                    progress_min_gain=scfg.mixed_progress_min_gain)
+                    progress_min_gain=scfg.mixed_progress_min_gain,
+                    # inner iterations run on r/normr: the ring records
+                    # absolute residuals via the cycle's refresh norm
+                    trace_scale=scale)
                 return res.x, carry2, res.flag
 
+            in_cycle = (data_specs, P, P, R, carry_specs, R) + (
+                (R,) if traced else ())
             self._inner_cycle_fn = smap(
-                _inner_cycle, (data_specs, P, P, R, carry_specs, R),
-                (P, carry_specs, R))
+                _inner_cycle, in_cycle, (P, carry_specs, R))
 
             if amul_fn is None:
                 def _refine(data, fext, x, xinc32, scale):
@@ -165,6 +189,21 @@ class ChunkedEngine:
             self._final_fn = smap(
                 _final, (data_specs, P, carry_specs), (P, R))
 
+    def _disp(self, name: str):
+        """Dispatch span (compile/execute attribution + optional profiler
+        annotation) when a recorder is attached; free otherwise.
+
+        jax dispatch is asynchronous: a span only measures device
+        execution when the blocking scalar fetch sits INSIDE it, so every
+        call site keeps its ``int(...)``/``float(...)`` coercions in the
+        block.  Spans with no scalar of their own (inner_start, final32)
+        time the enqueue; their execution is absorbed by the next fetching
+        span (the device serializes programs), so per-CYCLE attribution
+        stays correct."""
+        if self._rec is None:
+            return contextlib.nullcontext()
+        return self._rec.dispatch(name)
+
     def run(self, data, fext, carry, normr0, n2b, prec,
             vlog: Optional[Callable[[str], None]] = None):
         """Budget loop from a prepared start state to termination.
@@ -174,9 +213,14 @@ class ChunkedEngine:
         direct mode).  Returns ``(x_fin, flag, relres, total_iters)``.
         The caller handles the ``n2b == 0`` and already-converged early
         exits (they need no dispatches).
+
+        With ``trace_len`` > 0 the convergence ring of the finished solve
+        is left (device-resident) on ``self.last_trace`` — unpack it with
+        ``obs.trace.unpack_trace`` (that is the single host transfer).
         """
         scfg = self.scfg
         vlog = vlog or (lambda s: None)
+        self.last_trace = None
         n2b_f = float(n2b)
         tolb = scfg.tol * n2b_f
         total, flag = 0, 1
@@ -188,36 +232,55 @@ class ChunkedEngine:
         if self.mixed:
             x, r, normr = carry["x"], carry["r"], normr0
             stall = 0
+            trace = (trace_host_init(self.trace_len)
+                     if self.trace_len > 0 else None)
             while flag == 1 and total < scfg.max_iter:
                 prev = cur
                 # One refinement cycle: run the f32 inner solve to ITS
                 # convergence via resumable capped dispatches, then refine.
                 vlog(f"inner_start dispatch (normr={float(normr):.3e})")
-                rhat32, tol_cycle, c32 = self._inner_start_fn(
-                    data, r, normr, n2b)
+                start_args = (data, r, normr, n2b) + (
+                    (trace,) if trace is not None else ())
+                with self._disp("inner_start"):
+                    rhat32, tol_cycle, c32 = self._inner_start_fn(*start_args)
                 inner_flag, xin = 1, None
                 while inner_flag == 1 and total < scfg.max_iter:
                     budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
                     vlog(f"inner_cycle dispatch (total={total})")
-                    xin, c32, iflag = self._inner_cycle_fn(
-                        data, rhat32, prec, tol_cycle, c32, budget)
-                    total += int(c32["exec"])
-                    inner_flag = int(iflag)
+                    cyc_args = (data, rhat32, prec, tol_cycle, c32,
+                                budget) + ((normr,) if trace is not None
+                                           else ())
+                    with self._disp("inner_cycle"):
+                        xin, c32, iflag = self._inner_cycle_fn(*cyc_args)
+                        # scalar fetches INSIDE the span: jax dispatch is
+                        # async, so the span only measures execution if it
+                        # contains the blocking host transfer
+                        total += int(c32["exec"])
+                        inner_flag = int(iflag)
                     vlog(f"inner_cycle done: +{int(c32['exec'])} iters "
                          f"flag={inner_flag}")
+                if trace is not None:
+                    # ring hand-off to the next cycle (device-to-device)
+                    trace = c32["trace"]
                 if inner_flag != 0:
                     # Failed/exhausted inner solve: min-residual selection
                     # (the resumable path defers it; matches one-shot
                     # pcg_mixed's inner finalize_bad).
-                    xin = self._final32_fn(data, rhat32, c32)
+                    with self._disp("final32"):
+                        xin = self._final32_fn(data, rhat32, c32)
                 vlog("refine dispatch (f64 true-residual matvec)")
-                if self._amul_fn is None:
-                    x, r, normr = self._refine_fn(data, fext, x, xin, normr)
-                else:
-                    x = self._refine_pre_fn(x, xin, normr)
-                    r, normr = self._refine_post_fn(
-                        data, fext, self._amul_fn(data, x))
-                cur = float(normr)
+                with self._disp("refine"):
+                    if self._amul_fn is None:
+                        x, r, normr = self._refine_fn(
+                            data, fext, x, xin, normr)
+                    else:
+                        x = self._refine_pre_fn(x, xin, normr)
+                        r, normr = self._refine_post_fn(
+                            data, fext, self._amul_fn(data, x))
+                    # blocking fetch inside the span (async dispatch) —
+                    # this also absorbs any still-running earlier program
+                    # (inner_start/final32 spans have no fetch of their own)
+                    cur = float(normr)
                 vlog(f"refine done: relres={cur / n2b_f:.3e} total={total}")
                 if cur <= tolb:
                     flag = 0
@@ -231,19 +294,26 @@ class ChunkedEngine:
                 else:
                     stall = 0
             x_fin, relres = x, cur / n2b_f
+            self.last_trace = trace
         else:
             while flag == 1 and total < scfg.max_iter:
                 budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
-                x_fin, carry, cflag, crelres = self._cycle_fn(
-                    data, fext, prec, carry, budget)
-                total += int(carry["exec"])
-                flag = int(cflag)
-                relres = float(crelres)
+                with self._disp("cycle"):
+                    x_fin, carry, cflag, crelres = self._cycle_fn(
+                        data, fext, prec, carry, budget)
+                    # scalar fetches INSIDE the span (async dispatch): the
+                    # span must contain the blocking transfer to time
+                    # execution, not enqueue
+                    total += int(carry["exec"])
+                    flag = int(cflag)
+                    relres = float(crelres)
             if flag != 0:
                 # Terminal failure: the resumable path defers MATLAB pcg's
                 # min-residual fallback to here (once per step).
-                x_fin, relres_dev = self._final_fn(data, fext, carry)
-                relres = float(relres_dev)
+                with self._disp("final"):
+                    x_fin, relres_dev = self._final_fn(data, fext, carry)
+                    relres = float(relres_dev)
+            self.last_trace = carry.get("trace")
         return x_fin, flag, relres, total
 
 

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import sys
 import time
 from functools import partial
 from typing import Callable, List, Optional
@@ -23,20 +22,20 @@ import numpy as np
 
 from pcg_mpi_solver_tpu.config import RunConfig
 from pcg_mpi_solver_tpu.models.model_data import ModelData
+from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
+from pcg_mpi_solver_tpu.obs.trace import (
+    ConvergenceTrace, clamp_trace_len, empty_trace, trace_init, trace_specs,
+    unpack_trace)
 from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
 from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
 from pcg_mpi_solver_tpu.parallel.partition import PartitionedModel, partition_model
 from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_mixed
 
-
-def _vlog(msg: str) -> None:
-    """Dispatch-level breadcrumbs (PCG_TPU_VERBOSE=1): on tunneled TPUs a
-    pathological remote compile or execution hangs with no host activity;
-    these timestamps localize which dispatch it was."""
-    if os.environ.get("PCG_TPU_VERBOSE") == "1":
-        print(f"[pcg-tpu {time.strftime('%H:%M:%S')}] {msg}",
-              file=sys.stderr, flush=True)
-
+# The old `_vlog` stderr breadcrumb path is gone: dispatch-level
+# breadcrumbs (which localize a hung remote compile/execution on tunneled
+# TPUs) are now `note`/`dispatch` events through the solver's
+# MetricsRecorder (obs/metrics.py).  PCG_TPU_VERBOSE=1 still enables the
+# stderr sink on the default recorder — same knob, one logging path.
 
 _PALLAS_PROBE: dict = {}
 
@@ -123,9 +122,18 @@ class Solver:
         n_parts: Optional[int] = None,
         elem_part: Optional[np.ndarray] = None,
         backend: str = "auto",   # "auto" | "structured" | "hybrid" | "general"
+        recorder: Optional[MetricsRecorder] = None,
     ):
         self._t_init0 = time.perf_counter()
         self.config = config or RunConfig()
+        # Telemetry: an injected recorder wins; otherwise build the default
+        # (stderr sink iff PCG_TPU_VERBOSE=1 — the historical knob — plus a
+        # JSONL sink iff config.telemetry_path is set).
+        self.recorder = recorder if recorder is not None else (
+            MetricsRecorder.default(
+                jsonl_path=self.config.telemetry_path or None,
+                profile=True if self.config.telemetry_profile else None))
+        self._rec = self.recorder
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
         if n_parts is None:
@@ -350,6 +358,32 @@ class Solver:
 
         glob_n_eff = self.pm.glob_n_dof_eff
 
+        # Static telemetry gauges: problem size, backend, and the per-PCG-
+        # iteration collective estimate from the ops shapes (psum count /
+        # payload bytes) — reported in the run_summary event.
+        self._rec.gauge("backend", self.backend)
+        self._rec.gauge("n_parts", int(self.pm.n_parts))
+        self._rec.gauge("n_dof", int(self.pm.glob_n_dof))
+        self._rec.gauge("precision_mode", solver_cfg.precision_mode)
+        # mixed mode: the Krylov iterations (vectors AND dot reductions)
+        # run on the f32 ops, so that is the ops object to size from
+        est_ops = self.ops32 if self.mixed else self.ops
+        iter_dtype = jnp.float32 if self.mixed else dtype
+        for k, v in est_ops.comm_estimate(storage_dtype=iter_dtype).items():
+            self._rec.gauge(f"comm.{k}", v)
+
+        # In-graph convergence trace: ring length (0 = off) and its float
+        # dtype — the dot dtype of whatever runs the Krylov iterations
+        # (f32 for the mixed inner cycles, whose records are rescaled to
+        # absolute residuals).
+        self.trace_len = (clamp_trace_len(solver_cfg.trace_resid,
+                                          solver_cfg.max_iter)
+                          if solver_cfg.trace_resid > 0 else 0)
+        self._trace_dtype = (jnp.float32 if self.mixed
+                             else jnp.dtype(solver_cfg.dot_dtype))
+        self.last_trace: Optional[ConvergenceTrace] = None
+        trace_len = self.trace_len
+
         def _step(data, un_prev, delta):
             data64 = data["f64"] if self.mixed else data
             eff = data64["eff"]
@@ -359,6 +393,8 @@ class Solver:
             fdi = self.ops.matvec(data64, udi)
             fext = eff * (data64["F"] * delta - fdi)
             x0 = eff * un_prev
+            trace0 = (trace_init(trace_len, self._trace_dtype)
+                      if trace_len else None)
             if self.mixed:
                 data32 = data["f32"]
                 # preconditioner rebuild in f32 (pcg_solver.py:346-352)
@@ -374,6 +410,7 @@ class Solver:
                     progress_window=solver_cfg.mixed_progress_window,
                     progress_ratio=solver_cfg.mixed_progress_ratio,
                     progress_min_gain=solver_cfg.mixed_progress_min_gain,
+                    trace_in=trace0,
                 )
             else:
                 # preconditioner rebuild (pcg_solver.py:346-352)
@@ -383,15 +420,22 @@ class Solver:
                     tol=solver_cfg.tol, max_iter=solver_cfg.max_iter,
                     glob_n_dof_eff=glob_n_eff,
                     max_stag_steps=solver_cfg.max_stag_steps,
+                    trace_in=trace0,
                 )
+            if trace_len:
+                res, trace = res
             un = res.x + udi
-            return un, res.flag, res.relres, res.iters
+            out = (un, res.flag, res.relres, res.iters)
+            return out + ((trace,) if trace_len else ())
 
+        R = self._rep_spec
+        step_out = (self._part_spec, R, R, R) + (
+            (trace_specs(R),) if trace_len else ())
         shard_step = jax.shard_map(
             _step,
             mesh=self.mesh,
             in_specs=(self._specs, self._part_spec, self._rep_spec),
-            out_specs=(self._part_spec, self._rep_spec, self._rep_spec, self._rep_spec),
+            out_specs=step_out,
             check_vma=False,
         )
         self._step_fn = jax.jit(shard_step)
@@ -450,7 +494,11 @@ class Solver:
         from pcg_mpi_solver_tpu.solver.pcg import carry_part_specs, cold_carry
 
         P, R = self._part_spec, self._rep_spec
-        carry_specs = carry_part_specs(P, R)
+        # Direct mode threads the convergence ring through the dispatch
+        # carry built here; in mixed mode the engine owns the ring (it
+        # rides the f32 inner carries instead).
+        trace_direct = self.trace_len > 0 and not mixed
+        carry_specs = carry_part_specs(P, R, trace=trace_direct)
 
         # The ONE program holding the out-of-loop f64 stencil: Dirichlet
         # lifting, r0, and every refinement's true-residual matvec all
@@ -515,7 +563,10 @@ class Solver:
             r0 = fext - kx0
             n2b = jnp.sqrt(self.ops.wdot(w, fext, fext))
             normr0 = jnp.sqrt(self.ops.wdot(w, r0, r0))
-            carry0 = cold_carry(x0, r0, normr0, self.ops.dot_dtype)
+            carry0 = cold_carry(
+                x0, r0, normr0, self.ops.dot_dtype,
+                trace=(trace_init(self.trace_len, self._trace_dtype)
+                       if trace_direct else None))
             # preconditioner rebuild once per step (not per dispatch /
             # refinement cycle): f32 for the mixed inner solves.
             if mixed:
@@ -534,7 +585,8 @@ class Solver:
             rep_spec=R, ops=self.ops, scfg=scfg,
             glob_n_dof_eff=glob_n_eff, cap=self._dispatch_cap,
             mixed=mixed, ops32=self.ops32 if mixed else None,
-            amul_fn=self._amul64_fn)
+            amul_fn=self._amul64_fn, trace_len=self.trace_len,
+            recorder=self._rec)
         self._finish_fn = jax.jit(lambda x, udi: x + udi)
 
     def _step_chunked(self, delta):
@@ -544,21 +596,29 @@ class Solver:
         PCG); the resumable carry makes direct-mode dispatches iteration-
         for-iteration identical to one long solve, and chunk boundaries
         align with refinement cycles in mixed mode."""
-        _vlog("start dispatch (lifting + r0; first call pays compile)")
+        rec = self._rec
+        rec.note("start dispatch (lifting + r0; first call pays compile)")
         delta_dev = jnp.asarray(delta, self.dtype)
-        udi = self._start_pre_fn(self.data, delta_dev)
-        kudi = self._amul64_fn(self.data, udi)
-        fext, x0 = self._start_mid_fn(self.data, self.un, delta_dev, kudi)
-        kx0 = self._amul64_fn(self.data, x0)
-        carry, normr0, n2b, prec = self._start_post_fn(
-            self.data, fext, x0, kx0)
-        n2b_f = float(n2b)
-        _vlog(f"start_fn done; ||b||={n2b_f:.3e}")
+        with rec.dispatch("start"):
+            udi = self._start_pre_fn(self.data, delta_dev)
+            kudi = self._amul64_fn(self.data, udi)
+            fext, x0 = self._start_mid_fn(self.data, self.un, delta_dev,
+                                          kudi)
+            kx0 = self._amul64_fn(self.data, x0)
+            carry, normr0, n2b, prec = self._start_post_fn(
+                self.data, fext, x0, kx0)
+            n2b_f = float(n2b)
+        rec.note(f"start_fn done; ||b||={n2b_f:.3e}")
         if n2b_f == 0.0:
             self.un = self._finish_fn(jnp.zeros_like(carry["x"]), udi)
+            self.last_trace = empty_trace() if self.trace_len else None
             return 0, 0.0, 0
         x_fin, flag, relres, total = self._engine.run(
-            self.data, fext, carry, normr0, n2b, prec, vlog=_vlog)
+            self.data, fext, carry, normr0, n2b, prec, vlog=rec.note)
+        if self.trace_len:
+            tr = self._engine.last_trace
+            self.last_trace = (unpack_trace(tr) if tr is not None
+                               else empty_trace())
         self.un = self._finish_fn(x_fin, udi)
         return flag, relres, total
 
@@ -576,13 +636,19 @@ class Solver:
         if self._dispatch_cap > 0:
             flag, relres, iters = self._step_chunked(delta)
         else:
-            un, flag, relres, iters = self._step_fn(
-                self.data, self.un, jnp.asarray(delta, self.dtype))
+            with self._rec.dispatch("step"):
+                out = self._step_fn(
+                    self.data, self.un, jnp.asarray(delta, self.dtype))
+                un, flag, relres, iters = out[:4]
+                # Scalar fetch INSIDE the timed region and the dispatch
+                # span: on tunneled devices block_until_ready can ack
+                # before execution finishes (and async dispatch returns
+                # immediately); fetching the scalars can't.
+                flag, relres, iters = int(flag), float(relres), int(iters)
+            # trace ring: the solve's ONE device->host trace transfer
+            self.last_trace = (unpack_trace(out[4]) if self.trace_len
+                               else None)
             self.un = un
-        # Force a value transfer INSIDE the timed region: on tunneled devices
-        # block_until_ready can ack before execution finishes; fetching the
-        # scalars can't.
-        flag, relres, iters = int(flag), float(relres), int(iters)
         wall = time.perf_counter() - t0
         res = StepResult(flag, relres, iters, wall)
         self.flags.append(res.flag)
@@ -590,6 +656,12 @@ class Solver:
         self.iters.append(res.iters)
         self.step_times.append(wall)
         self._proc_step_times.append(wall)
+        step_i = len(self.flags)
+        self._rec.event("step", step=step_i, flag=flag, relres=relres,
+                        iters=iters, wall_s=round(wall, 6))
+        if self.trace_len and self.last_trace is not None:
+            self._rec.event("resid_trace",
+                            **self.last_trace.to_event_fields(step_i))
         return res
 
     def solve(self, on_step: Optional[Callable[[int, StepResult], None]] = None,
@@ -689,6 +761,9 @@ class Solver:
                     if self.config.comm_probe_iters > 0 else None)
             store.write_time_data(self.pm.n_parts,
                                   self.time_data(t_prep, comm))
+        # End-of-run snapshot (counters/gauges/dispatch attribution) as the
+        # final JSONL event — also the data behind the CLI --summary table.
+        self._rec.emit_run_summary()
         return results
 
     def _maybe_export(self, store, t: int):

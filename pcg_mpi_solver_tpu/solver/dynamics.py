@@ -22,6 +22,7 @@ boundaries (export frames) surface to the host.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -30,6 +31,7 @@ import numpy as np
 
 from pcg_mpi_solver_tpu.config import RunConfig
 from pcg_mpi_solver_tpu.models.model_data import ModelData
+from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
 from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
 from pcg_mpi_solver_tpu.solver.driver import _data_specs
 
@@ -67,8 +69,17 @@ class DynamicsSolver:
         damping: float = 0.0,          # c_m: mass-proportional damping
         probe_dofs: Sequence[int] = (),
         backend: str = "auto",         # "auto" | "hybrid" | "general"
+        recorder: Optional[MetricsRecorder] = None,
     ):
         self.config = config or RunConfig()
+        # Telemetry registry (obs/metrics.py): same default wiring as the
+        # quasi-static Solver — stderr sink iff PCG_TPU_VERBOSE=1, JSONL
+        # sink iff config.telemetry_path is set.
+        self.recorder = recorder if recorder is not None else (
+            MetricsRecorder.default(
+                jsonl_path=self.config.telemetry_path or None,
+                profile=True if self.config.telemetry_profile else None))
+        self._rec = self.recorder
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
         n_parts = n_parts or max(self.config.n_parts, n_dev)
@@ -187,15 +198,26 @@ class DynamicsSolver:
         u, v = self.u, self.v
         while done < n_steps:
             k = min(chunk, n_steps - done)
-            u, v, pr = self._chunk_fn(
-                self.data, (u, v),
-                jnp.asarray(deltas[done:done + k], self.dtype))
-            probes.append(np.asarray(pr))
+            t0 = time.perf_counter()
+            with self._rec.dispatch("dynamics_chunk", emit=False):
+                u, v, pr = self._chunk_fn(
+                    self.data, (u, v),
+                    jnp.asarray(deltas[done:done + k], self.dtype))
+                # the probe fetch forces the transfer, so the chunk wall
+                # time below covers execution, not just dispatch
+                probes.append(np.asarray(pr))
+            self._rec.event(
+                "dynamics_chunk", steps=int(k),
+                wall_s=round(time.perf_counter() - t0, 6))
             done += k
             if export_every > 0:
                 frames.append(self._global_u(u))
                 frame_times.append(done * self.dt)
         self.u, self.v = u, v
+        # End-of-run snapshot, like the quasi-static driver's solve():
+        # without it the gauges/dispatch attribution of a JSONL-sinking
+        # run would be silently discarded.
+        self._rec.emit_run_summary()
         probe_u = (np.concatenate(probes, axis=0).T[: len(self._probe)]
                    if probes and len(self._probe) else np.zeros((0, n_steps)))
         return DynamicsResult(

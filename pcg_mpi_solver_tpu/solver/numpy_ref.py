@@ -32,6 +32,9 @@ class NumpyRefResult:
     relres: float
     iters: int
     wall_s: float
+    # per-iteration residual norms (oldest -> newest) — the host-side
+    # oracle for the TPU path's in-graph convergence trace (obs/trace.py)
+    normr_hist: Optional[np.ndarray] = None
 
 
 class NumpyRefSolver:
@@ -99,7 +102,8 @@ class NumpyRefSolver:
 
         n2b = np.linalg.norm(fext)
         if n2b == 0:
-            return NumpyRefResult(udi, 0, 0.0, 0, time.perf_counter() - t0)
+            return NumpyRefResult(udi, 0, 0.0, 0, time.perf_counter() - t0,
+                                  normr_hist=np.zeros(0))
         tolb = tol * n2b
 
         x = np.zeros(len(self.eff)) if x0 is None else x0[self.eff].copy()
@@ -115,6 +119,7 @@ class NumpyRefSolver:
         flag, rho, iters = 1, 1.0, 0
         if normr <= tolb:
             flag, iters = 0, 0
+        hist = []
         for i in range(max_iter):
             if flag != 1:
                 break
@@ -141,10 +146,14 @@ class NumpyRefSolver:
                 normr = np.linalg.norm(r)
                 if normr <= tolb:
                     flag = 0
-                    break
+            hist.append(normr)
+            if flag == 0:
+                break
         u = udi.copy()
         u[self.eff] += x
-        return NumpyRefResult(u, flag, normr / n2b, iters, time.perf_counter() - t0)
+        return NumpyRefResult(u, flag, normr / n2b, iters,
+                              time.perf_counter() - t0,
+                              normr_hist=np.asarray(hist))
 
     def time_per_iter(self, n_iters: int = 30, delta: float = 1.0) -> float:
         """Measured seconds per PCG iteration (matvec + vector ops)."""

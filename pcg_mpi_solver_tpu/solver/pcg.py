@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pcg_mpi_solver_tpu.obs.trace import trace_record, trace_specs
 from pcg_mpi_solver_tpu.ops.matvec import Ops
 
 
@@ -36,13 +37,16 @@ class PCGResult(NamedTuple):
     iters: jnp.ndarray    # () int32  (1-based, MATLAB-compatible)
 
 
-def cold_carry(x0, r0, normr0, dot_dtype) -> dict:
+def cold_carry(x0, r0, normr0, dot_dtype, trace=None) -> dict:
     """Cold-start Krylov carry for resumable ``pcg`` calls: with p=0, rho=1
     the resumed beta/p recurrence reduces to the standard first iteration
-    p = z.  The single schema shared by every chunked-dispatch call site."""
+    p = z.  The single schema shared by every chunked-dispatch call site.
+    ``trace`` (obs/trace.py ring dict) rides the carry when convergence
+    tracing is on — it resumes across dispatch boundaries like the rest of
+    the Krylov state."""
     dd = dot_dtype
     zero_i = jnp.asarray(0, jnp.int32)
-    return dict(
+    out = dict(
         x=x0, r=r0, p=jnp.zeros_like(x0),
         rho=jnp.asarray(1.0, dd),
         stag=zero_i, moresteps=zero_i,
@@ -50,16 +54,23 @@ def cold_carry(x0, r0, normr0, dot_dtype) -> dict:
         since_best=zero_i, best_at_reset=jnp.asarray(normr0, dd),
         win_start=jnp.asarray(normr0, dd), win_count=zero_i,
         normr_act=jnp.asarray(normr0, dd), exec=zero_i)
+    if trace is not None:
+        out["trace"] = trace
+    return out
 
 
-def carry_part_specs(part_spec, rep_spec) -> dict:
+def carry_part_specs(part_spec, rep_spec, trace: bool = False) -> dict:
     """shard_map PartitionSpecs for the carry dict (vectors on the parts
-    axis, bookkeeping scalars replicated)."""
+    axis, bookkeeping scalars replicated; the optional trace ring is
+    replicated scalar streams)."""
     P, R = part_spec, rep_spec
-    return dict(x=P, r=P, p=P, rho=R, stag=R, moresteps=R,
-                normrmin=R, xmin=P, imin=R, since_best=R, best_at_reset=R,
-                win_start=R, win_count=R,
-                normr_act=R, exec=R)
+    out = dict(x=P, r=P, p=P, rho=R, stag=R, moresteps=R,
+               normrmin=R, xmin=P, imin=R, since_best=R, best_at_reset=R,
+               win_start=R, win_count=R,
+               normr_act=R, exec=R)
+    if trace:
+        out["trace"] = trace_specs(R)
+    return out
 
 
 def refine_tol(tolb, normr, inner_tol):
@@ -111,8 +122,23 @@ def pcg(
     progress_window: int = 0,
     progress_ratio: float = 0.7,
     progress_min_gain: float = 30.0,
+    trace_in: Optional[dict] = None,
+    trace_scale=None,
 ):
-    """Returns PCGResult, or (PCGResult, carry) with ``return_carry``.
+    """Returns PCGResult, or (PCGResult, carry) with ``return_carry``, or
+    (PCGResult, trace) when tracing is on without ``return_carry``.
+
+    ``trace_in`` (an ``obs/trace.py`` ring dict) enables in-graph
+    convergence tracing: each committed iteration appends
+    (normr, rho, stag, flag) to the device-resident ring inside the
+    while_loop — four dynamic-index scalar stores, no extra collectives,
+    no host transfers.  With ``return_carry`` the (updated) ring rides the
+    returned carry under ``"trace"`` and a subsequent call resumes it via
+    ``carry_in`` (so a chunked solve still surfaces ONE ring at the end);
+    otherwise the updated ring is returned as a second output.
+    ``trace_scale`` rescales recorded residual norms (mixed-precision
+    inner cycles iterate on r/||r||; passing ||r|| restores absolute
+    residuals in the trace).
 
     ``progress_window`` > 0 adds a progress-RATE exit for mixed-mode inner
     cycles (flag 3, min-residual iterate — the refinement driver restarts
@@ -153,6 +179,12 @@ def pcg(
     it overrides ``x0`` and the initial-residual matvec.
     """
     warm = carry_in is not None
+    if warm and "trace" in carry_in:
+        # resumable dispatch: the ring continues from the previous call
+        trace0 = carry_in["trace"]
+    else:
+        trace0 = trace_in
+    traced = trace0 is not None
     eff = data["eff"]
     w = data["weight"] * eff
     dt = fext.dtype
@@ -213,6 +245,8 @@ def pcg(
         # exit, so it never rides the exported resume carry
         mode=jnp.asarray(0, jnp.int32),
     )
+    if traced:
+        carry0["trace"] = trace0
 
     def cond(c):
         return (c["flag"] == 1) & (c["i"] < max_iter)
@@ -276,7 +310,7 @@ def pcg(
                 jnp.where(toosmall | stagnated | plateaued | no_progress, 3,
                           1)).astype(jnp.int32)
         stop = flag != 1
-        return dict(
+        out = dict(
             x=x, r=r, p=p, rho=rho,
             i=jnp.where(stop, i, i + 1).astype(jnp.int32),
             flag=flag, stag=stag, moresteps=moresteps,
@@ -286,6 +320,14 @@ def pcg(
             win_start=win_start, win_count=win_count,
             mode=jnp.asarray(0, jnp.int32),
         )
+        if traced:
+            # each committed iteration reaches _resolve exactly once
+            # (immediately, or via the deferred mode-1 check with the TRUE
+            # residual norm) — one ring slot per iteration
+            out["trace"] = trace_record(
+                c["trace"], normr=normr_act, rho=rho, stag=stag, flag=flag,
+                scale=trace_scale)
+        return out
 
     def body(c):
         """One trip = one CG iteration (mode 0), or the deferred
@@ -346,6 +388,12 @@ def pcg(
                 out["flag"] = new_flag
                 out["iter_out"] = i
                 out["rho"] = rho
+                if traced:
+                    # breakdown exits skip the epilogue; record the flag-2/4
+                    # slot here so the trace shows WHY the solve died
+                    out["trace"] = trace_record(
+                        c["trace"], normr=c["normr_act"], rho=rho,
+                        stag=c["stag"], flag=new_flag, scale=trace_scale)
                 return out
 
             def on_continue(c):
@@ -445,7 +493,11 @@ def pcg(
         # would undercount).
         carry["exec"] = jnp.where(zero_rhs | initial_ok, 0,
                                   c["iter_out"] + 1).astype(jnp.int32)
+        if traced:
+            carry["trace"] = c["trace"]
         return result, carry
+    if traced:
+        return result, c["trace"]
     return result
 
 
@@ -468,8 +520,15 @@ def pcg_mixed(
     progress_window: int = 0,
     progress_ratio: float = 0.7,
     progress_min_gain: float = 30.0,
+    trace_in: Optional[dict] = None,
 ) -> PCGResult:
     """Mixed-precision PCG by iterative refinement (TPU performance path).
+
+    ``trace_in`` (f32 ring dict, obs/trace.py) threads in-graph convergence
+    tracing through the f32 inner cycles: recorded norms are rescaled by
+    the cycle's f64 refresh norm, so the trace reads as ABSOLUTE residuals
+    across the whole refinement sequence.  Returns (PCGResult, trace) when
+    given.
 
     Finite-precision CG can only reach a relative residual of roughly
     eps*kappa; in f32 that is far above the reference's tol=1e-7 (SURVEY.md §7
@@ -508,6 +567,9 @@ def pcg_mixed(
         # post-cycle one (matches the refresh-at-bottom formulation)
         fatal2=jnp.asarray(False),
     )
+    traced = trace_in is not None
+    if traced:
+        carry0["trace"] = trace_in
 
     def cond(c):
         return c["flag"] == -1
@@ -547,6 +609,10 @@ def pcg_mixed(
                 progress_window=progress_window,
                 progress_ratio=progress_ratio,
                 progress_min_gain=progress_min_gain,
+                trace_in=c["trace"] if traced else None,
+                # inner iterations run on r/normr: rescale recorded norms
+                # to absolute residuals
+                trace_scale=normr if traced else None,
             )
             # return_carry skips the min-residual finalize, so inner.x is
             # the LAST iterate.  CG's residual is non-monotone: on a
@@ -566,31 +632,40 @@ def pcg_mixed(
             use_min = (inner.flag != 0) & (
                 icarry["normrmin"] < icarry["normr_act"])
             xbest = jnp.where(use_min, icarry["xmin"], inner.x)
-            return (xbest.astype(fext.dtype) * normr,
-                    jnp.maximum(icarry["exec"], 1), inner.flag)
+            out = (xbest.astype(fext.dtype) * normr,
+                   jnp.maximum(icarry["exec"], 1), inner.flag)
+            return out + ((icarry["trace"],) if traced else ())
 
         def skip_inner(args):
             r, _ = args
-            return (jnp.zeros_like(fext), jnp.asarray(0, jnp.int32),
-                    jnp.asarray(1, jnp.int32))
+            out = (jnp.zeros_like(fext), jnp.asarray(0, jnp.int32),
+                   jnp.asarray(1, jnp.int32))
+            return out + ((c["trace"],) if traced else ())
 
-        xinc, exec_n, inner_flag = jax.lax.cond(
+        inner_out = jax.lax.cond(
             run_inner, do_inner, skip_inner, (r, normr))
+        xinc, exec_n, inner_flag = inner_out[:3]
 
         flag = jnp.where(
             converged, 0,
             jnp.where(stalled, 3,
              jnp.where(c["fatal2"], 2,
               jnp.where(exhausted, 1, -1)))).astype(jnp.int32)
-        return dict(x=c["x"] + xinc, normr=normr,
-                    outer=c["outer"] + run_inner.astype(jnp.int32),
-                    total=c["total"] + exec_n, flag=flag,
-                    fatal2=inner_flag == 2)
+        out = dict(x=c["x"] + xinc, normr=normr,
+                   outer=c["outer"] + run_inner.astype(jnp.int32),
+                   total=c["total"] + exec_n, flag=flag,
+                   fatal2=inner_flag == 2)
+        if traced:
+            out["trace"] = inner_out[3]
+        return out
 
     c = jax.lax.while_loop(cond, body, carry0)
     zero_rhs = n2b == 0
     relres = jnp.where(zero_rhs, 0.0, c["normr"] / n2b)
     x = jnp.where(zero_rhs, jnp.zeros_like(c["x"]), c["x"])
     # flag 1 if budget exhausted without convergence
-    return PCGResult(x=x, flag=c["flag"], relres=relres.astype(jnp.float32),
-                     iters=c["total"])
+    result = PCGResult(x=x, flag=c["flag"], relres=relres.astype(jnp.float32),
+                       iters=c["total"])
+    if traced:
+        return result, c["trace"]
+    return result

@@ -1,0 +1,39 @@
+"""JAX version compatibility shims.
+
+The framework targets the modern ``jax.shard_map(f, mesh=..., in_specs=...,
+out_specs=..., check_vma=...)`` entry point.  Older jax releases (< 0.5)
+ship the same machinery as ``jax.experimental.shard_map.shard_map`` with
+the replication check spelled ``check_rep``.  ``ensure_shard_map()``
+installs a signature-adapting alias at ``jax.shard_map`` so every call
+site (and downstream user code written against the new spelling) runs
+unchanged on both.
+
+Called once from the package ``__init__`` — importing any part of the
+framework guarantees the alias exists.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def ensure_shard_map() -> None:
+    import jax
+
+    try:
+        if getattr(jax, "shard_map", None) is not None:
+            return                          # modern jax: nothing to do
+    except Exception:                       # noqa: BLE001 — deprecation
+        pass                                # __getattr__ may raise; shim it
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    @functools.wraps(_legacy)
+    def shard_map(f=None, /, **kwargs):
+        # new-API spelling of the replication check -> legacy keyword
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:                       # decorator-style partial use
+            return functools.partial(shard_map, **kwargs)
+        return _legacy(f, **kwargs)
+
+    jax.shard_map = shard_map

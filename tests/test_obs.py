@@ -1,0 +1,316 @@
+"""Telemetry subsystem (obs/): in-graph convergence-trace ring buffer
+(clamping, wrap-around, parity against the numpy reference), the metrics
+recorder / JSONL event round-trip, and the no-extra-transfer contract."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.config import RunConfig, SolverConfig, TimeHistoryConfig
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+from pcg_mpi_solver_tpu.obs.metrics import JsonlSink, MetricsRecorder, StderrSink
+from pcg_mpi_solver_tpu.obs.schema import (
+    TELEMETRY_SCHEMA, validate_event, validate_jsonl_text)
+from pcg_mpi_solver_tpu.obs.trace import (
+    clamp_trace_len, trace_init, trace_record, unpack_trace)
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+from pcg_mpi_solver_tpu.solver.driver import Solver
+from pcg_mpi_solver_tpu.solver.numpy_ref import NumpyRefSolver
+
+
+# ---------------------------------------------------------------- ring buffer
+def test_clamp_trace_len():
+    assert clamp_trace_len(100, 50) == 50      # clamped to max_iter
+    assert clamp_trace_len(10, 50) == 10
+    assert clamp_trace_len(0, 50) == 1         # floor (callers gate on > 0)
+    assert clamp_trace_len(5, 0) == 1
+
+
+def _record_n(tr, n):
+    for i in range(1, n + 1):
+        tr = trace_record(
+            tr, normr=jnp.asarray(float(i)), rho=jnp.asarray(10.0 * i),
+            stag=jnp.asarray(0, jnp.int32), flag=jnp.asarray(1, jnp.int32))
+    return tr
+
+
+def test_trace_no_wrap():
+    tr = _record_n(trace_init(8), 5)
+    out = unpack_trace(tr)
+    assert out.n_recorded == 5 and not out.truncated
+    np.testing.assert_allclose(out.normr, [1, 2, 3, 4, 5])
+    np.testing.assert_allclose(out.rho, [10, 20, 30, 40, 50])
+
+
+def test_trace_wrap_around_keeps_last_entries_in_order():
+    tr = _record_n(trace_init(4), 7)
+    out = unpack_trace(tr)
+    assert out.n_recorded == 7 and out.truncated
+    # ring holds the LAST 4 records, oldest -> newest
+    np.testing.assert_allclose(out.normr, [4, 5, 6, 7])
+    np.testing.assert_allclose(out.rho, [40, 50, 60, 70])
+
+
+def test_trace_scale_restores_absolute_residuals():
+    tr = trace_init(2)
+    tr = trace_record(tr, normr=jnp.asarray(0.5), rho=jnp.asarray(1.0),
+                      stag=jnp.asarray(0, jnp.int32),
+                      flag=jnp.asarray(1, jnp.int32),
+                      scale=jnp.asarray(8.0))
+    out = unpack_trace(tr)
+    np.testing.assert_allclose(out.normr, [4.0])
+
+
+# ------------------------------------------------------------- normr parity
+def test_traced_normr_matches_numpy_reference():
+    """The in-graph trace must reproduce the host reference's per-iteration
+    residual norms — same length, same values (f64 direct mode; both sides
+    record the TRUE residual at tol-confirmation iterations)."""
+    model = make_cube_model(4, 3, 3, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-8, max_iter=2000, trace_resid=2000),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1)
+    r = s.step(1.0)
+    assert r.flag == 0
+    tr = s.last_trace
+    assert tr is not None and not tr.truncated
+    assert tr.n_recorded == r.iters
+    ref = NumpyRefSolver(model).solve(1.0, tol=1e-8, max_iter=2000)
+    assert ref.flag == 0
+    assert len(ref.normr_hist) == tr.n_recorded
+    # Early iterations: the two f64 implementations are numerically
+    # indistinguishable.  Late iterations: the residual RECURRENCES drift
+    # apart in low-order bits that compound (different summation orders),
+    # so the whole-trace contract is log-space agreement — each recorded
+    # norm within a fraction of a decade of the reference's — plus an
+    # identical endpoint (both solves land at the same true residual).
+    np.testing.assert_allclose(tr.normr[:10], ref.normr_hist[:10],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.log10(tr.normr),
+                               np.log10(ref.normr_hist), atol=0.5)
+    np.testing.assert_allclose(tr.normr[-1], ref.normr_hist[-1], rtol=0.05)
+    # the final recorded flag is the termination flag
+    assert tr.flag[-1] == 0 and np.all(tr.flag[:-1] == 1)
+
+
+def test_traced_chunked_identical_to_one_shot():
+    """Dispatch chunking must not change the recorded trace (the ring rides
+    the resumable carry across dispatch boundaries)."""
+    model = make_cube_model(4, 3, 3, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+
+    def run(iters_per_dispatch):
+        cfg = RunConfig(
+            solver=SolverConfig(tol=1e-8, max_iter=2000, trace_resid=2000,
+                                iters_per_dispatch=iters_per_dispatch),
+            time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+        )
+        s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1)
+        s.step(1.0)
+        return s.last_trace
+
+    one_shot, chunked = run(0), run(20)
+    assert chunked.n_recorded == one_shot.n_recorded
+    np.testing.assert_allclose(chunked.normr, one_shot.normr, rtol=1e-12)
+    np.testing.assert_array_equal(chunked.flag, one_shot.flag)
+
+
+def test_traced_mixed_mode_absolute_residuals():
+    """Mixed-precision tracing: recorded norms are rescaled to absolute
+    residuals, so the trace decays to ~tol*||b|| like the direct trace."""
+    model = make_cube_model(4, 3, 3, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-8, max_iter=4000, trace_resid=4000,
+                            dtype="float32", dot_dtype="float64",
+                            precision_mode="mixed"),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1)
+    r = s.step(1.0)
+    assert r.flag == 0
+    tr = s.last_trace
+    assert tr.n_recorded == r.iters
+    # absolute scale: starts near ||b|| magnitude, ends near tol*||b||
+    ref = NumpyRefSolver(model).solve(1.0, tol=1e-8, max_iter=4000)
+    n2b = np.linalg.norm(ref.normr_hist[0])
+    assert tr.normr[0] > 1e3 * tr.normr[-1]
+    assert tr.normr[-1] < 1e-6 * n2b
+
+
+def test_trace_ring_shorter_than_solve_truncates():
+    model = make_cube_model(4, 3, 3, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-8, max_iter=2000, trace_resid=10),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1)
+    r = s.step(1.0)
+    tr = s.last_trace
+    assert tr.truncated and tr.n_recorded == r.iters
+    assert len(tr.normr) == 10
+    # the retained window is the LAST 10 iterations -> monotone-ish decay
+    # into convergence, ending with the termination flag
+    assert tr.flag[-1] == 0
+
+
+# ------------------------------------------------------- recorder + JSONL
+def test_recorder_jsonl_round_trip(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    rec = MetricsRecorder(sinks=[JsonlSink(path)])
+    rec.event("step", step=1, flag=0, relres=1e-9, iters=42, wall_s=0.5)
+    rec.note("hello")
+    rec.inc("foo", 2)
+    rec.gauge("bar", "baz")
+    with rec.span("phase1", emit=True):
+        pass
+    rec.emit_run_summary()
+    rec.close()
+
+    text = open(path).read()
+    assert validate_jsonl_text(text) == []
+    events = [json.loads(ln) for ln in text.splitlines()]
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["step", "note", "bench_phase", "run_summary"]
+    assert all(e["schema"] == TELEMETRY_SCHEMA for e in events)
+    step = events[0]
+    assert step["iters"] == 42 and step["relres"] == 1e-9
+    summary = events[-1]
+    assert summary["counters"]["foo"] == 2
+    assert summary["gauges"]["bar"] == "baz"
+    assert summary["spans"]["phase1"]["calls"] == 1
+
+
+def test_recorder_jsonl_appends_and_survives_kill(tmp_path):
+    """Per-event flush: a half-finished run still leaves parseable lines."""
+    path = str(tmp_path / "t.jsonl")
+    rec = MetricsRecorder(sinks=[JsonlSink(path)])
+    rec.note("one")
+    # file is readable BEFORE close (flush-per-event)
+    assert validate_jsonl_text(open(path).read()) == []
+    rec.close()
+
+
+def test_validate_event_rejects_missing_fields():
+    assert validate_event({"schema": TELEMETRY_SCHEMA, "t": 0.0,
+                           "kind": "step", "step": 1}) != []
+    assert validate_event({"t": 0.0, "kind": "note", "msg": "x"}) != []
+    ok = {"schema": TELEMETRY_SCHEMA, "t": 0.0, "kind": "note", "msg": "x"}
+    assert validate_event(ok) == []
+    # unknown kinds are forward-compatible (allowed)
+    unk = {"schema": TELEMETRY_SCHEMA, "t": 0.0, "kind": "future_thing"}
+    assert validate_event(unk) == []
+
+
+def test_stderr_sink_verbose_alias(capsys, monkeypatch):
+    """PCG_TPU_VERBOSE=1 is the alias that turns on the stderr
+    breadcrumbs of the default recorder — checked PER EVENT like the
+    historical _vlog, so it can be flipped on a live process."""
+    monkeypatch.setenv("PCG_TPU_VERBOSE", "1")
+    rec = MetricsRecorder.default()
+    assert any(isinstance(snk, StderrSink) for snk in rec.sinks)
+    rec.note("breadcrumb")
+    err = capsys.readouterr().err
+    assert "breadcrumb" in err and "[pcg-tpu " in err
+    # flipping the env var OFF silences the SAME recorder mid-flight...
+    monkeypatch.setenv("PCG_TPU_VERBOSE", "0")
+    rec.note("muted")
+    assert "muted" not in capsys.readouterr().err
+    # ...and back ON re-enables it (the hung-dispatch forensics workflow)
+    monkeypatch.setenv("PCG_TPU_VERBOSE", "1")
+    rec.note("resumed")
+    assert "resumed" in capsys.readouterr().err
+
+
+def test_solver_step_events_and_dispatch_attribution(tmp_path):
+    """Solver wiring end to end: a solve with telemetry_path set writes
+    step + resid_trace + run_summary events, and dispatch stats split the
+    compile-paying first call from warm calls."""
+    path = str(tmp_path / "run.jsonl")
+    model = make_cube_model(3, 3, 3)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-8, max_iter=2000, trace_resid=100),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 0.5, 1.0]),
+        telemetry_path=path,
+    )
+    s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1)
+    res = s.solve()
+    s.recorder.close()
+    assert all(r.flag == 0 for r in res)
+    text = open(path).read()
+    assert validate_jsonl_text(text) == []
+    events = [json.loads(ln) for ln in text.splitlines()]
+    steps = [e for e in events if e["kind"] == "step"]
+    traces = [e for e in events if e["kind"] == "resid_trace"]
+    assert [e["step"] for e in steps] == [1, 2]
+    assert len(traces) == 2
+    assert traces[0]["n_recorded"] == steps[0]["iters"]
+    assert len(traces[0]["normr"]) == min(steps[0]["iters"], 100)
+    assert events[-1]["kind"] == "run_summary"
+    ds = s.recorder.dispatch_stats()
+    assert ds["step"]["calls"] == 2
+    # first call paid the XLA compile: cold >> warm on this tiny model
+    assert ds["step"]["cold_s"] > ds["step"]["warm_s"]
+    gauges = events[-1]["gauges"]
+    assert gauges["n_dof"] == model.n_dof
+    assert "comm.psums_per_iter" in gauges
+
+
+def test_cli_telemetry_end_to_end(tmp_path, capsys):
+    """The acceptance surface: the CLI demo with --telemetry-out and
+    --trace-resid writes schema-valid JSONL with per-step metrics and a
+    residual trace matching the host reference within tolerance."""
+    from pcg_mpi_solver_tpu.cli import main
+
+    out = str(tmp_path / "out.jsonl")
+    main(["demo", "--nx", "4", "--scratch", str(tmp_path / "s"),
+          "--tol", "1e-8", "--precision", "direct",
+          "--telemetry-out", out, "--trace-resid", "2000", "--summary"])
+    stdout = capsys.readouterr().out
+    assert ">success!" in stdout
+    assert "dispatch" in stdout          # the --summary table
+    text = open(out).read()
+    assert validate_jsonl_text(text) == []
+    events = [json.loads(ln) for ln in text.splitlines()]
+    steps = [e for e in events if e["kind"] == "step"]
+    traces = [e for e in events if e["kind"] == "resid_trace"]
+    assert steps and traces and steps[0]["flag"] == 0
+    # the demo model is make_cube_model(nx=4, heterogeneous=True): check
+    # the traced residuals against the host-side reference on that model
+    from pcg_mpi_solver_tpu.models.synthetic import make_cube_model as mk
+
+    model = mk(4, 0, 0, E=30e9, nu=0.2, load="traction", load_value=1e6,
+               heterogeneous=True)
+    ref = NumpyRefSolver(model).solve(1.0, tol=1e-8, max_iter=10000)
+    tn = np.asarray(traces[0]["normr"])
+    assert len(tn) == len(ref.normr_hist)
+    np.testing.assert_allclose(np.log10(tn), np.log10(ref.normr_hist),
+                               atol=0.5)
+
+
+def test_tracing_off_no_trace_in_carry():
+    """With trace_resid=0 nothing is threaded: no trace output, and the
+    carry schema (hence the compiled program) is unchanged."""
+    from pcg_mpi_solver_tpu.solver.pcg import carry_part_specs, cold_carry
+
+    model = make_cube_model(3, 3, 3)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-8, max_iter=2000),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1)
+    s.step(1.0)
+    assert s.last_trace is None and s.trace_len == 0
+    import jax
+
+    P, R = (jax.sharding.PartitionSpec("parts"),
+            jax.sharding.PartitionSpec())
+    assert "trace" not in carry_part_specs(P, R)
+    assert "trace" not in cold_carry(jnp.zeros(4), jnp.zeros(4),
+                                     jnp.asarray(1.0), jnp.float64)

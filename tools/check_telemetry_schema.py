@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Telemetry/bench artifact schema lint.
+
+Validates JSON artifacts against the versioned contracts in
+``pcg_mpi_solver_tpu/obs/schema.py``:
+
+* ``*.jsonl``          — telemetry event streams (``--telemetry-out``)
+* ``BENCH_*.json``     — bench round artifacts (raw line or round wrapper;
+                         failed-round wrappers with ``parsed: null`` pass)
+* ``bench_*.json``     — provisional/salvage side files written by bench.py
+
+Usage::
+
+    python tools/check_telemetry_schema.py [PATH ...]
+
+With no PATH arguments, scans the repository root for committed
+``BENCH_*.json`` artifacts (the tier-1 fast check,
+tests/test_telemetry_schema.py).  Exits non-zero if any file fails;
+prints one line per error.  Import-light on purpose (no jax/numpy): this
+runs as a fast lint.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pcg_mpi_solver_tpu.obs.schema import (          # noqa: E402
+    validate_bench_text, validate_jsonl_text)
+
+
+def default_paths() -> list:
+    """The committed artifacts the tier-1 check covers."""
+    return sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+
+
+def check_file(path: str) -> list:
+    """Validate one artifact; returns error strings prefixed with path."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    name = os.path.basename(path)
+    if name.endswith(".jsonl"):
+        errs = validate_jsonl_text(text)
+    elif name.endswith(".json"):
+        if name.startswith("bench_salvage"):
+            # salvage wrapper: {"lines": [{"line": <bench json str>}]}
+            errs = []
+            try:
+                doc = json.loads(text)
+            except ValueError as e:
+                errs = [f"not JSON ({e})"]
+            else:
+                for i, entry in enumerate(doc.get("lines", [])):
+                    errs.extend(
+                        f"lines[{i}]: {e}"
+                        for e in validate_bench_text(entry.get("line", "")))
+        else:
+            errs = validate_bench_text(text)
+    else:
+        errs = [f"unrecognized artifact type (expected .json/.jsonl)"]
+    return [f"{path}: {e}" for e in errs]
+
+
+def main(argv=None) -> int:
+    paths = list(argv if argv is not None else sys.argv[1:]) or \
+        default_paths()
+    if not paths:
+        print("check_telemetry_schema: no artifacts to check")
+        return 0
+    errors = []
+    for p in paths:
+        errors.extend(check_file(p))
+    for e in errors:
+        print(e, file=sys.stderr)
+    n = len(paths)
+    if errors:
+        print(f"check_telemetry_schema: {len(errors)} error(s) in {n} "
+              f"file(s)", file=sys.stderr)
+        return 1
+    print(f"check_telemetry_schema: {n} file(s) OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
