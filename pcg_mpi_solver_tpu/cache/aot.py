@@ -1,0 +1,159 @@
+"""Ahead-of-time compile path: persistent XLA cache + jax.export'd steps.
+
+Two layers, both keyed/invalidated by ``cache/keys.py``:
+
+* ``enable_persistent_compilation_cache`` points jax's persistent
+  compilation cache at ``<cache_dir>/xla`` on accelerator backends (CPU
+  executables don't round-trip through it on jax 0.4.x — see the
+  function docstring) — a re-compile of an identical program becomes a
+  cache read.  This alone cuts the 400+ s flagship octree compiles
+  (docs/BENCH_LOG.md) to a load on re-runs.
+* ``export_step``/``store_step``/``load_step`` serialize the jitted PCG
+  step via ``jax.export`` keyed by its ABSTRACT signature (shapes /
+  dtypes / shardings): a warm session deserializes StableHLO instead of
+  re-tracing the solver's Python, so a same-shape-class re-run skips
+  tracing entirely and its (deserialized-module) compile hits the
+  persistent XLA cache.  Critical when a hardware window is 9 minutes.
+
+Import contract: jax is imported lazily inside functions — this module
+may be imported before the accelerator environment is configured.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+
+def enable_persistent_compilation_cache(cache_dir: str) -> str:
+    """Wire jax's persistent compilation cache to ``<cache_dir>/xla``.
+    Safe to call repeatedly; returns the XLA cache dir.
+
+    ACCELERATOR BACKENDS ONLY: on jax 0.4.x CPU, executables written to
+    the persistent cache do not deserialize reliably — a later
+    same-signature compile loads the entry and crashes the process
+    (segfault, flaky) at dispatch.  Empirically reproduced on the
+    8-device virtual CPU mesh; the cache module is also sticky (a later
+    ``jax_compilation_cache_dir`` config change does not re-point an
+    initialized cache), so one enable poisons every later solve in the
+    process.  CPU compiles are seconds, not the 400+ s flagship pain
+    this exists for — the partition + AOT layers alone already give CPU
+    the warm path."""
+    import jax
+
+    d = os.path.join(cache_dir, "xla")
+    os.makedirs(d, exist_ok=True)
+    if jax.default_backend() != "cpu":
+        jax.config.update("jax_compilation_cache_dir", d)
+    return d
+
+
+def _entry_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, "aot", f"{key}.jaxexport")
+
+
+def abstract_like(tree):
+    """Concrete (committed) array pytree -> ShapeDtypeStruct pytree with
+    the SAME shardings, for sharding-faithful .lower()/export calls."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=x.sharding), tree)
+
+
+def signature_repr(abstract_args) -> str:
+    """Stable repr of an abstract signature (shapes/dtypes/shardings) for
+    key derivation."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(abstract_args)
+    parts = [f"{tuple(l.shape)}:{l.dtype}:"
+             f"{getattr(l, 'sharding', None)}" for l in leaves]
+    return f"{treedef}|" + ";".join(parts)
+
+
+def export_step(jit_fn, abstract_args):
+    """Trace + lower ``jit_fn`` at the abstract signature and return the
+    serializable ``jax.export.Exported``.  The one trace this costs on a
+    COLD run is what every warm run skips."""
+    from jax import export as jexport
+
+    return jexport.export(jit_fn)(*abstract_args)
+
+
+def load_step(cache_dir: str, key: str):
+    """Deserialize the exported step for ``key``; None on miss.  Corrupt
+    or version-incompatible blobs (jax.export enforces its own calling-
+    convention versioning) are removed and treated as a miss."""
+    path = _entry_path(cache_dir, key)
+    if not os.path.exists(path):
+        return None
+    from jax import export as jexport
+
+    try:
+        with open(path, "rb") as f:
+            exported = jexport.deserialize(bytearray(f.read()))
+    except Exception:                                   # noqa: BLE001
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+    try:
+        os.utime(path)                                  # LRU touch
+    except OSError:
+        pass
+    return exported
+
+
+def store_step(cache_dir: str, key: str, exported) -> bool:
+    """Atomically publish a serialized exported step; best-effort.  The
+    half-written tmp of a failed write is removed, and the aot dir is
+    LRU-evicted to the same PCG_TPU_CACHE_GB cap as the partition
+    entries (code/version re-keys orphan old generations here too)."""
+    from pcg_mpi_solver_tpu.cache.partition_cache import evict_lru
+    from pcg_mpi_solver_tpu.utils.io import write_atomic
+
+    path = _entry_path(cache_dir, key)
+    try:
+        blob = bytes(exported.serialize())
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        write_atomic(path, blob)
+    except Exception:                                   # noqa: BLE001
+        return False
+    evict_lru(os.path.dirname(path), keep=path,
+              suffix=".jaxexport")
+    return True
+
+
+def cached_step(cache_dir: str, key: str, jit_fn, abstract_args,
+                recorder=None) -> Optional[object]:
+    """Load-or-export the step program; returns the ``Exported`` (from
+    disk on a hit — zero tracing — or freshly exported on a miss), or
+    None when export is unsupported for this program/jax version (the
+    caller keeps its plain jit).  Cold/warm attribution mirrors
+    ``cached_partition``."""
+    t0 = time.perf_counter()
+    exported = load_step(cache_dir, key)
+    if exported is not None:
+        if recorder is not None:
+            recorder.inc("cache.aot.hit")
+            recorder.event("cache", name="aot.step", hit=True, key=key,
+                           wall_s=round(time.perf_counter() - t0, 6))
+        return exported
+    try:
+        exported = export_step(jit_fn, abstract_args)
+        stored = store_step(cache_dir, key, exported)
+        err = None
+    except Exception as e:                              # noqa: BLE001
+        exported, stored = None, False
+        err = f"{type(e).__name__}: {e}"
+    if recorder is not None:
+        recorder.inc("cache.aot.miss" if err is None
+                     else "cache.aot.unsupported")
+        recorder.event("cache", name="aot.step", hit=False, key=key,
+                       stored=stored, error=err,
+                       wall_s=round(time.perf_counter() - t0, 6))
+    return exported

@@ -1,0 +1,135 @@
+"""Content-addressed cache keys for the warm-path subsystem.
+
+A cache entry is valid iff EVERYTHING that shaped its content hashes the
+same: the model bundle, the partition/solver knobs, and the code
+generation that produced it.  The last part is covered by embedding
+``CACHE_SCHEMA`` (bumped on any serialization-layout change in cache/)
+and the package version in every key — a version bump invalidates the
+whole cache rather than risking a stale entry deserialized into new code.
+
+Import contract: jax-free at module load (numpy/hashlib only).  The CLI
+and bench consult keys before the accelerator environment is configured.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from pcg_mpi_solver_tpu import __version__
+
+# Bump on ANY change to what cache entries contain or how they are
+# serialized (partition pickle layout, AOT export calling convention
+# expectations, key payload shape).  Additive key fields need no bump —
+# they change the key hash by themselves.
+CACHE_SCHEMA = 1
+
+# Monkeypatchable in tests to simulate a package-version bump without
+# editing the package.
+PACKAGE_VERSION = __version__
+
+
+def _hash_update(h, obj: Any) -> None:
+    """Deterministic recursive hash of numpy arrays / builtins /
+    dataclasses (dict keys canonicalized by repr sort)."""
+    if obj is None:
+        h.update(b"\x00none")
+    elif isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        h.update(f"nd:{a.shape}:{a.dtype}".encode())
+        h.update(a.tobytes())
+    elif isinstance(obj, (bool, int, float, str, bytes, complex,
+                          np.integer, np.floating, np.bool_)):
+        h.update(f"{type(obj).__name__}:{obj!r}".encode())
+    elif isinstance(obj, dict):
+        h.update(f"dict:{len(obj)}".encode())
+        for k in sorted(obj, key=repr):
+            h.update(repr(k).encode())
+            _hash_update(h, obj[k])
+    elif isinstance(obj, (list, tuple)):
+        h.update(f"seq:{len(obj)}".encode())
+        for v in obj:
+            _hash_update(h, v)
+    elif dataclasses.is_dataclass(obj):
+        h.update(type(obj).__name__.encode())
+        for f in dataclasses.fields(obj):
+            h.update(f.name.encode())
+            _hash_update(h, getattr(obj, f.name))
+    else:
+        h.update(repr(obj).encode())
+
+
+def model_fingerprint(model) -> str:
+    """Content hash of a full ModelData bundle (every dataclass field:
+    topology, loads, BCs, element library, materials, octree/grid
+    metadata).  ~GB/s sha256 — sub-second even at flagship scale, and the
+    ONE thing that makes the partition cache safe against silently-edited
+    models (the reference's zpkl bundles carry no integrity check)."""
+    h = hashlib.sha256()
+    _hash_update(h, model)
+    return h.hexdigest()
+
+
+def array_hash(arr) -> str:
+    """Short content hash of one array (e.g. an explicit elem_part map)."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    h = hashlib.sha256(f"{a.shape}:{a.dtype}".encode())
+    h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+def _digest(payload: Dict[str, Any]) -> str:
+    payload = dict(payload)
+    payload["cache_schema"] = CACHE_SCHEMA
+    payload["version"] = PACKAGE_VERSION
+    blob = json.dumps(payload, sort_keys=True, default=repr).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+def partition_cache_key(model_fp: str, *, n_parts: int, backend: str,
+                        dtype: str, method: str = "n/a",
+                        elem_part_hash: Optional[str] = None,
+                        pad_multiple: int = 8,
+                        extra: Optional[Dict[str, Any]] = None) -> str:
+    """Key for one serialized partition: model content + every knob that
+    shapes the partition arrays.  ``extra`` carries backend-specific knobs
+    (hybrid block size / merge, native-partitioner availability for
+    method='auto', ...)."""
+    return _digest({
+        "kind": "partition",
+        "model": model_fp,
+        "n_parts": int(n_parts),
+        "backend": backend,
+        "dtype": dtype,
+        "method": method,
+        "elem_part": elem_part_hash,
+        "pad_multiple": int(pad_multiple),
+        "extra": extra or {},
+    })
+
+
+def step_cache_key(*, abstract: Any, mesh: Any, backend: str,
+                   solver: Dict[str, Any], trace_len: int,
+                   glob_n_dof_eff: int, donate: bool,
+                   jax_version: str,
+                   extra: Optional[Dict[str, Any]] = None) -> str:
+    """Key for one AOT-exported PCG step program: the ABSTRACT signature
+    (shapes/dtypes/shardings repr), the mesh layout, and every scalar the
+    step closure bakes in as a compile-time constant (solver config,
+    effective dof count, trace ring length, donation)."""
+    return _digest({
+        "kind": "aot-step",
+        "abstract": abstract,
+        "mesh": mesh,
+        "backend": backend,
+        "solver": solver,
+        "trace_len": int(trace_len),
+        "glob_n_dof_eff": int(glob_n_dof_eff),
+        "donate": bool(donate),
+        "jax": jax_version,
+        "extra": extra or {},
+    })

@@ -8,6 +8,8 @@ orchestrated by examples/run_basic_script.bash) as one typed CLI.
     pcg-tpu export    <scratch> <run_id> <vars> <mode> # frames -> .vtu
     pcg-tpu demo      [--nx ...]                       # synthetic end-to-end
     pcg-tpu bench                                      # benchmark harness
+    pcg-tpu warmup    <scratch> [options]              # pre-bake caches
+    pcg-tpu cache-stats [--cache-dir D]                # warm-path cache table
 
 Settings come from ``--settings settings.json`` (same shape as the
 reference's GlobSettings: TimeHistoryParam/SolverParam,
@@ -62,6 +64,50 @@ def _apply_telemetry_flags(cfg, args) -> None:
     cfg.solver.trace_resid = int(getattr(args, "trace_resid", None) or 0)
     if getattr(args, "profile_spans", False):
         cfg.telemetry_profile = True
+    cfg.cache_dir = _resolve_cache_dir(args)
+
+
+def _resolve_cache_dir(args) -> str:
+    """One resolution rule for every subcommand (warmup MUST land in the
+    same dir the later solve reads, so they cannot have different
+    defaults): the --cache-dir flag, else the PCG_TPU_CACHE_DIR env var,
+    else off."""
+    return getattr(args, "cache_dir", None) or \
+        os.environ.get("PCG_TPU_CACHE_DIR", "")
+
+
+def _resolve_partition_mesh(n_parts_arg, scratch):
+    """(n_parts, elem_part, n_dev, n_dev_used): the n_parts default, the
+    scratch MeshPart_<n>.npy element->part map, and the device count
+    that divides n_parts — ONE resolution shared by solve and warmup,
+    because warmup's entire value depends on baking caches for the
+    IDENTICAL mesh/partition inputs the later solve resolves."""
+    import jax
+
+    n_dev = len(jax.devices())
+    n_parts = n_parts_arg or n_dev
+    elem_part = None
+    if scratch:
+        part_file = os.path.join(scratch, "ModelData",
+                                 f"MeshPart_{n_parts}.npy")
+        if os.path.exists(part_file):
+            elem_part = np.load(part_file)
+    # use as many devices as divide n_parts
+    n_dev_used = n_dev if n_parts % n_dev == 0 else max(
+        d for d in range(1, min(n_dev, n_parts) + 1) if n_parts % d == 0)
+    return n_parts, elem_part, n_dev, n_dev_used
+
+
+def _add_cache_flag(p) -> None:
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="warm-path cache directory (cache/): partitions "
+                        "are served from a content-addressed on-disk "
+                        "cache, the PCG step program is AOT-exported, and "
+                        "jax's persistent XLA compilation cache lives "
+                        "under DIR/xla — the second solve of the same "
+                        "model/n_parts/backend pays near-zero setup "
+                        "(pre-bake with `pcg-tpu warmup`; env default: "
+                        "PCG_TPU_CACHE_DIR)")
 
 
 def _finish_telemetry(solver, args) -> None:
@@ -117,8 +163,6 @@ def cmd_partition(args):
 
 
 def cmd_solve(args):
-    import jax
-
     from pcg_mpi_solver_tpu.models.mdf import read_mdf
     from pcg_mpi_solver_tpu.solver.driver import Solver
     from pcg_mpi_solver_tpu.utils.io import RunStore
@@ -131,17 +175,11 @@ def cmd_solve(args):
     cfg.profile_dir = args.profile_dir or ""
     model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
     cfg.time_history.dt = model.dt   # frame timestamps follow the model's dt
-    n_dev = len(jax.devices())
-    n_parts = args.n_parts or n_dev
-
-    part_file = os.path.join(args.scratch, "ModelData", f"MeshPart_{n_parts}.npy")
-    elem_part = np.load(part_file) if os.path.exists(part_file) else None
+    n_parts, elem_part, n_dev, n_dev_used = _resolve_partition_mesh(
+        args.n_parts, args.scratch)
 
     from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
 
-    # use as many devices as divide n_parts
-    n_dev_used = n_dev if n_parts % n_dev == 0 else max(
-        d for d in range(1, min(n_dev, n_parts) + 1) if n_parts % d == 0)
     print(f">solving on {n_dev_used}/{n_dev} device(s), {n_parts} parts "
           f"({cfg.solver.precision_mode} precision)..")
     s = Solver(model, cfg, mesh=make_mesh(n_dev_used), n_parts=n_parts,
@@ -223,6 +261,63 @@ def cmd_demo(args):
     print(">success!")
 
 
+def cmd_warmup(args):
+    """Pre-bake the warm-path caches for a model/config (docs/RUNBOOK.md
+    "Warm path"): partition + AOT step + persistent XLA compile entries,
+    so the solve inside a scarce hardware window pays no setup."""
+    from pcg_mpi_solver_tpu.cache.partition_cache import format_stats
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+    from pcg_mpi_solver_tpu.solver.driver import Solver
+
+    cfg = _load_settings(args.settings, args)
+    cfg.cache_dir = _resolve_cache_dir(args)
+    if not cfg.cache_dir:
+        # refusing to invent a default: a warmup baked into a dir the
+        # later solve does not read is worse than no warmup at all
+        raise SystemExit(
+            "warmup: pass --cache-dir DIR (or set PCG_TPU_CACHE_DIR) — "
+            "and run the solve with the SAME dir to use the baked caches")
+    if args.demo_nx:
+        from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+
+        model = make_cube_model(args.demo_nx, 0, 0, E=30e9, nu=0.2,
+                                load="traction", load_value=1e6,
+                                heterogeneous=True)
+    elif args.scratch:
+        from pcg_mpi_solver_tpu.models.mdf import read_mdf
+
+        cfg.scratch_path = args.scratch
+        model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    else:
+        raise SystemExit("warmup: pass a <scratch> dir or --demo-nx N")
+    # the scratch MeshPart map belongs to the scratch MODEL — when
+    # --demo-nx overrode the model above, pairing it with a synthetic
+    # cube would index past the cube's element count
+    n_parts, elem_part, n_dev, n_dev_used = _resolve_partition_mesh(
+        args.n_parts, None if args.demo_nx else args.scratch)
+    print(f">warming {model.n_dof} dofs on {n_dev_used}/{n_dev} device(s), "
+          f"{n_parts} parts ({cfg.solver.precision_mode} precision) into "
+          f"{cfg.cache_dir} ..")
+    s = Solver(model, cfg, mesh=make_mesh(n_dev_used), n_parts=n_parts,
+               elem_part=elem_part, backend=args.backend)
+    print(f">backend: {s.backend}  setup: {s.setup_s:.2f}s "
+          f"({s.setup_cache} partition)")
+    s.warmup()
+    _finish_telemetry(s, args)
+    print(format_stats(cfg.cache_dir))
+    print(">warm path ready")
+
+
+def cmd_cache_stats(args):
+    from pcg_mpi_solver_tpu.cache.partition_cache import format_stats
+
+    d = _resolve_cache_dir(args)
+    if not d:
+        raise SystemExit("cache-stats: pass --cache-dir DIR (or set "
+                         "PCG_TPU_CACHE_DIR)")
+    print(format_stats(d))
+
+
 def cmd_bench(args):
     from pcg_mpi_solver_tpu.bench import main as bench_main
 
@@ -275,6 +370,7 @@ def main(argv=None):
                         "(open with TensorBoard; shows the per-op "
                         "compute/collective split; ignored with --speed-test)")
     _add_telemetry_flags(p)
+    _add_cache_flag(p)
     p.set_defaults(fn=cmd_solve)
 
     p = sub.add_parser("export", help="export result frames to VTK")
@@ -304,7 +400,34 @@ def main(argv=None):
                    help="scalar Poisson/diffusion model (1 dof per node, "
                         "heterogeneous conductivity)")
     _add_telemetry_flags(p)
+    _add_cache_flag(p)
     p.set_defaults(fn=cmd_demo)
+
+    p = sub.add_parser("warmup", help="pre-bake the warm-path caches "
+                                      "(partition + AOT step + XLA "
+                                      "compile) before a hardware window")
+    p.add_argument("scratch", nargs="?", default=None,
+                   help="scratch dir with an ingested MDF model "
+                        "(or use --demo-nx)")
+    p.add_argument("--demo-nx", type=int, default=0,
+                   help="warm a synthetic nx^3 cube instead of a scratch "
+                        "model (smoke/testing)")
+    p.add_argument("--settings", default=None)
+    p.add_argument("--n-parts", type=int, default=None)
+    p.add_argument("--tol", type=float, default=None)
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--precision", choices=["direct", "mixed"], default=None)
+    p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    p.add_argument("--backend",
+                   choices=["auto", "structured", "hybrid", "general"],
+                   default="auto")
+    _add_telemetry_flags(p)
+    _add_cache_flag(p)
+    p.set_defaults(fn=cmd_warmup)
+
+    p = sub.add_parser("cache-stats", help="show the warm-path cache table")
+    _add_cache_flag(p)
+    p.set_defaults(fn=cmd_cache_stats)
 
     p = sub.add_parser("bench", help="benchmark harness (prints one JSON line)")
     p.set_defaults(fn=cmd_bench)

@@ -75,6 +75,14 @@ class SolverConfig:
     # `trace_resid` iterations (clamped to max_iter) and crosses to the
     # host ONCE per solve.  CLI: --trace-resid.
     trace_resid: int = 0
+    # Donated-carry dispatch: donate the resumable Krylov carry (and the
+    # previous solution vector of the one-shot step) to XLA across
+    # chunked dispatches and mixed-refinement cycles, so the multi-vector
+    # carry is updated in place instead of copied every dispatch.
+    # Numerically a no-op (bit-identical on/off — asserted in
+    # tests/test_cache.py); off is a debugging escape hatch for
+    # inspecting carries between dispatches.
+    donate_carry: bool = True
     # Fused Pallas matvec kernel for f32 structured-backend matvecs
     # (ops/pallas_matvec.py): "auto" = on TPU devices, "on", "off",
     # "interpret" = force the kernel through the Pallas interpreter on
@@ -121,6 +129,14 @@ class RunConfig:
     # steps (0 = off).  The reference is resumable only at pipeline-stage
     # granularity (SURVEY.md §5); this adds step granularity.
     checkpoint_every: int = 0
+    # Warm-path cache directory (cache/): when set, partitions are served
+    # from a content-addressed on-disk cache, the jitted PCG step is
+    # AOT-exported/deserialized (skipping re-tracing), and jax's
+    # persistent XLA compilation cache is pointed at <cache_dir>/xla —
+    # the second solve of the same model/n_parts/backend performs zero
+    # partitioning work and zero step tracing.  CLI: --cache-dir and the
+    # `warmup` subcommand (docs/RUNBOOK.md "Warm path").
+    cache_dir: str = ""
     # Telemetry (obs/): when set, every structured event (steps, dispatch
     # timings, residual traces, run summary) is appended to this JSONL
     # file, one schema-versioned object per line.  CLI: --telemetry-out.

@@ -154,6 +154,16 @@ class MetricsRecorder:
         with self._lock:
             self.sinks.append(sink)
 
+    def remove_sink(self, sink) -> None:
+        """Detach a sink added with :meth:`add_sink`; idempotent (the
+        bench's one-shot first-dispatch sink detaches best-effort on
+        every exit path)."""
+        with self._lock:
+            try:
+                self.sinks.remove(sink)
+            except ValueError:
+                pass
+
     def close(self) -> None:
         for s in self.sinks:
             close = getattr(s, "close", None)

@@ -50,11 +50,22 @@ EVENT_KINDS: Dict[str, tuple] = {
     "dynamics_chunk": ("steps", "wall_s"),
     # bench harness phase timing
     "bench_phase": ("name", "wall_s"),
+    # one warm-path cache probe (cache/: partition load-or-build, AOT
+    # step load-or-export); `hit` is the cold/warm attribution bit
+    "cache": ("name", "hit", "key", "wall_s"),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }
 
 BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
+
+# Optional ``detail`` fields with a typed contract WHEN present (absent in
+# pre-warm-path lines — committed BENCH_r0*.json stay valid).  Numeric-or-
+# null: ``time_to_first_iter_s`` is null when no device dispatch happened
+# (e.g. a solve that failed before its first jitted call).
+BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s")
+# ``setup_cache``: warm-path partition attribution (cache/ subsystem).
+BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
 
 
 def validate_event(ev: Any) -> List[str]:
@@ -93,6 +104,17 @@ def validate_bench_line(d: Any) -> List[str]:
     schema = d.get("schema")
     if schema is not None and schema not in KNOWN_BENCH_SCHEMAS:
         errs.append(f"unknown bench schema {schema!r}")
+    detail = d.get("detail")
+    if isinstance(detail, dict):
+        for field in BENCH_DETAIL_NUMERIC:
+            if field in detail and detail[field] is not None \
+                    and not isinstance(detail[field], (int, float)):
+                errs.append(f"detail.{field} is not numeric/null: "
+                            f"{detail[field]!r}")
+        sc = detail.get("setup_cache")
+        if sc is not None and sc not in BENCH_SETUP_CACHE_VALUES:
+            errs.append(f"detail.setup_cache not in "
+                        f"{BENCH_SETUP_CACHE_VALUES}: {sc!r}")
     # schema-less lines are legacy (pre-schema artifacts) — still valid.
     return errs
 

@@ -131,19 +131,29 @@ def _planes_env(fn):
     inside is always correct."""
 
     def wrapped(xg, ck, Ke, *, interpret=False):
-        import os
-
-        planes = int(os.environ.get("PCG_TPU_PALLAS_PLANES", "8"))
-        if planes % 8 != 0:
-            # a typo'd knob would otherwise fail Mosaic lowering and
-            # silently degrade pallas='auto' to the XLA path
-            raise ValueError(
-                f"PCG_TPU_PALLAS_PLANES must be a multiple of 8, "
-                f"got {planes}")
         with jax.enable_x64(False):
-            return fn(xg, ck, Ke, interpret=interpret, planes=planes)
+            return fn(xg, ck, Ke, interpret=interpret,
+                      planes=pallas_planes())
 
     return wrapped
+
+
+def pallas_planes() -> int:
+    """Resolved PCG_TPU_PALLAS_PLANES (cell planes per output block) —
+    the ONE place the default lives.  Cache keys consume this function
+    (solver/driver.py AOT step key) rather than copying the default, so
+    a default change here re-keys cached step programs instead of
+    silently serving a program built with the old block shape."""
+    import os
+
+    planes = int(os.environ.get("PCG_TPU_PALLAS_PLANES", "8"))
+    if planes % 8 != 0:
+        # a typo'd knob would otherwise fail Mosaic lowering and
+        # silently degrade pallas='auto' to the XLA path
+        raise ValueError(
+            f"PCG_TPU_PALLAS_PLANES must be a multiple of 8, "
+            f"got {planes}")
+    return planes
 
 
 def selected_variant():

@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -174,6 +174,9 @@ def hybrid_pallas_enabled(hp: "HybridPartition", pallas_mode: str,
 def partition_hybrid(model: ModelData, n_parts: int,
                      elem_part: Optional[np.ndarray] = None,
                      method: str = "rcb") -> HybridPartition:
+    from pcg_mpi_solver_tpu.parallel.partition import BUILD_CALLS
+
+    BUILD_CALLS["partition_hybrid"] += 1
     if not can_hybrid(model):
         raise ValueError("model has no octree/brick metadata for the "
                          "hybrid backend")
@@ -201,7 +204,8 @@ def partition_hybrid(model: ModelData, n_parts: int,
 
     P = n_parts
     lib = model.elem_lib[bt]
-    bs_knob = int(os.environ.get("PCG_TPU_HYBRID_BLOCK", "8"))
+    knobs = partition_env_knobs()   # one owner for the defaults
+    bs_knob = knobs["block"]
     # PCG_TPU_HYBRID_MERGE (default OFF): give EVERY level the same tile
     # dims and merge all levels into ONE block batch after the loop —
     # legal because the stencil math is size-independent (level size
@@ -213,7 +217,7 @@ def partition_hybrid(model: ModelData, n_parts: int,
     # per-level unroll), so it stays an off-by-default runtime A/B
     # candidate (1 launch vs 5 per matvec; parity-asserted in
     # tests/test_hybrid.py::test_merged_levels_match_unmerged).
-    merge = os.environ.get("PCG_TPU_HYBRID_MERGE", "0") == "1"
+    merge = knobs["merge"]
     sizes = sorted(int(v) for v in np.unique(leaves[brick, 3]))
     level_sel = []
     for s in sizes:
@@ -340,6 +344,20 @@ def partition_hybrid(model: ModelData, n_parts: int,
                   if lib.get("Se") is not None else None),
         combine=build_combine_maps(levels, pm.n_node_loc, P),
     )
+
+
+def partition_env_knobs() -> Dict[str, object]:
+    """Every env knob ``partition_hybrid`` consumes at PARTITION time,
+    resolved by the module that owns the defaults.  Cache keys
+    (solver/driver.py ``_partition_cached``) must consume THIS dict, not
+    copy the defaults: a default change here must re-key cached
+    partitions, never silently serve the old layout."""
+    return {
+        "block": int(os.environ.get("PCG_TPU_HYBRID_BLOCK", "8")),
+        "merge": os.environ.get("PCG_TPU_HYBRID_MERGE", "0") == "1",
+        "kd": combine_kd(),
+        "combine": hybrid_combine_mode(),
+    }
 
 
 def combine_kd() -> int:

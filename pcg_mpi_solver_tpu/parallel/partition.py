@@ -36,6 +36,19 @@ from pcg_mpi_solver_tpu.models.model_data import ModelData
 from pcg_mpi_solver_tpu import native
 
 
+# Host-side build-work call counters, bumped at the top of each builder
+# (here and in parallel/structured.py, parallel/hybrid.py).  The cache/
+# warm path's contract — "a warm cache hit performs ZERO partitioning
+# work" — is asserted against these in tests/test_cache.py.  Monotonic;
+# never reset by library code.
+BUILD_CALLS = {
+    "make_elem_part": 0,
+    "partition_model": 0,
+    "partition_structured": 0,
+    "partition_hybrid": 0,
+}
+
+
 # ----------------------------------------------------------------------
 # Element -> part assignment
 # ----------------------------------------------------------------------
@@ -76,6 +89,7 @@ def make_elem_part(model: ModelData, n_parts: int, method: str = "rcb",
     """Element->part map by method: 'rcb' (coordinate bisection), 'graph'
     (native dual-graph, raises if the native lib is missing), or 'auto'
     (graph when the native lib is present, else RCB)."""
+    BUILD_CALLS["make_elem_part"] += 1
     if n_parts <= 1:
         return np.zeros(model.n_elem, dtype=np.int32)
     if method == "rcb":
@@ -234,6 +248,7 @@ def partition_model(
     interface maps) but are EXCLUDED from the type blocks and scatter maps
     — the hybrid level-grid backend (parallel/hybrid.py) applies their
     stiffness through dense per-level stencils instead."""
+    BUILD_CALLS["partition_model"] += 1
     if elem_part is None:
         elem_part = make_elem_part(model, n_parts, method=method)
 

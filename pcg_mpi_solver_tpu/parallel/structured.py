@@ -69,6 +69,9 @@ class StructuredPartition:
 def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
     """Slab-partition a structured cube model (requires model.grid set and
     nx % n_parts == 0)."""
+    from pcg_mpi_solver_tpu.parallel.partition import BUILD_CALLS
+
+    BUILD_CALLS["partition_structured"] += 1
     if model.grid is None:
         raise ValueError("model has no structured-grid metadata")
     nx, ny, nz, _h = model.grid

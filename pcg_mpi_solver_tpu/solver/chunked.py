@@ -43,7 +43,7 @@ class ChunkedEngine:
     def __init__(self, *, mesh, data_specs, part_spec, rep_spec, ops,
                  scfg, glob_n_dof_eff: int, cap: int, mixed: bool,
                  ops32=None, amul_fn=None, trace_len: int = 0,
-                 recorder=None):
+                 recorder=None, donate: bool = False):
         """``amul_fn``, when given, is a host-level callable
         ``(data, v) -> eff * K.v`` backed by ONE separately-jitted
         program the caller shares across all its out-of-loop f64 matvec
@@ -61,21 +61,33 @@ class ChunkedEngine:
         all dispatches of a solve and is surfaced once, as
         ``self.last_trace``, after :meth:`run` terminates.  ``recorder``
         (obs/metrics.py MetricsRecorder) gets a ``dispatch`` span around
-        every jitted call; None disables that instrumentation."""
+        every jitted call; None disables that instrumentation.
+
+        ``donate`` enables donated-carry dispatch: each capped dispatch
+        DONATES its input Krylov carry (and the refine step its previous
+        f64 iterate) to XLA, so the multi-vector resumable state is
+        aliased in place instead of copied per dispatch.  Numerically a
+        no-op (bit-identical on/off, tests/test_cache.py); the budget
+        loop in :meth:`run` honors the contract by never touching a
+        carry object after passing it to a donating program — every read
+        (``final``/``final32``, the trace hand-off) is from the LATEST
+        dispatch's freshly-allocated outputs."""
         self.mixed = mixed
         self.scfg = scfg
         self._amul_fn = amul_fn
         self.trace_len = int(trace_len)
         self._rec = recorder
         self.last_trace = None
+        self.donate = bool(donate)
         cap = int(cap)
         P, R = part_spec, rep_spec
         carry_specs = carry_part_specs(P, R, trace=self.trace_len > 0)
 
-        def smap(f, in_specs, out_specs):
+        def smap(f, in_specs, out_specs, donate_argnums=()):
             return jax.jit(jax.shard_map(
                 f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False))
+                check_vma=False),
+                donate_argnums=donate_argnums if self.donate else ())
 
         if mixed:
             # Three jitted pieces so the f32 Krylov state survives dispatch
@@ -105,6 +117,8 @@ class ChunkedEngine:
 
             def _inner_cycle(data, rhat32, prec32, tol_cycle, carry32,
                              budget, scale=None):
+                if recorder is not None:       # runs at trace time only
+                    recorder.inc("trace.inner_cycle")
                 res, carry2 = pcg(
                     ops32, data["f32"], rhat32, carry32["x"], prec32,
                     tol=tol_cycle,
@@ -124,8 +138,11 @@ class ChunkedEngine:
 
             in_cycle = (data_specs, P, P, R, carry_specs, R) + (
                 (R,) if traced else ())
+            # donated f32 carry: each resumable dispatch updates the
+            # Krylov state in place instead of copying it
             self._inner_cycle_fn = smap(
-                _inner_cycle, in_cycle, (P, carry_specs, R))
+                _inner_cycle, in_cycle, (P, carry_specs, R),
+                donate_argnums=(4,))
 
             if amul_fn is None:
                 def _refine(data, fext, x, xinc32, scale):
@@ -137,13 +154,17 @@ class ChunkedEngine:
                     normr2 = jnp.sqrt(ops.wdot(w, r2, r2))
                     return x2, r2, normr2
 
+                # donated previous iterate: x2 replaces x 1:1
                 self._refine_fn = smap(
-                    _refine, (data_specs, P, P, P, R), (P, P, R))
+                    _refine, (data_specs, P, P, P, R), (P, P, R),
+                    donate_argnums=(2,))
             else:
                 def _refine_pre(x, xinc32, scale):
                     return x + xinc32.astype(x.dtype) * scale
 
-                self._refine_pre_fn = smap(_refine_pre, (P, P, R), P)
+                # donated previous iterate: x2 replaces x 1:1
+                self._refine_pre_fn = smap(_refine_pre, (P, P, R), P,
+                                           donate_argnums=(0,))
 
                 def _refine_post(data, fext, kx2):
                     data64 = data["f64"]
@@ -168,6 +189,8 @@ class ChunkedEngine:
                 # Resumable call: the Krylov recurrence continues across
                 # dispatch boundaries, so N capped dispatches are iteration-
                 # for-iteration identical to one long solve.
+                if recorder is not None:       # runs at trace time only
+                    recorder.inc("trace.cycle")
                 res, carry2 = pcg(
                     ops, data, fext, carry["x"], inv_diag,
                     tol=scfg.tol,
@@ -178,9 +201,11 @@ class ChunkedEngine:
                     carry_in=carry, return_carry=True)
                 return res.x, carry2, res.flag, res.relres
 
+            # donated carry: the resumable Krylov state is aliased across
+            # dispatch boundaries instead of copied
             self._cycle_fn = smap(
                 _cycle, (data_specs, P, P, carry_specs, R),
-                (P, carry_specs, R, R))
+                (P, carry_specs, R, R), donate_argnums=(3,))
 
             def _final(data, fext, carry):
                 """Min-residual selection at terminal failure (once/step)."""
@@ -203,6 +228,51 @@ class ChunkedEngine:
         if self._rec is None:
             return contextlib.nullcontext()
         return self._rec.dispatch(name)
+
+    def warmup(self, data, fext, carry, normr0, n2b, prec):
+        """Compile every budget-loop program by running each ONCE with a
+        1-iteration budget: a single Krylov iteration of execution per
+        program, negligible next to the minutes-scale XLA compiles this
+        front-loads into the persistent compilation cache
+        (Solver.warmup / `pcg-tpu warmup`).  CONSUMES ``carry`` when
+        donation is on — callers pass a throwaway start state; every
+        output is discarded."""
+        one = jnp.asarray(1, jnp.int32)
+        # Same dispatch names/spans as run(): the warmup call IS the
+        # call that pays compile, and booking it cold here keeps the
+        # real solve's first dispatch truthfully warm in
+        # dispatch_stats() / the run_summary attribution.
+        if self.mixed:
+            trace = (trace_host_init(self.trace_len)
+                     if self.trace_len > 0 else None)
+            start_args = (data, carry["r"], normr0, n2b) + (
+                (trace,) if trace is not None else ())
+            with self._disp("inner_start"):
+                rhat32, tol_cycle, c32 = self._inner_start_fn(*start_args)
+            cyc_args = (data, rhat32, prec, tol_cycle, c32, one) + (
+                (normr0,) if trace is not None else ())
+            with self._disp("inner_cycle"):
+                _xin, c32, _flag = self._inner_cycle_fn(*cyc_args)
+                jax.block_until_ready(c32["exec"])
+            with self._disp("final32"):
+                xin = self._final32_fn(data, rhat32, c32)
+            with self._disp("refine"):
+                if self._amul_fn is None:
+                    out = self._refine_fn(data, fext, carry["x"], xin,
+                                          normr0)
+                else:
+                    x2 = self._refine_pre_fn(carry["x"], xin, normr0)
+                    out = self._refine_post_fn(data, fext,
+                                               self._amul_fn(data, x2))
+                jax.block_until_ready(out)
+        else:
+            with self._disp("cycle"):
+                _x, c2, _flag, _rel = self._cycle_fn(data, fext, prec,
+                                                     carry, one)
+                jax.block_until_ready(c2["exec"])
+            with self._disp("final"):
+                out = self._final_fn(data, fext, c2)
+                jax.block_until_ready(out)
 
     def run(self, data, fext, carry, normr0, n2b, prec,
             vlog: Optional[Callable[[str], None]] = None):

@@ -159,6 +159,32 @@ class Solver:
                 jax.config.update("jax_enable_x64", True)
         self.dtype = dtype
 
+        # ---- warm-path cache + donated-carry dispatch (cache/) -----------
+        # cache_dir set => partitions come from the content-addressed
+        # on-disk cache, the one-shot PCG step is AOT-exported (zero
+        # re-tracing on a warm run), and jax's persistent XLA compilation
+        # cache is wired to <cache_dir>/xla (zero re-compile).  The model
+        # fingerprint is the content half of every cache key.
+        self._donate = bool(solver_cfg.donate_carry)
+        self._cache_dir = (self.config.cache_dir or "").strip() or None
+        self._model_fp = None
+        # Counter baseline for THIS construction: the recorder may be a
+        # process-lifetime one (bench._REC) whose cache counters already
+        # carry earlier solvers' hits/misses — setup_cache must reflect
+        # only the partitions this __init__ resolved.
+        self._cache_hm0 = (
+            self._rec.counters.get("cache.partition.hit", 0),
+            self._rec.counters.get("cache.partition.miss", 0))
+        if self._cache_dir:
+            from pcg_mpi_solver_tpu.cache.aot import (
+                enable_persistent_compilation_cache)
+            from pcg_mpi_solver_tpu.cache.keys import model_fingerprint
+
+            enable_persistent_compilation_cache(self._cache_dir)
+            with self._rec.span("cache_fingerprint"):
+                self._model_fp = model_fingerprint(model)
+            self._rec.gauge("cache.dir", self._cache_dir)
+
         # ---- backend selection: structured slab fast path when possible ----
         # (TPU has no vector gather/scatter; the structured path replaces
         # them with contiguous slice shifts, parallel/structured.py.)
@@ -208,7 +234,9 @@ class Solver:
             from pcg_mpi_solver_tpu.parallel.structured import (
                 StructuredOps, device_data_structured, partition_structured)
 
-            self.pm = partition_structured(model, n_parts)
+            self.pm = self._partition_cached(
+                "structured", lambda: partition_structured(model, n_parts),
+                n_parts=n_parts)
             sp = self.pm
             use_pallas = _pallas_enabled(
                 solver_cfg.pallas, self.mesh,
@@ -228,10 +256,8 @@ class Solver:
                 use_pallas=use_pallas, pallas_interpret=interp)
         elif self.backend == "hybrid":
             from pcg_mpi_solver_tpu.parallel.hybrid import (
-                HybridOps, device_data_hybrid, partition_hybrid)
-
-            from pcg_mpi_solver_tpu.parallel.hybrid import (
-                hybrid_pallas_enabled)
+                HybridOps, device_data_hybrid, hybrid_pallas_enabled,
+                partition_env_knobs, partition_hybrid)
 
             # PCG_TPU_HYBRID_F64_REFRESH: formulation of the out-of-loop
             # f64 matvecs (Dirichlet lifting, r0, refinement
@@ -258,14 +284,24 @@ class Solver:
                     "'bucketed' (default), 'stencil' or 'general'")
             if self.mixed and _knob in ("general", "bucketed"):
                 self.f64_refresh = _knob
-                if elem_part is None:
-                    from pcg_mpi_solver_tpu.parallel.partition import (
-                        make_elem_part)
-
-                    elem_part = make_elem_part(
-                        model, n_parts, method=self.config.partition_method)
-            self.pm = partition_hybrid(model, n_parts, elem_part=elem_part,
-                                       method=self.config.partition_method)
+            method = self.config.partition_method
+            self.pm = self._partition_cached(
+                "hybrid",
+                lambda: partition_hybrid(model, n_parts,
+                                         elem_part=elem_part,
+                                         method=method),
+                n_parts=n_parts, method=method, elem_part=elem_part,
+                # every partition-time env knob keys the entry, resolved
+                # by the module that owns the defaults (block/merge
+                # reshape the level grids, combine/kd shape CombineMaps)
+                extra=partition_env_knobs())
+            if self.f64_refresh in ("general", "bucketed") \
+                    and elem_part is None:
+                # The general-refresh partition below must use the SAME
+                # element->part map (identical local dof numbering).  A
+                # cache hit skipped make_elem_part entirely, so recover
+                # the map from the partition itself.
+                elem_part = np.asarray(self.pm.elem_part)
             use_pallas = hybrid_pallas_enabled(
                 self.pm, solver_cfg.pallas, self.mesh)
             if use_pallas:
@@ -286,8 +322,12 @@ class Solver:
                 use_pallas=use_pallas, n_local_parts=lp,
                 pallas_interpret=interp)
             if self.f64_refresh in ("general", "bucketed"):
-                pm_full = partition_model(model, n_parts,
-                                          elem_part=elem_part)
+                pm_full = self._partition_cached(
+                    "general",
+                    lambda: partition_model(model, n_parts,
+                                            elem_part=elem_part),
+                    n_parts=n_parts, method="explicit",
+                    elem_part=elem_part)
                 if not (pm_full.n_loc == self.pm.n_loc
                         and np.array_equal(pm_full.node_gid,
                                            self.pm.node_gid)):
@@ -321,8 +361,13 @@ class Solver:
                                    axis_name=PARTS_AXIS),
                     rdata)
         else:
-            self.pm = partition_model(model, n_parts, elem_part=elem_part,
-                                      method=self.config.partition_method)
+            self.pm = self._partition_cached(
+                "general",
+                lambda: partition_model(
+                    model, n_parts, elem_part=elem_part,
+                    method=self.config.partition_method),
+                n_parts=n_parts, method=self.config.partition_method,
+                elem_part=elem_part)
             self.ops = Ops.from_model(self.pm, dot_dtype=dot_dtype,
                                       axis_name=PARTS_AXIS)
             data = device_data(self.pm, dtype)
@@ -385,6 +430,11 @@ class Solver:
         trace_len = self.trace_len
 
         def _step(data, un_prev, delta):
+            # Host-side trace counter: runs ONLY while jax traces this
+            # function.  The warm-path contract — an AOT-cache hit
+            # re-runs the step with ZERO tracing — is asserted against it
+            # (tests/test_cache.py).
+            self._rec.inc("trace.step")
             data64 = data["f64"] if self.mixed else data
             eff = data64["eff"]
             # Dirichlet lifting: Fext = F*delta - K.(Ud*delta)
@@ -438,7 +488,12 @@ class Solver:
             out_specs=step_out,
             check_vma=False,
         )
-        self._step_fn = jax.jit(shard_step)
+        # Donated previous-solution vector: the step's output un replaces
+        # its input un_prev 1:1 (same shape/dtype/sharding), so XLA may
+        # alias the buffers instead of copying.  The attribute rebinding
+        # in step() is the only live reference either way.
+        donate_step = (1,) if self._donate else ()
+        self._step_fn = jax.jit(shard_step, donate_argnums=donate_step)
 
         # ---- dispatch-chunked solve path (large problems) -----------------
         # (solver/chunked.py; auto-engaged above ~4M dofs)
@@ -450,6 +505,14 @@ class Solver:
             force_engage=self.backend == "hybrid")
         if self._dispatch_cap > 0:
             self._build_chunked(solver_cfg, glob_n_eff)
+        elif self._cache_dir:
+            # AOT warm path for the one-shot step program (the chunked
+            # programs rely on the persistent XLA cache + warmup()): a
+            # cache hit deserializes the exported StableHLO — zero
+            # re-tracing of _step — and its compile hits <cache_dir>/xla.
+            aot_step = self._build_aot_step(shard_step, donate_step)
+            if aot_step is not None:
+                self._step_fn = aot_step
 
         # Initial state: deterministic zeros (the reference seeds Un with
         # unseeded 1e-200*rand, pcg_solver.py:996 — an intentional
@@ -476,6 +539,19 @@ class Solver:
         # estimate must compare a first step that actually paid the compile.
         self._proc_step_times: List[float] = []
 
+        # Setup attribution (bench setup_s / warm-path triage): wall from
+        # construction start to ready-to-step, plus whether the partition
+        # came cold (built) or warm (cache).
+        self.setup_s = time.perf_counter() - self._t_init0
+        hits = self._rec.counters.get("cache.partition.hit", 0) \
+            - self._cache_hm0[0]
+        miss = self._rec.counters.get("cache.partition.miss", 0) \
+            - self._cache_hm0[1]
+        self.setup_cache = ("off" if not self._cache_dir
+                            else "warm" if hits and not miss else "cold")
+        self._rec.gauge("setup_s", round(self.setup_s, 3))
+        self._rec.gauge("setup.cache", self.setup_cache)
+
     # ------------------------------------------------------------------
     def _make_prec(self, ops, d):
         """Preconditioner inverse per config.solver.precond: scalar Jacobi
@@ -484,6 +560,124 @@ class Solver:
         from pcg_mpi_solver_tpu.ops.precond import make_prec
 
         return make_prec(ops, d, self.config.solver.precond)
+
+    # ------------------------------------------------------------------
+    # Warm-path subsystem (cache/): partition cache, AOT step, warmup
+    # ------------------------------------------------------------------
+    def _partition_cached(self, backend_label, builder, *, n_parts,
+                          method="n/a", elem_part=None, extra=None):
+        """Serve a partition from the content-addressed cache (cache/),
+        falling through to ``builder`` on a miss.  The key covers the
+        model content (fingerprint), n_parts, backend, dtype, the
+        partition method (resolving 'auto' to whether the native graph
+        partitioner is actually available), an explicit elem_part array's
+        hash, and backend-specific layout knobs — plus the cache schema
+        and package version (cache/keys.py), so a code bump invalidates
+        rather than deserializing stale layouts."""
+        if not self._cache_dir:
+            return builder()
+        from pcg_mpi_solver_tpu.cache import keys as ckeys
+        from pcg_mpi_solver_tpu.cache.partition_cache import cached_partition
+
+        extra = dict(extra or {})
+        if method == "auto" and elem_part is None:
+            # 'auto' resolves to graph-or-RCB by native availability —
+            # the resolved choice must key the entry, not the knob.
+            from pcg_mpi_solver_tpu import native
+
+            extra["native"] = bool(native.available())
+        key = ckeys.partition_cache_key(
+            self._model_fp, n_parts=int(n_parts), backend=backend_label,
+            dtype=str(np.dtype(self.dtype)), method=method,
+            elem_part_hash=(ckeys.array_hash(elem_part)
+                            if elem_part is not None else None),
+            extra=extra)
+        return cached_partition(self._cache_dir, key, builder,
+                                recorder=self._rec, label=backend_label)
+
+    def _build_aot_step(self, shard_step, donate_step):
+        """AOT-export path for the one-shot step program: deserialize the
+        exported StableHLO for this abstract signature (warm — zero
+        tracing of ``_step``) or export + persist it (cold — the one
+        trace every warm run skips).  Returns the dispatchable jit of
+        ``exported.call`` (which re-applies carry donation), or None when
+        export is unsupported — the caller keeps the plain jit."""
+        import dataclasses as _dc
+
+        from pcg_mpi_solver_tpu.cache import aot
+        from pcg_mpi_solver_tpu.cache.keys import step_cache_key
+        from pcg_mpi_solver_tpu.ops.pallas_matvec import pallas_planes
+
+        data_abs = aot.abstract_like(self.data)
+        psh = jax.sharding.NamedSharding(self.mesh, self._part_spec)
+        rsh = jax.sharding.NamedSharding(self.mesh, self._rep_spec)
+        un_abs = jax.ShapeDtypeStruct(
+            (self.pm.n_parts, self.pm.n_loc), self.dtype, sharding=psh)
+        delta_abs = jax.ShapeDtypeStruct((), self.dtype, sharding=rsh)
+        abstract_args = (data_abs, un_abs, delta_abs)
+        key = step_cache_key(
+            abstract=aot.signature_repr(abstract_args),
+            mesh=(sorted(self.mesh.shape.items()),
+                  self.mesh.devices.flat[0].platform),
+            backend=self.backend,
+            # every SolverConfig scalar is baked into the traced program
+            solver=_dc.asdict(self.config.solver),
+            trace_len=self.trace_len,
+            glob_n_dof_eff=int(self.pm.glob_n_dof_eff),
+            donate=bool(donate_step),
+            jax_version=jax.__version__,
+            # every trace-time env knob baked into the program must key
+            # it: the RESOLVED stencil form (StructuredOps pins it at
+            # construction so an env flip cannot silently change what a
+            # resume replays — the AOT layer must not reintroduce that
+            # substitution) and the pallas kernel shape knobs
+            extra={"pallas_variant": self.pallas_variant,
+                   "matvec_form": getattr(self.ops, "form", None),
+                   "pallas_planes": (pallas_planes()
+                                     if self.pallas_variant != "off"
+                                     else None),
+                   "x64": bool(jax.config.jax_enable_x64)})
+        exported = aot.cached_step(
+            self._cache_dir, key, jax.jit(shard_step), abstract_args,
+            recorder=self._rec)
+        if exported is None:
+            return None
+        return jax.jit(exported.call, donate_argnums=donate_step)
+
+    def warmup(self):
+        """Compile the engaged solve path WITHOUT running a solve, so a
+        later hardware window pays no setup: populates the AOT step cache
+        and the persistent XLA compilation cache (both live under
+        ``config.cache_dir`` when set — warmup works without it too, but
+        then only this process benefits).  One-shot path: AOT
+        lower+compile, zero execution.  Chunked path: the start programs
+        execute once and each budget-loop program runs a single capped
+        Krylov iteration (ChunkedEngine.warmup) — negligible runtime next
+        to the minutes-scale compiles this front-loads.  ``self.un`` and
+        all solve history are untouched.  CLI: ``pcg-tpu warmup``."""
+        delta = jnp.asarray(1.0, self.dtype)
+        with self._rec.span("warmup", emit=True):
+            if self._dispatch_cap > 0:
+                # same dispatch name as _step_chunked: warmup pays the
+                # compile, so the real solve's start books warm
+                with self._rec.dispatch("start"):
+                    udi = self._start_pre_fn(self.data, delta)
+                    kudi = self._amul64_fn(self.data, udi)
+                    fext, x0 = self._start_mid_fn(self.data, self.un,
+                                                  delta, kudi)
+                    kx0 = self._amul64_fn(self.data, x0)
+                    carry, normr0, n2b, prec = self._start_post_fn(
+                        self.data, fext, x0, kx0)
+                    jax.block_until_ready(n2b)
+                # consumes carry (donated); all outputs are throwaway
+                self._engine.warmup(self.data, fext, carry, normr0, n2b,
+                                    prec)
+                jax.block_until_ready(self._finish_fn(
+                    jnp.zeros_like(udi), udi))
+            else:
+                self._step_fn.lower(self.data, self.un, delta).compile()
+        self._rec.note("warmup complete (programs compiled, caches "
+                       "populated)")
 
     # ------------------------------------------------------------------
     def _build_chunked(self, scfg, glob_n_eff):
@@ -586,7 +780,7 @@ class Solver:
             glob_n_dof_eff=glob_n_eff, cap=self._dispatch_cap,
             mixed=mixed, ops32=self.ops32 if mixed else None,
             amul_fn=self._amul64_fn, trace_len=self.trace_len,
-            recorder=self._rec)
+            recorder=self._rec, donate=self._donate)
         self._finish_fn = jax.jit(lambda x, udi: x + udi)
 
     def _step_chunked(self, delta):
@@ -636,15 +830,35 @@ class Solver:
         if self._dispatch_cap > 0:
             flag, relres, iters = self._step_chunked(delta)
         else:
-            with self._rec.dispatch("step"):
-                out = self._step_fn(
-                    self.data, self.un, jnp.asarray(delta, self.dtype))
-                un, flag, relres, iters = out[:4]
-                # Scalar fetch INSIDE the timed region and the dispatch
-                # span: on tunneled devices block_until_ready can ack
-                # before execution finishes (and async dispatch returns
-                # immediately); fetching the scalars can't.
-                flag, relres, iters = int(flag), float(relres), int(iters)
+            try:
+                with self._rec.dispatch("step"):
+                    out = self._step_fn(
+                        self.data, self.un, jnp.asarray(delta, self.dtype))
+                    un, flag, relres, iters = out[:4]
+                    # Scalar fetch INSIDE the timed region and the dispatch
+                    # span: on tunneled devices block_until_ready can ack
+                    # before execution finishes (and async dispatch returns
+                    # immediately); fetching the scalars can't.
+                    flag, relres, iters = int(flag), float(relres), int(iters)
+            except BaseException:
+                # The dispatch may have CONSUMED the donated self.un
+                # before failing (or before a KeyboardInterrupt landed) —
+                # restore a live zero state so the solver stays retryable
+                # instead of every later access dying on a deleted
+                # buffer.  Only when actually consumed: an error raised
+                # before the jitted call ran (bad delta, a sink raising)
+                # must keep the intact previous iterate.
+                if self._donate and getattr(self.un, "is_deleted",
+                                            lambda: False)():
+                    self.reset_state()
+                    # the divergence from donate_carry=False (which
+                    # would have kept the previous iterate) must be
+                    # visible to whoever catches and retries
+                    self._rec.note(
+                        "failed dispatch consumed the donated solution "
+                        "vector; state RESET TO ZERO — a retry resumes "
+                        "from u=0, not the previous iterate")
+                raise
             # trace ring: the solve's ONE device->host trace transfer
             self.last_trace = (unpack_trace(out[4]) if self.trace_len
                                else None)

@@ -326,7 +326,10 @@ class NewmarkSolver:
                 rep_spec=R_, ops=self.ops, scfg=scfg,
                 glob_n_dof_eff=glob_n_eff, cap=self._dispatch_cap,
                 mixed=self.mixed,
-                ops32=self.ops32 if self.mixed else None)
+                ops32=self.ops32 if self.mixed else None,
+                # donation-safe here too: the carry is built fresh by
+                # _start_ch_fn each step and never read after run()
+                donate=bool(getattr(scfg, "donate_carry", False)))
 
         # A = K + c*M is CONSTANT over the run (unlike the quasi-static
         # driver, whose per-step Jacobi rebuild is reference parity):

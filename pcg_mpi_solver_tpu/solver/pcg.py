@@ -43,7 +43,17 @@ def cold_carry(x0, r0, normr0, dot_dtype, trace=None) -> dict:
     p = z.  The single schema shared by every chunked-dispatch call site.
     ``trace`` (obs/trace.py ring dict) rides the carry when convergence
     tracing is on — it resumes across dispatch boundaries like the rest of
-    the Krylov state."""
+    the Krylov state.
+
+    Donation contract (solver/chunked.py donated-carry dispatch): a carry
+    dict is a linear resource — once passed to a dispatch compiled with
+    ``donate_argnums`` on the carry argument, the caller must never touch
+    that dict (or any alias of its leaves) again; the next dispatch's
+    carry is the previous dispatch's freshly-returned one.  ``pcg``'s
+    ``return_carry`` output satisfies the producer side: every returned
+    leaf is an output of the traced computation (never a passed-through
+    host reference), so donating the INPUT carry can at most alias
+    input->output buffers, exactly as intended."""
     dd = dot_dtype
     zero_i = jnp.asarray(0, jnp.int32)
     out = dict(
@@ -484,6 +494,9 @@ def pcg(
     if return_carry:
         # Raw (non-finalized) continuation state: x is the LAST iterate, not
         # the min-residual fallback — resuming must continue the recurrence.
+        # Every entry comes out of the while_loop carry (fresh outputs of
+        # the traced program), which is what makes the chunked engine's
+        # donated-carry dispatch safe (see cold_carry's donation contract).
         carry = {k: c[k] for k in ("x", "r", "p", "rho", "stag", "moresteps",
                                    "normrmin", "xmin", "imin", "since_best",
                                    "best_at_reset", "win_start", "win_count",

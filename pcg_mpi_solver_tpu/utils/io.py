@@ -48,6 +48,43 @@ def importz(filename: str):
         return pickle.loads(zlib.decompress(f.read()))
 
 
+def write_atomic(filename: str, blob) -> None:
+    """Atomic-publish discipline for shared directories (cache/,
+    concurrent warmup queues): write to a unique per-process tmp, then
+    ``os.replace`` — readers only ever see complete files, concurrent
+    writers cannot truncate each other's half-write, and a failed write
+    leaves no tmp residue.  The ONE copy of this protocol; layer
+    serialization on top (``exportz_atomic``, cache/aot.py).
+
+    ``blob``: bytes, or a ``callable(fileobj)`` that STREAMS the payload
+    (bench.py's flagship model pickles are multi-hundred-MB — streaming
+    avoids materializing the serialized blob on top of the live model)."""
+    import threading
+
+    # pid alone is not unique: two threads of one process storing the
+    # same cache key would interleave into a single tmp file
+    tmp = f"{filename}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            if callable(blob):
+                blob(f)
+            else:
+                f.write(blob)
+        os.replace(tmp, filename)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def exportz_atomic(filename: str, data) -> None:
+    """``exportz`` published via :func:`write_atomic`."""
+    write_atomic(filename,
+                 zlib.compress(pickle.dumps(data, pickle.HIGHEST_PROTOCOL)))
+
+
 class RunStore:
     """Owns one Results_Run directory.
 

@@ -62,6 +62,27 @@ def test_tool_validates_jsonl(tmp_path):
     assert len(errs) >= 2
 
 
+def test_setup_detail_fields_validate():
+    """Warm-path bench fields (cache/ subsystem): numeric-or-null
+    setup_s/time_to_first_iter_s and the off/cold/warm setup_cache enum
+    are enforced WHEN present; absent fields (pre-warm-path committed
+    artifacts) stay valid — exercised above on the real BENCH_r0*.json."""
+    from pcg_mpi_solver_tpu.obs.schema import validate_bench_line
+
+    base = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0}
+    ok = dict(base, detail={"setup_s": 1.5, "setup_cache": "cold",
+                            "time_to_first_iter_s": None})
+    assert validate_bench_line(ok) == []
+    assert validate_bench_line(dict(base, detail={})) == []
+    bad_num = dict(base, detail={"setup_s": "1.5s"})
+    assert any("setup_s" in e for e in validate_bench_line(bad_num))
+    bad_ttfi = dict(base, detail={"time_to_first_iter_s": "soon"})
+    assert any("time_to_first_iter_s" in e
+               for e in validate_bench_line(bad_ttfi))
+    bad_enum = dict(base, detail={"setup_cache": "lukewarm"})
+    assert any("setup_cache" in e for e in validate_bench_line(bad_enum))
+
+
 def test_current_bench_line_is_schema_valid():
     """The line bench.py emits TODAY must satisfy the schema the lint
     enforces (catches drift between emitter and validator)."""

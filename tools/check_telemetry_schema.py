@@ -9,6 +9,11 @@ Validates JSON artifacts against the versioned contracts in
                          failed-round wrappers with ``parsed: null`` pass)
 * ``bench_*.json``     — provisional/salvage side files written by bench.py
 
+Bench-line ``detail`` carries the warm-path attribution fields
+(``setup_s`` / ``time_to_first_iter_s`` numeric-or-null, ``setup_cache``
+off/cold/warm — obs/schema.py BENCH_DETAIL_NUMERIC): typed when present,
+optional so pre-warm-path committed artifacts stay valid.
+
 Usage::
 
     python tools/check_telemetry_schema.py [PATH ...]

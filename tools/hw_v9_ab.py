@@ -31,9 +31,24 @@ from tools.hw_session import log_line, run_step, start_queue  # noqa: E402
 
 
 def _parse_ab(path, marker):
-    """(gse_ms, v9_ms or None) from the A/B step's log section."""
-    text = open(path).read()
-    sect = text[text.rindex(marker):]
+    """(gse_ms, v9_ms or None) from the A/B step's log section.
+
+    Never raises: an unreadable log or a missing marker (the step died
+    before writing its section header) is an anomaly of ONE step, and it
+    must not abort the remaining independent steps of a scarce hardware
+    window — log it and report (None, None), which downstream treats as
+    "v9 produced no number"."""
+    try:
+        text = open(path).read()
+        sect = text[text.rindex(marker):]
+    except (OSError, ValueError) as e:
+        try:
+            log_line(path, f"v9 A/B parse anomaly ({type(e).__name__}: "
+                           f"{e}) — treating as no-measurement")
+        except OSError:
+            print(f"v9 A/B parse anomaly ({type(e).__name__}: {e})",
+                  flush=True)         # the log file itself is the anomaly
+        return None, None
     gse = re.search(r"xla \(gse\):\s+([0-9.]+) ms/matvec", sect)
     v9 = re.search(r"pallas v9 C=8:\s+([0-9.]+) ms/matvec", sect)
     return (float(gse.group(1)) if gse else None,
