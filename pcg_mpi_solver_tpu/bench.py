@@ -552,6 +552,11 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
          f"({setup_info['setup_cache']} partition), first iter at "
          f"{setup_info['time_to_first_iter_s']}s")
     plat = _accel_platform() if emitter is not None else "cpu"
+    # ONE run-config detail dict for the insurance line, the
+    # failed-timed-solve salvage line, and (via the caller) the final
+    # line — three consumers that must not drift in attribution.
+    run_extra = _run_config_extra(s, dtype, mode, pallas_on, n_parts,
+                                  t_part, plat, setup=setup_info)
     if emitter is not None and r0.flag == 0 and plat != "cpu":
         # Insurance against a device death DURING the timed solve: on
         # 2026-08-01 the tunnel died mid-timed-dispatch 29 SECONDS after
@@ -561,8 +566,7 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
         # conservative (wall includes compile + start overhead) and
         # labeled as such; the timed line displaces it at equal rank.
         warm_extra = dict(
-            _run_config_extra(s, dtype, mode, pallas_on, n_parts, t_part,
-                              plat, setup=setup_info),
+            run_extra,
             timing="warm (first solve; wall incl. compile/start "
                    "overhead — conservative)",
             baseline_source="validated-constant",
@@ -573,15 +577,51 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
         _log("# warm-solve accelerator line (insurance): " + wline)
         emitter.offer(wline, rank=4)
 
-    # Measured solve from scratch state (compile cached).
+    # Measured solve from scratch state (compile cached).  A solver
+    # exception HERE (the r05 failure mode: the device died mid-timed-
+    # dispatch, 29 s after a completed warm solve) must not abort the
+    # round silently: the warm solve is a real accelerator measurement,
+    # so a salvage line carrying failed=true + the reason is offered at
+    # accelerator rank before the exception continues up to the ladder /
+    # fallback chain — the round artifact then records both the number
+    # and WHY the timed leg is missing.
     s.reset_state()
-    with _REC.span("timed_solve", emit=True):
-        r1 = s.step(1.0)
+    try:
+        with _REC.span("timed_solve", emit=True):
+            r1 = s.step(1.0)
+    except Exception as e:                              # noqa: BLE001
+        _offer_failed_salvage(
+            emitter, model, kind, r0, run_extra,
+            f"timed solve died: {type(e).__name__}: {e}")
+        raise
     iters = max(r1.iters, 1)
     _log(f"# timed solve: flag={r1.flag} iters={iters} "
          f"relres={r1.relres:.3e} wall={r1.wall_s:.3f}s "
          f"-> {r1.wall_s/iters*1e3:.3f} ms/iter")
     return model, s, r1, iters, t_part, pallas_on, setup_info
+
+
+def _offer_failed_salvage(emitter, model, kind, r0, extra, reason):
+    """Salvage line for a solver exception mid-measurement: the WARM
+    solve's numbers (a completed accelerator measurement) stamped with
+    ``failed``/``fail_reason`` so the round continues with an honest
+    artifact instead of aborting (round-5 post-mortem: the device death
+    mid-timed-solve aborted the timed line entirely).  No-op when there
+    is no emitter or no converged warm solve to salvage."""
+    if emitter is None or r0 is None or r0.flag != 0:
+        return None
+    if str(extra.get("platform", "cpu")).startswith("cpu"):
+        return None     # only accelerator measurements rank/salvage at 4
+    line = _result_json(
+        model, kind, r0, max(r0.iters, 1), VALIDATED_REF_NS_PER_DOF_ITER,
+        _VALIDATED_NOTE,
+        dict(extra, failed=True, fail_reason=reason,
+             timing="warm (timed solve failed; wall incl. compile/start "
+                    "overhead — conservative)",
+             baseline_source="validated-constant"))
+    _log("# timed solve failed; salvage line (failed=true): " + line)
+    emitter.offer(line, rank=4)
+    return line
 
 
 def _ladder(kind, cpu_fallback, provisional=False):

@@ -83,10 +83,21 @@ def export_step(jit_fn, abstract_args):
     return jexport.export(jit_fn)(*abstract_args)
 
 
-def load_step(cache_dir: str, key: str):
-    """Deserialize the exported step for ``key``; None on miss.  Corrupt
-    or version-incompatible blobs (jax.export enforces its own calling-
-    convention versioning) are removed and treated as a miss."""
+def load_step(cache_dir: str, key: str, recorder=None):
+    """Deserialize the exported step for ``key``; None on miss.
+
+    Corrupt, truncated, or version-incompatible blobs (jax.export
+    enforces its own calling-convention versioning; a killed writer
+    predating the atomic-publish discipline, or a torn disk, leaves
+    truncated ones) are QUARANTINED — renamed to ``<entry>.corrupt``,
+    overwriting any previous quarantine for the key so at most one is
+    kept — and treated as a cache miss, exactly matching the
+    corruption handling ``cache/partition_cache.load_partition``
+    already has (there the entry is removed; here the blob is kept for
+    forensics since a bad AOT entry usually means a toolchain-version
+    skew worth diagnosing).  The caller then re-exports and the fresh
+    entry replaces the bad one: a corrupt cache can cost one re-trace,
+    never a failed solve."""
     path = _entry_path(cache_dir, key)
     if not os.path.exists(path):
         return None
@@ -95,11 +106,21 @@ def load_step(cache_dir: str, key: str):
     try:
         with open(path, "rb") as f:
             exported = jexport.deserialize(bytearray(f.read()))
-    except Exception:                                   # noqa: BLE001
+    except Exception as e:                              # noqa: BLE001
         try:
-            os.remove(path)
+            os.replace(path, path + ".corrupt")
+            action = "quarantined"
         except OSError:
-            pass
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            action = "removed"
+        if recorder is not None:
+            recorder.inc("cache.aot.corrupt")
+            recorder.event("cache", name="aot.step.corrupt", hit=False,
+                           key=key, wall_s=0.0, action=action,
+                           error=f"{type(e).__name__}: {e}")
         return None
     try:
         os.utime(path)                                  # LRU touch
@@ -125,6 +146,13 @@ def store_step(cache_dir: str, key: str, exported) -> bool:
         return False
     evict_lru(os.path.dirname(path), keep=path,
               suffix=".jaxexport")
+    # quarantined corrupt blobs (load_step) are forensics, not cache
+    # entries — they get the same LRU discipline under their own suffix
+    # so they can never grow the shared dir unboundedly (every version
+    # bump re-keys entries, so per-key overwrite alone does not bound
+    # them)
+    evict_lru(os.path.dirname(path), keep=path,
+              suffix=".jaxexport.corrupt")
     return True
 
 
@@ -136,7 +164,7 @@ def cached_step(cache_dir: str, key: str, jit_fn, abstract_args,
     caller keeps its plain jit).  Cold/warm attribution mirrors
     ``cached_partition``."""
     t0 = time.perf_counter()
-    exported = load_step(cache_dir, key)
+    exported = load_step(cache_dir, key, recorder=recorder)
     if exported is not None:
         if recorder is not None:
             recorder.inc("cache.aot.hit")

@@ -172,6 +172,9 @@ def cmd_solve(args):
     cfg.run_id = args.run_id
     cfg.speed_test = bool(args.speed_test)
     cfg.checkpoint_every = int(args.checkpoint_every or 0)
+    cfg.snapshot_every = int(args.snapshot_every or 0)
+    if args.max_recoveries is not None:
+        cfg.solver.max_recoveries = int(args.max_recoveries)
     cfg.profile_dir = args.profile_dir or ""
     model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
     cfg.time_history.dt = model.dt   # frame timestamps follow the model's dt
@@ -358,8 +361,23 @@ def main(argv=None):
                         "(reference SpeedTestFlag)")
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="write a solver checkpoint every N time steps")
+    p.add_argument("--snapshot-every", type=int, default=0,
+                   help="mid-Krylov snapshots (resilience/): persist the "
+                        "resumable dispatch carry every N chunk "
+                        "boundaries, so a killed/preempted solve loses "
+                        "at most N chunks and --resume continues "
+                        "MID-SOLVE with bit-identical history "
+                        "(chunked dispatch path; 0 = off)")
+    p.add_argument("--max-recoveries", type=int, default=None,
+                   help="recovery-ladder budget for flag-2/4 breakdowns, "
+                        "NaN carries and device-loss dispatch failures: "
+                        "min-residual restart -> Jacobi fallback "
+                        "preconditioner -> f64 escalation (default 2; "
+                        "0 = report-and-stop)")
     p.add_argument("--resume", action="store_true",
-                   help="continue from the latest checkpoint of this run")
+                   help="continue from the latest checkpoint of this run "
+                        "(and from the latest mid-Krylov snapshot, with "
+                        "--snapshot-every)")
     p.add_argument("--backend",
                    choices=["auto", "structured", "hybrid", "general"],
                    default="auto",

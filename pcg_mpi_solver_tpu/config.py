@@ -83,6 +83,23 @@ class SolverConfig:
     # tests/test_cache.py); off is a debugging escape hatch for
     # inspecting carries between dispatches.
     donate_carry: bool = True
+    # Resilience (resilience/ subsystem, the QUASI-STATIC chunked
+    # dispatch path — solver/driver.py; the Newmark dynamics driver does
+    # not consume these knobs yet):
+    # bounded recovery-ladder attempts for flag-2/4 breakdowns, NaN/Inf
+    # carries, and device-loss dispatch failures — min-residual restart
+    # -> scalar-Jacobi fallback preconditioner -> f64 escalation (mixed
+    # mode), each attempt an obs/metrics `recovery` event.  0 disables
+    # the ladder (the historical report-and-stop behavior).  Healthy
+    # solves never enter it, so the default is on.  CLI: --max-recoveries.
+    max_recoveries: int = 2
+    # Device-loss dispatch retries per solve step (resilience dispatch
+    # guard): a failed chunked dispatch is retried with backoff from the
+    # last mid-Krylov snapshot (PCG_TPU_RETRY_BACKOFF_S tunes the base
+    # backoff).  Needs RunConfig.snapshot_every > 0 to have a snapshot
+    # to re-dispatch from; without one the failure escalates to the
+    # recovery ladder's step restart.
+    dispatch_retries: int = 2
     # Fused Pallas matvec kernel for f32 structured-backend matvecs
     # (ops/pallas_matvec.py): "auto" = on TPU devices, "on", "off",
     # "interpret" = force the kernel through the Pallas interpreter on
@@ -129,6 +146,15 @@ class RunConfig:
     # steps (0 = off).  The reference is resumable only at pipeline-stage
     # granularity (SURVEY.md §5); this adds step granularity.
     checkpoint_every: int = 0
+    # Mid-Krylov snapshots (resilience/): on the quasi-static chunked
+    # dispatch path (not Newmark dynamics),
+    # persist the resumable Krylov carry every N chunk boundaries (0 =
+    # off) into the checkpoint dir via utils/checkpoint.SnapshotStore —
+    # a killed process or lost device then loses at most N chunks, and
+    # `solve(resume=True)` continues MID-SOLVE with bit-identical
+    # history.  Also the restore point the dispatch guard re-dispatches
+    # from after a device-loss exception.  CLI: --snapshot-every.
+    snapshot_every: int = 0
     # Warm-path cache directory (cache/): when set, partitions are served
     # from a content-addressed on-disk cache, the jitted PCG step is
     # AOT-exported/deserialized (skipping re-tracing), and jax's

@@ -53,6 +53,17 @@ EVENT_KINDS: Dict[str, tuple] = {
     # one warm-path cache probe (cache/: partition load-or-build, AOT
     # step load-or-export); `hit` is the cold/warm attribution bit
     "cache": ("name", "hit", "key", "wall_s"),
+    # one recovery-ladder attempt or guarded re-dispatch (resilience/):
+    # action = restart_minres | fallback_prec | escalate_f64 |
+    # redispatch; trigger = flag2 | flag4 | nan_carry | device_loss
+    "recovery": ("action", "attempt", "trigger"),
+    # one injected fault (resilience/faultinject.py — deterministic
+    # chaos): mode = kill|exc|nan|inf|rho0, point = dispatch|boundary
+    "fault": ("mode", "point", "at"),
+    # one mid-Krylov snapshot operation (op = save | restore)
+    "snapshot": ("op", "step"),
+    # end-of-step ladder summary (emitted only when recoveries happened)
+    "recovery_done": ("flag", "attempts", "actions"),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }

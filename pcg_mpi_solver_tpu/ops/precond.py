@@ -114,6 +114,19 @@ def invert_node_blocks(B: jnp.ndarray, eff3: jnp.ndarray) -> jnp.ndarray:
 VALID_PRECONDS = ("jacobi", "block3")
 
 
+def fallback_kind(kind: str) -> "str | None":
+    """The next-weaker-but-safer preconditioner for the recovery ladder
+    (resilience/): a flag-2/4 breakdown under block-Jacobi retries under
+    scalar Jacobi — the reference's only preconditioner, whose inverse
+    is finite wherever the assembled diagonal is nonzero, so it cannot
+    itself re-introduce the Inf the 3x3 block inverse may have produced
+    on a near-singular block.  Scalar Jacobi has nothing weaker that is
+    still a preconditioner (identity would change iteration counts far
+    more than it saves), so it returns None and the ladder skips to its
+    next rung."""
+    return "jacobi" if kind == "block3" else None
+
+
 def corner_block_field(Ke: jnp.ndarray, ck: jnp.ndarray,
                        corners) -> jnp.ndarray:
     """Brick-grid node-block assembly: every cell adds ``ck * Ke[3a:3a+3,

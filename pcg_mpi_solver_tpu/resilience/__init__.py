@@ -1,0 +1,40 @@
+"""Resilience subsystem: preemption-safe solves on scarce hardware.
+
+The paper's billion-DOF regime runs on preemptible, tunneled TPUs where
+a failed dispatch throws away minutes of compile and thousands of Krylov
+iterations (round 5 lost its only timed flagship measurement exactly
+this way).  This package makes the solver SURVIVE those failures rather
+than report them:
+
+* mid-Krylov snapshots (``utils/checkpoint.SnapshotStore`` + the
+  per-step :class:`~pcg_mpi_solver_tpu.resilience.recovery.ResilienceContext`)
+  — a killed process or lost device loses at most one snapshot interval,
+  and ``--resume`` continues MID-SOLVE with bit-identical history;
+* a bounded recovery ladder for flag-2/4 breakdowns and NaN/Inf carries
+  (:class:`~pcg_mpi_solver_tpu.resilience.recovery.RecoveryLadder`);
+* a retry-with-backoff dispatch guard for XLA/device-loss exceptions
+  (:class:`~pcg_mpi_solver_tpu.resilience.recovery.DispatchGuard`);
+* deterministic fault injection so every path above is exercised in
+  tier-1 on CPU (:mod:`pcg_mpi_solver_tpu.resilience.faultinject`).
+
+Import contract: jax-free at module load (the fault poisoners and the
+state put/fetch closures import jax lazily), matching ``cache/`` and
+``obs/``.
+"""
+
+from pcg_mpi_solver_tpu.resilience.faultinject import (
+    FaultPlan, InjectedDispatchError, SimulatedKill)
+from pcg_mpi_solver_tpu.resilience.recovery import (
+    DispatchGuard, RecoveryLadder, ResilienceContext, breakdown_trigger,
+    is_device_loss)
+
+__all__ = [
+    "FaultPlan",
+    "InjectedDispatchError",
+    "SimulatedKill",
+    "DispatchGuard",
+    "RecoveryLadder",
+    "ResilienceContext",
+    "breakdown_trigger",
+    "is_device_loss",
+]

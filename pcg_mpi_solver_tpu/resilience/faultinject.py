@@ -1,0 +1,202 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+Every recovery path in the framework (breakdown ladder, dispatch guard,
+mid-Krylov snapshot/resume) must be exercisable in tier-1 on CPU, without
+hardware and without flaky timing: faults fire at exact, configured
+positions in the chunked dispatch sequence, so a test (or a chaos run on
+real hardware) is bit-reproducible.
+
+A :class:`FaultPlan` is parsed from a spec string (env ``PCG_TPU_FAULTS``
+or passed programmatically, e.g. ``Solver.fault_plan = FaultPlan(...)``):
+
+    spec     := term ("," term)*
+    term     := mode "@" index ["*" count]
+    mode     := "kill" | "exc" | "nan" | "inf" | "rho0"
+    index    := 0-based position in the mode's counter (see below)
+    count    := consecutive firings (default 1; "exc@3*2" also fails the
+                first retry of dispatch 3)
+
+Two counters, both monotone over the life of the plan (they keep running
+across recovery restarts, so a second fault can be aimed at a later
+ladder rung):
+
+* the DISPATCH counter advances once per successfully completed Krylov
+  dispatch ("exc" fires *before* the dispatch with that index runs);
+* the BOUNDARY counter advances once per chunk boundary — after a direct
+  chunk / mixed refinement cycle completes and any due snapshot is taken
+  ("kill" / "nan" / "inf" / "rho0" fire *at* that boundary).
+
+Modes and the recovery path each one exercises:
+
+``exc``   raise :class:`InjectedDispatchError` (walks/talks like an XLA
+          device-loss error) before the dispatch -> dispatch guard
+          (snapshot re-dispatch) or, with the guard exhausted, the
+          driver ladder's ``device_loss`` restart.
+``kill``  raise :class:`SimulatedKill` at the chunk boundary, after the
+          snapshot -> kill-and-resume (``BaseException`` on purpose:
+          like a real SIGKILL it must not be swallowable by any
+          ``except Exception`` on the way out).
+``inf``   overwrite the nonzero entries of the carry residual with Inf
+          -> the next preconditioner apply goes Inf -> flag 2.
+``rho0``  zero the carry ``rho`` -> the resumed beta recurrence divides
+          by zero -> flag 4 (rho/pq breakdown).
+``nan``   multiply the carry residual by NaN — the silent-corruption
+          case: NO MATLAB flag trips on NaN (every breakdown predicate
+          compares false), so this exercises the host-side NaN-carry
+          detection, not the in-graph flags.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+MODES = ("kill", "exc", "nan", "inf", "rho0")
+_DISPATCH_MODES = ("exc",)
+_BOUNDARY_MODES = ("kill", "nan", "inf", "rho0")
+
+
+class SimulatedKill(BaseException):
+    """Simulated process death at a chunk boundary.
+
+    Derives from ``BaseException`` (like ``KeyboardInterrupt``) so no
+    recovery handler can catch it: a killed process does not get to run
+    its ladder — only a NEW process's ``--resume`` does.
+    """
+
+
+class InjectedDispatchError(RuntimeError):
+    """Synthetic device-loss exception (stands in for XlaRuntimeError/
+    UNAVAILABLE from a dropped tunnel or preempted device)."""
+
+
+def _parse(spec: str) -> Dict[str, Dict[int, int]]:
+    """spec string -> {mode: {index: remaining_count}}."""
+    out: Dict[str, Dict[int, int]] = {}
+    for term in (t.strip() for t in spec.split(",")):
+        if not term:
+            continue
+        try:
+            mode, rest = term.split("@", 1)
+            count = 1
+            if "*" in rest:
+                rest, c = rest.split("*", 1)
+                count = int(c)
+            idx = int(rest)
+        except ValueError:
+            raise ValueError(
+                f"bad fault term {term!r} (want mode@index[*count])")
+        mode = mode.strip()
+        if mode not in MODES:
+            raise ValueError(f"unknown fault mode {mode!r} "
+                             f"(valid: {', '.join(MODES)})")
+        if idx < 0 or count < 1:
+            raise ValueError(f"bad fault term {term!r}: index >= 0, "
+                             f"count >= 1")
+        out.setdefault(mode, {})[idx] = count
+    return out
+
+
+class FaultPlan:
+    """One deterministic injection schedule (see module docstring).
+
+    Stateful and single-use by design: counters and remaining fire-counts
+    advance as the solve runs, so a plan instance describes one process
+    lifetime, exactly like the failures it simulates.
+    """
+
+    def __init__(self, spec: str, recorder=None):
+        self._faults = _parse(spec)
+        self.recorder = recorder
+        self.dispatches = 0         # completed Krylov dispatches
+        self.boundaries = 0         # completed chunk boundaries
+        self.fired: List[dict] = []  # (mode, point, index) audit trail
+
+    @classmethod
+    def from_env(cls, recorder=None) -> Optional["FaultPlan"]:
+        """Plan from ``PCG_TPU_FAULTS``; None when unset/empty."""
+        spec = os.environ.get("PCG_TPU_FAULTS", "").strip()
+        return cls(spec, recorder=recorder) if spec else None
+
+    @property
+    def armed(self) -> bool:
+        return any(self._faults.values())
+
+    def _take(self, mode: str, idx: int) -> bool:
+        pending = self._faults.get(mode, {})
+        if pending.get(idx, 0) <= 0:
+            return False
+        pending[idx] -= 1
+        if pending[idx] <= 0:
+            del pending[idx]
+        return True
+
+    def _fire(self, mode: str, point: str, idx: int) -> None:
+        self.fired.append({"mode": mode, "point": point, "at": idx})
+        if self.recorder is not None:
+            self.recorder.event("fault", mode=mode, point=point, at=idx)
+
+    # -- engine hooks ---------------------------------------------------
+    def on_dispatch(self) -> None:
+        """Called immediately before a Krylov dispatch.  May raise
+        :class:`InjectedDispatchError` (the count is consumed, so a
+        guarded retry of the same dispatch succeeds unless the spec asked
+        for consecutive failures with ``*count``)."""
+        idx = self.dispatches
+        if self._take("exc", idx):
+            self._fire("exc", "dispatch", idx)
+            raise InjectedDispatchError(
+                f"injected device loss before dispatch {idx} "
+                "(PCG_TPU_FAULTS)")
+
+    def on_dispatch_done(self) -> None:
+        """Called after a dispatch completes successfully."""
+        self.dispatches += 1
+
+    def at_boundary(self, carry: dict) -> dict:
+        """Called at a chunk boundary AFTER any snapshot was taken (the
+        snapshot must hold the clean state — corruption happens to the
+        live carry, as it would on real hardware).  Returns the
+        (possibly poisoned) carry; may raise :class:`SimulatedKill`.
+
+        A poison mode whose target leaf is absent from this path's carry
+        (``rho0`` needs ``rho`` — the mixed outer state has none) is NOT
+        consumed and NOT recorded as fired: a chaos drill must never
+        read "recovery path exercised" off an injection that could not
+        land."""
+        idx = self.boundaries
+        self.boundaries += 1
+        for mode, leaf in (("nan", "r"), ("inf", "r"), ("rho0", "rho")):
+            if leaf in carry and self._take(mode, idx):
+                self._fire(mode, "boundary", idx)
+                carry = _poison(carry, mode)
+        if self._take("kill", idx):
+            self._fire("kill", "boundary", idx)
+            raise SimulatedKill(
+                f"injected kill at chunk boundary {idx} (PCG_TPU_FAULTS)")
+        return carry
+
+
+def _poison(carry: dict, mode: str) -> dict:
+    """Corrupt a device-resident carry dict (new leaves, never in-place:
+    the donated-carry contract means the input dict's leaves may be the
+    fresh outputs of the previous dispatch — poisoning builds replacement
+    arrays and leaves the originals to the garbage collector)."""
+    import jax.numpy as jnp
+
+    out = dict(carry)
+    if mode == "rho0":
+        if "rho" in out:
+            out["rho"] = jnp.zeros_like(out["rho"])
+        return out
+    r = out.get("r")
+    if r is None:
+        return out
+    if mode == "nan":
+        out["r"] = r * jnp.asarray(float("nan"), r.dtype)
+    elif mode == "inf":
+        # only the nonzero entries: constrained dofs stay exactly 0, so
+        # the Inf lands where the preconditioner inverse is > 0 and the
+        # next apply_prec trips the flag-2 Inf-preconditioner exit
+        out["r"] = jnp.where(r != 0, jnp.asarray(float("inf"), r.dtype), r)
+    return out

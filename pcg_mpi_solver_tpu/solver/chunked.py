@@ -19,14 +19,22 @@ finalize programs and the host-side budget loop.  Used by
 from __future__ import annotations
 
 import contextlib
+import math
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pcg_mpi_solver_tpu.obs.trace import trace_host_init, trace_specs
 from pcg_mpi_solver_tpu.solver.pcg import (
     carry_part_specs, cold_carry, pcg, refine_tol, select_best)
+
+
+def _state_kind(state) -> str:
+    """The ``kind`` tag of a (possibly npz-round-tripped) snapshot
+    state: plain str programmatically, 0-d unicode array from disk."""
+    return str(np.asarray(state.get("kind", "")))
 
 
 class ChunkedEngine:
@@ -275,7 +283,8 @@ class ChunkedEngine:
                 jax.block_until_ready(out)
 
     def run(self, data, fext, carry, normr0, n2b, prec,
-            vlog: Optional[Callable[[str], None]] = None):
+            vlog: Optional[Callable[[str], None]] = None,
+            resilience=None, total0: int = 0):
         """Budget loop from a prepared start state to termination.
 
         ``carry``: cold carry at the start iterate (``cold_carry``);
@@ -287,96 +296,276 @@ class ChunkedEngine:
         With ``trace_len`` > 0 the convergence ring of the finished solve
         is left (device-resident) on ``self.last_trace`` — unpack it with
         ``obs.trace.unpack_trace`` (that is the single host transfer).
+
+        ``resilience`` (resilience/recovery.ResilienceContext, optional)
+        threads the preemption-safety hooks through the loop — all
+        no-ops when None.  Healthy-path cost with a context attached:
+        the snapshot state thunks are only evaluated at cadence; the
+        only unconditional extras are two already-adjacent scalar reads
+        per inner dispatch (mixed corruption detection) and, with the
+        ladder armed, one device-side copy of the iterate per mixed
+        refinement cycle (the restart iterate must survive the refine
+        step's buffer donation).  The hooks:
+
+        * chunk boundaries snapshot the resumable state (direct: the
+          Krylov carry; mixed: the outer refinement state — chunk
+          boundaries align with refinement cycles on this path) and are
+          where deterministic faults fire;
+        * a device-loss exception from a dispatch re-dispatches from the
+          last snapshot via the retry/backoff guard, composing with
+          donated-carry dispatch (the snapshot is a HOST copy, so a
+          consumed-then-crashed donation cannot orphan the solve);
+        * a persisted mid-step snapshot (``--resume`` after a kill)
+          replaces the cold start state;
+        * a NaN/Inf residual — which trips NO in-graph flag (pcg.py
+          BREAKDOWN_FLAGS) — breaks the loop within one chunk so the
+          driver's recovery ladder can restart from the min-residual
+          iterate instead of burning the whole budget on poison.
+
+        ``total0`` continues the iteration budget across ladder restarts
+        and mid-step resumes.  After the loop, ``self.restart_x`` holds
+        the iterate a recovery restart should start from (direct: the
+        tracked min-residual iterate ``xmin``; mixed: the last iterate
+        whose f64 refresh was finite).
         """
         scfg = self.scfg
         vlog = vlog or (lambda s: None)
         self.last_trace = None
+        self.restart_x = None
         n2b_f = float(n2b)
         tolb = scfg.tol * n2b_f
-        total, flag = 0, 1
+        total, flag = int(total0), 1
         cur = float(normr0)
         relres = cur / n2b_f
         x_fin = carry["x"]
-        if cur <= tolb:
-            return x_fin, 0, relres, 0
+        faults = resilience.faults if resilience is not None else None
+        resume = (resilience.load_resume_state()
+                  if resilience is not None else None)
+        if cur <= tolb and resume is None:
+            # already converged at entry (a cold start below tol, or a
+            # ladder-restart iterate whose true residual already meets
+            # it): report the CUMULATIVE iteration count and surface the
+            # carry's ring (empty-but-valid) rather than dropping both
+            self.last_trace = carry.get("trace")
+            self.restart_x = carry.get("xmin")
+            return x_fin, 0, relres, total
         if self.mixed:
             x, r, normr = carry["x"], carry["r"], normr0
             stall = 0
+            chunk_i = 0
             trace = (trace_host_init(self.trace_len)
                      if self.trace_len > 0 else None)
+            def _restore_mixed(st):
+                """Snapshot state -> (x, r, normr, stall, total, trace):
+                the ONE mixed-state restore, shared by mid-step resume
+                and the guard's re-dispatch so the two cannot drift."""
+                dev = resilience.restore_device(
+                    {k: st[k] for k in ("x", "r")})
+                tr = (resilience.restore_device(
+                    {"trace": st["trace"]})["trace"]
+                    if "trace" in st else None)
+                return (dev["x"], dev["r"], np.asarray(st["normr"]),
+                        int(np.asarray(st["stall"])),
+                        int(np.asarray(st["total"])), tr)
+
+            if resume is not None and _state_kind(resume) == "mixed":
+                x, r, normr, stall, total, tr = _restore_mixed(resume)
+                if trace is not None and tr is not None:
+                    trace = tr
+                cur = float(normr)
+                relres = cur / n2b_f
+            # the restart iterate must survive the refine step's donation
+            # of the previous x (a kept alias would die with the buffer);
+            # copied only when the driver ladder will actually consume it
+            keep_restart = (resilience is not None
+                            and resilience.ladder_armed)
+            good_x = jnp.copy(x) if keep_restart else None
             while flag == 1 and total < scfg.max_iter:
                 prev = cur
-                # One refinement cycle: run the f32 inner solve to ITS
-                # convergence via resumable capped dispatches, then refine.
-                vlog(f"inner_start dispatch (normr={float(normr):.3e})")
-                start_args = (data, r, normr, n2b) + (
-                    (trace,) if trace is not None else ())
-                with self._disp("inner_start"):
-                    rhat32, tol_cycle, c32 = self._inner_start_fn(*start_args)
-                inner_flag, xin = 1, None
-                while inner_flag == 1 and total < scfg.max_iter:
-                    budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
-                    vlog(f"inner_cycle dispatch (total={total})")
-                    cyc_args = (data, rhat32, prec, tol_cycle, c32,
-                                budget) + ((normr,) if trace is not None
-                                           else ())
-                    with self._disp("inner_cycle"):
-                        xin, c32, iflag = self._inner_cycle_fn(*cyc_args)
-                        # scalar fetches INSIDE the span: jax dispatch is
-                        # async, so the span only measures execution if it
-                        # contains the blocking host transfer
-                        total += int(c32["exec"])
-                        inner_flag = int(iflag)
-                    vlog(f"inner_cycle done: +{int(c32['exec'])} iters "
-                         f"flag={inner_flag}")
-                if trace is not None:
-                    # ring hand-off to the next cycle (device-to-device)
-                    trace = c32["trace"]
-                if inner_flag != 0:
-                    # Failed/exhausted inner solve: min-residual selection
-                    # (the resumable path defers it; matches one-shot
-                    # pcg_mixed's inner finalize_bad).
-                    with self._disp("final32"):
-                        xin = self._final32_fn(data, rhat32, c32)
-                vlog("refine dispatch (f64 true-residual matvec)")
-                with self._disp("refine"):
-                    if self._amul_fn is None:
-                        x, r, normr = self._refine_fn(
-                            data, fext, x, xin, normr)
-                    else:
-                        x = self._refine_pre_fn(x, xin, normr)
-                        r, normr = self._refine_post_fn(
-                            data, fext, self._amul_fn(data, x))
-                    # blocking fetch inside the span (async dispatch) —
-                    # this also absorbs any still-running earlier program
-                    # (inner_start/final32 spans have no fetch of their own)
+                try:
+                    # One refinement cycle: run the f32 inner solve to ITS
+                    # convergence via resumable capped dispatches, refine.
+                    vlog(f"inner_start dispatch (normr={float(normr):.3e})")
+                    start_args = (data, r, normr, n2b) + (
+                        (trace,) if trace is not None else ())
+                    with self._disp("inner_start"):
+                        rhat32, tol_cycle, c32 = self._inner_start_fn(
+                            *start_args)
+                    inner_flag, xin = 1, None
+                    first_dispatch, poisoned = True, False
+                    while inner_flag == 1 and total < scfg.max_iter:
+                        budget = jnp.asarray(scfg.max_iter - total,
+                                             jnp.int32)
+                        vlog(f"inner_cycle dispatch (total={total})")
+                        if faults is not None:
+                            faults.on_dispatch()
+                        cyc_args = (data, rhat32, prec, tol_cycle, c32,
+                                    budget) + ((normr,) if trace is not None
+                                               else ())
+                        with self._disp("inner_cycle"):
+                            xin, c32, iflag = self._inner_cycle_fn(*cyc_args)
+                            # scalar fetches INSIDE the span: jax dispatch
+                            # is async, so the span only measures execution
+                            # if it contains the blocking host transfer
+                            exec_n = int(c32["exec"])
+                            total += exec_n
+                            inner_flag = int(iflag)
+                        if faults is not None:
+                            faults.on_dispatch_done()
+                        vlog(f"inner_cycle done: +{exec_n} iters "
+                             f"flag={inner_flag}")
+                        if resilience is not None:
+                            # Corruption detection off ALREADY-fetched
+                            # scalars (no extra host sync on the healthy
+                            # path): (a) flag 0 with 0 iterations on the
+                            # cycle's FIRST dispatch is impossible for the
+                            # normalized inner rhs (||rhat|| = 1 > any
+                            # tol_cycle <= 0.25) unless an Inf rhs faked
+                            # tolb = tol * ||Inf|| = Inf; (b) a NaN carry
+                            # norm trips no MATLAB flag at all.  Either
+                            # way, hand the step to the driver ladder.
+                            if (first_dispatch and inner_flag == 0
+                                    and exec_n == 0) or not math.isfinite(
+                                        float(c32["normr_act"])):
+                                vlog("inner state non-finite/corrupt; "
+                                     "handing the step to the recovery "
+                                     "ladder")
+                                poisoned = True
+                                break
+                        first_dispatch = False
+                    if poisoned:
+                        if trace is not None:
+                            trace = c32["trace"]
+                        cur = float("nan")
+                        break
+                    if trace is not None:
+                        # ring hand-off to the next cycle (device-to-device)
+                        trace = c32["trace"]
+                    if inner_flag != 0:
+                        # Failed/exhausted inner solve: min-residual
+                        # selection (the resumable path defers it; matches
+                        # one-shot pcg_mixed's inner finalize_bad).
+                        with self._disp("final32"):
+                            xin = self._final32_fn(data, rhat32, c32)
+                    vlog("refine dispatch (f64 true-residual matvec)")
+                    with self._disp("refine"):
+                        if self._amul_fn is None:
+                            x, r, normr = self._refine_fn(
+                                data, fext, x, xin, normr)
+                        else:
+                            x = self._refine_pre_fn(x, xin, normr)
+                            r, normr = self._refine_post_fn(
+                                data, fext, self._amul_fn(data, x))
+                        # blocking fetch inside the span (async dispatch) —
+                        # this also absorbs any still-running earlier
+                        # program (inner_start/final32 have no fetch)
+                        cur = float(normr)
+                except Exception as e:                  # noqa: BLE001
+                    st = (resilience.handle_dispatch_failure(e, "mixed")
+                          if resilience is not None else None)
+                    if st is None:
+                        # no retry budget, or no snapshot of THIS mode's
+                        # state (e.g. one predating an escalation
+                        # switch): escalate to the driver ladder
+                        raise
+                    # re-dispatch from the snapshot: lose at most one
+                    # snapshot interval, not the step
+                    x, r, normr, stall, total, tr = _restore_mixed(st)
+                    if trace is not None and tr is not None:
+                        trace = tr
                     cur = float(normr)
+                    if keep_restart:
+                        good_x = jnp.copy(x)
+                    continue
                 vlog(f"refine done: relres={cur / n2b_f:.3e} total={total}")
+                if not math.isfinite(cur):
+                    # poisoned carry: break BEFORE the snapshot/stall
+                    # bookkeeping (never persist non-finite state); the
+                    # driver ladder restarts from self.restart_x
+                    break
+                if keep_restart:
+                    good_x = jnp.copy(x)
+                chunk_i += 1
                 if cur <= tolb:
                     flag = 0
                 elif inner_flag == 2:
                     flag = 2
                 elif cur > 0.9 * prev:
-                    # no meaningful contraction over a whole refinement cycle
+                    # no meaningful contraction over a refinement cycle
                     stall += 1
                     if stall >= 2:
                         flag = 3
                 else:
                     stall = 0
+                if resilience is not None and flag == 1:
+                    resilience.after_chunk(lambda: dict(
+                        kind="mixed", chunk=chunk_i, total=total,
+                        stall=stall, normr=normr, x=x, r=r,
+                        **({"trace": trace} if trace is not None else {})))
+                    if faults is not None:
+                        st = faults.at_boundary({"r": r})
+                        r = st["r"]
             x_fin, relres = x, cur / n2b_f
             self.last_trace = trace
+            self.restart_x = good_x if good_x is not None else x
         else:
+            chunk_i = 0
+
+            def _restore_direct(st):
+                """Snapshot state -> (carry, total, relres): the ONE
+                direct-state restore, shared by mid-step resume and the
+                guard's re-dispatch so the two cannot drift."""
+                c = resilience.restore_device(
+                    {"carry": st["carry"]})["carry"]
+                return (c, int(np.asarray(st["total"])),
+                        float(np.asarray(
+                            st["carry"]["normr_act"])) / n2b_f)
+
+            if resume is not None and _state_kind(resume) == "direct":
+                carry, total, relres = _restore_direct(resume)
+                x_fin = carry["x"]
             while flag == 1 and total < scfg.max_iter:
                 budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
-                with self._disp("cycle"):
-                    x_fin, carry, cflag, crelres = self._cycle_fn(
-                        data, fext, prec, carry, budget)
-                    # scalar fetches INSIDE the span (async dispatch): the
-                    # span must contain the blocking transfer to time
-                    # execution, not enqueue
-                    total += int(carry["exec"])
-                    flag = int(cflag)
-                    relres = float(crelres)
+                try:
+                    if faults is not None:
+                        faults.on_dispatch()
+                    with self._disp("cycle"):
+                        x_fin, carry, cflag, crelres = self._cycle_fn(
+                            data, fext, prec, carry, budget)
+                        # scalar fetches INSIDE the span (async dispatch):
+                        # the span must contain the blocking transfer to
+                        # time execution, not enqueue
+                        total += int(carry["exec"])
+                        flag = int(cflag)
+                        relres = float(crelres)
+                except Exception as e:                  # noqa: BLE001
+                    st = (resilience.handle_dispatch_failure(e, "direct")
+                          if resilience is not None else None)
+                    if st is None:
+                        # no retry budget, or no snapshot of THIS mode's
+                        # state (e.g. one predating an escalation
+                        # switch): escalate to the driver ladder
+                        raise
+                    # re-dispatch from the snapshot (the donated carry may
+                    # have been consumed by the failed dispatch — the host
+                    # snapshot is the one copy that cannot have been)
+                    carry, total, relres = _restore_direct(st)
+                    flag = 1
+                    continue
+                if faults is not None:
+                    faults.on_dispatch_done()
+                chunk_i += 1
+                if flag != 1 or not math.isfinite(relres):
+                    # terminal, or NaN carry (no in-graph flag trips on
+                    # NaN): never snapshot past this point — a persisted
+                    # poisoned carry would poison the resume too
+                    break
+                if resilience is not None:
+                    resilience.after_chunk(lambda: dict(
+                        kind="direct", chunk=chunk_i, total=total,
+                        carry=carry))
+                    if faults is not None:
+                        carry = faults.at_boundary(carry)
             if flag != 0:
                 # Terminal failure: the resumable path defers MATLAB pcg's
                 # min-residual fallback to here (once per step).
@@ -384,6 +573,10 @@ class ChunkedEngine:
                     x_fin, relres_dev = self._final_fn(data, fext, carry)
                     relres = float(relres_dev)
             self.last_trace = carry.get("trace")
+            # min-residual restart iterate for the recovery ladder (only
+            # ever updated by committed finite iterations, so it stays
+            # finite through NaN poisoning and flag-2/4 breakdowns)
+            self.restart_x = carry["xmin"]
         return x_fin, flag, relres, total
 
 

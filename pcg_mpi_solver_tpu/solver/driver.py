@@ -29,6 +29,7 @@ from pcg_mpi_solver_tpu.obs.trace import (
 from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
 from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
 from pcg_mpi_solver_tpu.parallel.partition import PartitionedModel, partition_model
+from pcg_mpi_solver_tpu.resilience.faultinject import FaultPlan
 from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_mixed
 
 # The old `_vlog` stderr breadcrumb path is gone: dispatch-level
@@ -503,6 +504,19 @@ class Solver:
             solver_cfg, self.pm.glob_n_dof,
             self.pm.n_loc * (self.pm.n_parts // n_dev),
             force_engage=self.backend == "hybrid")
+        # ---- resilience subsystem (resilience/): recovery ladder, mid-
+        # Krylov snapshots, dispatch guard, deterministic fault injection.
+        # All chunked-path-only; the one-shot path keeps its donated-carry
+        # zero-state restore (step(), below).  `fault_plan` is settable
+        # (tests inject programmatically; PCG_TPU_FAULTS drives chaos runs).
+        self.fault_plan = FaultPlan.from_env(recorder=self._rec)
+        self._resume_pending = False     # solve(resume=True) arms mid-step
+        #                                  snapshot resume for its steps
+        self._snap_store = None          # lazy: fingerprints the model once
+        self._restart_post_fn = None     # lazy: ladder restart program
+        self._fallback_prec_fn = None    # lazy: scalar-Jacobi fallback
+        self._esc_engine = None          # lazy: f64 escalation engine
+        self._esc_prec_fn = None
         if self._dispatch_cap > 0:
             self._build_chunked(solver_cfg, glob_n_eff)
         elif self._cache_dir:
@@ -789,8 +803,21 @@ class Solver:
         Semantics match the one-shot path (same fext/lifting, same inner
         PCG); the resumable carry makes direct-mode dispatches iteration-
         for-iteration identical to one long solve, and chunk boundaries
-        align with refinement cycles in mixed mode."""
+        align with refinement cycles in mixed mode.
+
+        This is also where the recovery ladder lives (resilience/): when
+        the budget loop terminates on a flag-2/4 breakdown, a NaN/Inf
+        carry, or a device-loss exception, the solve restarts from the
+        engine's tracked min-residual iterate through a bounded
+        escalation — plain restart -> scalar-Jacobi fallback
+        preconditioner -> f64 escalation (mixed) — instead of reporting
+        the failure and discarding thousands of Krylov iterations.  The
+        total iteration budget (``max_iter``) spans all attempts."""
+        from pcg_mpi_solver_tpu.resilience.recovery import (
+            RecoveryLadder, breakdown_trigger, is_device_loss)
+
         rec = self._rec
+        scfg = self.config.solver
         rec.note("start dispatch (lifting + r0; first call pays compile)")
         delta_dev = jnp.asarray(delta, self.dtype)
         with rec.dispatch("start"):
@@ -807,14 +834,248 @@ class Solver:
             self.un = self._finish_fn(jnp.zeros_like(carry["x"]), udi)
             self.last_trace = empty_trace() if self.trace_len else None
             return 0, 0.0, 0
-        x_fin, flag, relres, total = self._engine.run(
-            self.data, fext, carry, normr0, n2b, prec, vlog=rec.note)
+        ctx = self._make_resilience()
+        engine, eng_data, eng_prec = self._engine, self.data, prec
+        ladder = None
+        total = 0
+        while True:
+            err = None
+            try:
+                x_fin, flag, relres, total = engine.run(
+                    eng_data, fext, carry, normr0, n2b, eng_prec,
+                    vlog=rec.note, resilience=ctx, total0=total)
+                trigger = breakdown_trigger(flag, relres)
+                restart_x = engine.restart_x
+            except Exception as e:          # noqa: BLE001 — classified below
+                # the engine's guard already retried from the snapshot;
+                # reaching here means the guard budget is spent (or there
+                # was no snapshot to re-dispatch from)
+                if scfg.max_recoveries <= 0 or not is_device_loss(e):
+                    raise
+                trigger, restart_x, err = "device_loss", None, e
+            if trigger is None:
+                break
+            if ladder is None:
+                ladder = RecoveryLadder(
+                    precond=scfg.precond, mixed=self.mixed,
+                    max_recoveries=scfg.max_recoveries, recorder=rec)
+            action = ladder.next_action(trigger)
+            if action is None:              # recovery budget spent
+                if err is not None:
+                    raise err
+                rec.note(f"recovery budget exhausted "
+                         f"({ladder.attempt} attempts); reporting "
+                         f"flag={flag} relres={relres:.3e}")
+                break
+            rec.note(f"recovery attempt {ladder.attempt}/"
+                     f"{scfg.max_recoveries}: {action} after {trigger} "
+                     f"(total={total})")
+            if action == "fallback_prec":
+                eng_prec = self._fallback_prec()
+            elif action == "escalate_f64":
+                engine, eng_data, eng_prec = self._escalation()
+            if restart_x is None:
+                # device loss: the in-flight carry may be gone with the
+                # failed dispatch — rebuild the step's cold start state
+                # (fext/x0/kx0 are intact: the start programs never
+                # donate their operands)
+                with rec.dispatch("start"):
+                    carry, normr0, _n2b, prec0 = self._start_post_fn(
+                        self.data, fext, x0, kx0)
+                if eng_prec is prec:
+                    eng_prec = prec = prec0
+            else:
+                # min-residual-iterate restart: a cold Krylov carry at the
+                # best iterate seen, through the SHARED out-of-loop amul
+                # program (no extra stencil instantiation)
+                with rec.dispatch("restart"):
+                    kx = self._amul64_fn(self.data, restart_x)
+                    carry, normr0 = self._restart_post()(
+                        self.data, fext, restart_x, kx)
+                    jax.block_until_ready(normr0)
+        if ladder is not None and ladder.attempt:
+            rec.event("recovery_done", flag=flag, relres=relres,
+                      attempts=ladder.attempt,
+                      actions=list(ladder.actions_taken))
         if self.trace_len:
-            tr = self._engine.last_trace
+            tr = engine.last_trace
             self.last_trace = (unpack_trace(tr) if tr is not None
                                else empty_trace())
+        if ctx is not None:
+            ctx.discard()               # the step is complete: its mid-
+            #                             Krylov snapshot must not outlive it
         self.un = self._finish_fn(x_fin, udi)
         return flag, relres, total
+
+    # ------------------------------------------------------------------
+    # Resilience subsystem (resilience/): context + recovery programs
+    # ------------------------------------------------------------------
+    def _make_resilience(self):
+        """Per-step resilience context for the chunked budget loop, or
+        None when the subsystem is fully disabled (no ladder budget, no
+        snapshot cadence, no fault plan)."""
+        scfg = self.config.solver
+        every = int(getattr(self.config, "snapshot_every", 0))
+        plan = self.fault_plan
+        if scfg.max_recoveries <= 0 and every <= 0 and plan is None:
+            return None
+        from pcg_mpi_solver_tpu.resilience.recovery import (
+            DispatchGuard, ResilienceContext)
+
+        store = None
+        if every > 0:
+            if self._snap_store is None:
+                from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+                self._snap_store = SnapshotStore.for_solver(self)
+            store = self._snap_store
+        # optional wall clamp on the retry storm (a scarce hardware
+        # window must not be eaten by backoff loops): seconds, env-only.
+        # A malformed value must not kill the solve the knob protects.
+        deadline = os.environ.get("PCG_TPU_RETRY_DEADLINE_S", "")
+        try:
+            deadline = float(deadline) if deadline else None
+        except ValueError:
+            import warnings
+
+            warnings.warn(f"PCG_TPU_RETRY_DEADLINE_S={deadline!r} is not "
+                          "a number; retry deadline disabled")
+            deadline = None
+        return ResilienceContext(
+            store=store, step=len(self.flags) + 1, snapshot_every=every,
+            fetch_state=self._fetch_state, put_state=self._put_state,
+            guard=DispatchGuard(retries=scfg.dispatch_retries,
+                                deadline_s=deadline,
+                                recorder=self._rec),
+            faults=plan, recorder=self._rec, resume=self._resume_pending,
+            ladder_armed=scfg.max_recoveries > 0)
+
+    def _fetch_state(self, state):
+        """Device state pytree -> host numpy (collective on multi-host:
+        every process participates in the vector all-gathers; only the
+        primary later writes)."""
+        from pcg_mpi_solver_tpu.parallel.distributed import fetch_global
+
+        def rec(node):
+            if isinstance(node, dict):
+                return {k: rec(v) for k, v in node.items()}
+            if isinstance(node, (int, float, bool, str)):
+                return node
+            return fetch_global(node, self.mesh)
+
+        return rec(state)
+
+    def _put_state(self, state):
+        """Host numpy state pytree -> device, sharding-faithful: leading-
+        axis-(n_parts) arrays go back parts-sharded, everything else
+        replicated; non-numeric leaves (the ``kind`` tag) pass through."""
+        from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
+
+        n_parts = self.pm.n_parts
+
+        def rec(node):
+            if isinstance(node, dict):
+                return {k: rec(v) for k, v in node.items()}
+            a = np.asarray(node)
+            if a.dtype.kind in "OUS":
+                return node
+            spec = (self._part_spec
+                    if a.ndim >= 2 and a.shape[0] == n_parts
+                    else self._rep_spec)
+            return put_sharded(a, self.mesh, spec)
+
+        return rec(state)
+
+    def _restart_post(self):
+        """Lazily-built ladder restart program: ``(data, fext, x, kx) ->
+        (cold carry at x, ||r||)`` with ``r = fext - kx`` — the kx matvec
+        goes through the shared ``_amul64_fn``, so the restart costs no
+        extra stencil instantiation and compiles only if a recovery ever
+        fires.  Direct mode with tracing gets a FRESH ring (the poisoned
+        solve's partial ring is superseded, not resumed)."""
+        if self._restart_post_fn is None:
+            from pcg_mpi_solver_tpu.solver.pcg import (
+                carry_part_specs, cold_carry)
+
+            mixed = self.mixed
+            trace_direct = self.trace_len > 0 and not mixed
+            P, R = self._part_spec, self._rep_spec
+            carry_specs = carry_part_specs(P, R, trace=trace_direct)
+            trace_len, trace_dtype = self.trace_len, self._trace_dtype
+
+            def _restart(data, fext, x, kx):
+                d = data["f64"] if mixed else data
+                w = d["weight"] * d["eff"]
+                r = fext - kx
+                normr = jnp.sqrt(self.ops.wdot(w, r, r))
+                tr = (trace_init(trace_len, trace_dtype)
+                      if trace_direct else None)
+                return cold_carry(x, r, normr, self.ops.dot_dtype,
+                                  trace=tr), normr
+
+            self._restart_post_fn = jax.jit(jax.shard_map(
+                _restart, mesh=self.mesh,
+                in_specs=(self._specs, self._part_spec, self._part_spec,
+                          self._part_spec),
+                out_specs=(carry_specs, R), check_vma=False))
+        return self._restart_post_fn
+
+    def _fallback_prec(self):
+        """Scalar-Jacobi fallback preconditioner inverse (ladder rung 2):
+        weaker than block3 but its inverse is finite wherever the
+        assembled diagonal is nonzero, so it cannot re-introduce the Inf
+        a near-singular 3x3 block inverse produced.  Built/compiled only
+        when the rung actually fires."""
+        from pcg_mpi_solver_tpu.ops.precond import make_prec
+
+        if self._fallback_prec_fn is None:
+            mixed = self.mixed
+
+            def _fb(data):
+                if mixed:
+                    return make_prec(self.ops32, data["f32"], "jacobi")
+                return make_prec(self.ops, data, "jacobi")
+
+            self._fallback_prec_fn = jax.jit(jax.shard_map(
+                _fb, mesh=self.mesh, in_specs=(self._specs,),
+                out_specs=self._part_spec, check_vma=False))
+        with self._rec.dispatch("fallback_prec"):
+            prec = self._fallback_prec_fn(self.data)
+            jax.block_until_ready(prec)
+        return prec
+
+    def _escalation(self):
+        """f64 escalation (ladder rung 3, mixed mode): finish the solve
+        with direct f64 Krylov cycles on the existing f64 ops/data — a
+        second ChunkedEngine built lazily, so the extra compile is paid
+        only when mixed-precision iteration itself is what keeps breaking
+        (the classic case: an f32 preconditioner Inf that the f64
+        assembly does not reproduce).  Returns (engine, data, prec)."""
+        from pcg_mpi_solver_tpu.ops.precond import make_prec
+        from pcg_mpi_solver_tpu.solver.chunked import ChunkedEngine
+
+        if self._esc_engine is None:
+            specs64 = self._specs["f64"]
+            self._esc_engine = ChunkedEngine(
+                mesh=self.mesh, data_specs=specs64,
+                part_spec=self._part_spec, rep_spec=self._rep_spec,
+                ops=self.ops, scfg=self.config.solver,
+                glob_n_dof_eff=self.pm.glob_n_dof_eff,
+                cap=self._dispatch_cap, mixed=False, trace_len=0,
+                recorder=self._rec, donate=self._donate)
+
+            def _p64(data):
+                # scalar Jacobi: the escalation rung sits after the
+                # fallback-prec rung, so the safest inverse is the point
+                return make_prec(self.ops, data, "jacobi")
+
+            self._esc_prec_fn = jax.jit(jax.shard_map(
+                _p64, mesh=self.mesh, in_specs=(specs64,),
+                out_specs=self._part_spec, check_vma=False))
+        with self._rec.dispatch("esc_prec"):
+            prec = self._esc_prec_fn(self.data["f64"])
+            jax.block_until_ready(prec)
+        return self._esc_engine, self.data["f64"], prec
 
     def reset_state(self):
         """Zero the solution, preserving its device sharding (avoids a
@@ -913,6 +1174,12 @@ class Solver:
             t_done = ckpt_mgr.restore(self)
             if t_done is not None:
                 t_start = t_done + 1
+        # Mid-Krylov snapshot resume (resilience/): only an EXPLICIT
+        # --resume may continue a persisted in-step carry — a fresh solve
+        # finding a stale snap_*.npz from a previous generation must
+        # start cold (steps discard their snapshot on completion, so the
+        # armed window closes as the resumed run advances past it).
+        self._resume_pending = bool(resume)
 
         t_prep = time.perf_counter() - self._t_init0
         if do_export and t_start == 1:
@@ -962,6 +1229,7 @@ class Solver:
                 if on_step is not None:
                     on_step(t, res)
         finally:
+            self._resume_pending = False
             if profiling:
                 jax.profiler.stop_trace()
 

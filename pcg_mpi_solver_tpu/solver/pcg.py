@@ -29,6 +29,18 @@ import numpy as np
 from pcg_mpi_solver_tpu.obs.trace import trace_record, trace_specs
 from pcg_mpi_solver_tpu.ops.matvec import Ops
 
+# Flag taxonomy for recovery policy (resilience/): flags 2 (Inf
+# preconditioner) and 4 (rho/pq breakdown) are RECOVERABLE-by-restart —
+# they mean the Krylov recurrence collapsed, not that the system is
+# unsolvable, so restarting CG from the tracked min-residual iterate (a
+# fresh direction set, possibly with a weaker-but-safer preconditioner)
+# routinely completes the solve.  Flags 1 (budget) and 3 (stagnation /
+# tolerance floor) are NOT in this set: restarts cannot conjure more
+# iterations or a finer floor.  NaN carries trip NO flag at all (every
+# breakdown predicate compares false on NaN) — detecting them is the
+# host-side budget loop's job (solver/chunked.py).
+BREAKDOWN_FLAGS = (2, 4)
+
 
 class PCGResult(NamedTuple):
     x: jnp.ndarray        # (P, n_loc) solution on effective dofs (0 elsewhere)

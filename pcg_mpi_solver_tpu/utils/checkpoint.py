@@ -9,17 +9,30 @@ completed time step the full solver state (solution vector, convergence
 histories, export counters) can be written and a later run continues from
 the next step, producing byte-identical histories and export frames.
 
-Format: one ``ckpt_{t:06d}.npz`` per checkpointed step plus a ``latest``
-pointer file written atomically (tmp + rename).  A fingerprint of the model
-and solver configuration guards against resuming with mismatched state.
+Two record granularities share the directory and the fingerprint guard:
+
+* ``ckpt_{t:06d}.npz`` — full solver state after COMPLETED step ``t``
+  (:class:`CheckpointManager`), plus the atomically-published ``latest``
+  pointer.  When the pointer references a missing/corrupt file, resume
+  falls back to the newest valid checkpoint instead of failing.
+* ``snap_{t:06d}.npz`` — mid-Krylov snapshot INSIDE step ``t``
+  (:class:`SnapshotStore`, resilience subsystem): the resumable dispatch
+  carry of the chunked budget loop, persisted every N chunks so a killed
+  process or lost device loses at most one snapshot interval and
+  ``--resume`` continues mid-solve with bit-identical history.
+
+A fingerprint of the model and solver configuration guards both against
+resuming with mismatched state.
 """
 
 from __future__ import annotations
 
+import glob as _glob
 import hashlib
 import json
 import os
-from typing import Optional, Tuple
+import warnings
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -194,15 +207,49 @@ class CheckpointManager:
         os.replace(ptr + ".tmp", ptr)
         return out
 
+    @staticmethod
+    def _valid_step(path: str) -> Optional[int]:
+        """The step index of a readable checkpoint file, else None (a
+        truncated/corrupt npz — e.g. the write was killed before the
+        atomic publish discipline existed, or the disk filled — must
+        read as absent, not crash the resume)."""
+        try:
+            with np.load(path) as z:
+                return int(z["t"])
+        except Exception:                               # noqa: BLE001
+            return None
+
     def latest_step(self) -> Optional[int]:
+        """Newest restorable step: the ``latest`` pointer's target when
+        it exists and loads, else the newest VALID ``ckpt_*.npz`` in the
+        directory — a dangling/corrupt pointer target costs one
+        checkpoint interval, not the whole resume."""
+        candidates = []
         ptr = os.path.join(self.path, "latest")
-        if not os.path.exists(ptr):
-            return None
-        with open(ptr) as f:
-            name = f.read().strip()
-        if not os.path.exists(os.path.join(self.path, name)):
-            return None
-        return int(name[len("ckpt_"):-len(".npz")])
+        ptr_name = None
+        if os.path.exists(ptr):
+            with open(ptr) as f:
+                ptr_name = f.read().strip()
+            candidates.append(ptr_name)
+        candidates += sorted(
+            (os.path.basename(p) for p in
+             _glob.glob(os.path.join(self.path, "ckpt_*.npz"))
+             if os.path.basename(p) != ptr_name),
+            reverse=True)
+        for name in candidates:
+            p = os.path.join(self.path, name)
+            if not os.path.exists(p):
+                continue
+            t = self._valid_step(p)
+            if t is None:
+                continue
+            if name != ptr_name and ptr_name is not None:
+                warnings.warn(
+                    f"checkpoint 'latest' pointer references "
+                    f"{ptr_name!r} (missing or corrupt); falling back "
+                    f"to {name!r}")
+            return t
+        return None
 
     def restore(self, solver, t: Optional[int] = None) -> Optional[int]:
         """Load the checkpoint for step ``t`` (default: latest) into
@@ -250,3 +297,117 @@ class CheckpointManager:
             load_state_dict(solver, {k: z[k] for k in z.files
                                      if k not in ("t", "fingerprint")})
         return t
+
+
+# ----------------------------------------------------------------------
+# Mid-Krylov snapshots (resilience subsystem)
+# ----------------------------------------------------------------------
+
+def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out
+
+
+class SnapshotStore:
+    """Mid-solve (intra-step) Krylov snapshots under the checkpoint dir.
+
+    One ``snap_{t:06d}.npz`` per in-flight step, published through
+    ``utils/io.write_atomic`` (readers never see a half-write — exactly
+    the failure window a snapshot exists to survive) and guarded by the
+    same solver fingerprint as the step checkpoints: resuming a Krylov
+    carry against different numerics would silently produce garbage.
+    The payload is an arbitrary numpy pytree (the chunked engine's
+    resumable state — direct-mode carry or mixed-mode outer-cycle
+    state) flattened with ``/``-joined keys.
+
+    The record is a mid-STEP artifact: the owning step deletes it on
+    completion (:meth:`discard`), so a later resume can never replay a
+    snapshot past the state it belongs to.
+    """
+
+    def __init__(self, path: str, fingerprint: Optional[dict] = None):
+        self.path = path
+        self.fingerprint = fingerprint
+
+    @classmethod
+    def for_solver(cls, solver) -> "SnapshotStore":
+        return cls(solver.config.checkpoint_path, _fingerprint(solver))
+
+    def _file(self, t: int) -> str:
+        return os.path.join(self.path, f"snap_{t:06d}.npz")
+
+    def save(self, t: int, state: Dict[str, Any]) -> str:
+        """Persist the (host numpy) state pytree for in-flight step
+        ``t``.  Multi-host safe like :meth:`CheckpointManager.save`: the
+        caller's state fetch is collective, only process 0 writes."""
+        from pcg_mpi_solver_tpu.utils.io import is_primary, write_atomic
+
+        out = self._file(t)
+        if not is_primary():
+            return out
+        os.makedirs(self.path, exist_ok=True)
+        flat = _flatten(state)
+        flat["__t"] = np.int64(t)
+        flat["__fingerprint"] = np.frombuffer(
+            json.dumps(self.fingerprint or {}, sort_keys=True).encode(),
+            dtype=np.uint8).copy()
+        write_atomic(out, lambda f: np.savez_compressed(f, **flat))
+        return out
+
+    def load(self, t: int) -> Optional[Dict[str, Any]]:
+        """The state pytree snapshotted inside step ``t``, or None.
+        Raises on a fingerprint mismatch (resuming a carry under drifted
+        numerics must fail loudly, like the step checkpoints); a
+        corrupt/truncated snapshot reads as absent — the step then
+        simply restarts cold from its start state."""
+        path = self._file(t)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path) as z:
+                flat = {k: z[k] for k in z.files}
+            # a structurally-loadable npz with a missing/garbled
+            # fingerprint entry is just as corrupt as a torn zip — same
+            # reads-as-absent outcome, not a KeyError mid-resume
+            saved = json.loads(bytes(flat.pop("__fingerprint")).decode())
+        except Exception as e:                          # noqa: BLE001
+            warnings.warn(f"mid-solve snapshot {path} unreadable "
+                          f"({type(e).__name__}: {e}); restarting the "
+                          "step from its start state")
+            return None
+        flat.pop("__t", None)
+        if self.fingerprint is not None and saved != self.fingerprint:
+            diffs = {k: (saved.get(k), self.fingerprint[k])
+                     for k in self.fingerprint
+                     if saved.get(k) != self.fingerprint[k]}
+            raise ValueError(
+                f"mid-solve snapshot/solver mismatch (saved, current): "
+                f"{diffs}")
+        return _unflatten(flat)
+
+    def discard(self, t: int) -> None:
+        from pcg_mpi_solver_tpu.utils.io import is_primary
+
+        if not is_primary():
+            return
+        try:
+            os.remove(self._file(t))
+        except OSError:
+            pass

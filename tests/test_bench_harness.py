@@ -566,6 +566,52 @@ def test_warm_solve_offers_rank4_line(monkeypatch, tmp_path):
     # test_offer_rank4_persists_salvage_immediately)
 
 
+def test_failed_timed_solve_offers_salvage_line(monkeypatch, tmp_path):
+    """A solver exception mid-measurement (the r05 device death) writes
+    a salvage line carrying failed=true + the reason at accelerator
+    rank, so the round artifact records both the warm number and WHY the
+    timed leg is missing — instead of aborting with nothing."""
+    import json
+    import types
+
+    from pcg_mpi_solver_tpu import bench as b
+
+    monkeypatch.chdir(tmp_path)
+    offers = []
+
+    class Em:
+        def offer(self, line, rank=1):
+            offers.append((rank, line))
+
+    model = types.SimpleNamespace(n_dof=10_328_853)
+    r0 = types.SimpleNamespace(flag=0, relres=3.2e-8, wall_s=83.3,
+                               iters=3334)
+    extra = {"platform": "tpu", "mode": "mixed", "dtype": "float32"}
+    line = b._offer_failed_salvage(Em(), model, "cube", r0, dict(extra),
+                                   "timed solve died: XlaRuntimeError: "
+                                   "UNAVAILABLE: socket closed")
+    assert offers and offers[0][0] == 4
+    d = json.loads(line)
+    assert d["detail"]["failed"] is True
+    assert "UNAVAILABLE" in d["detail"]["fail_reason"]
+    assert d["detail"]["timing"].startswith("warm")
+    assert d["value"] > 0
+    # schema stays valid with the extra failure fields
+    from pcg_mpi_solver_tpu.obs.schema import validate_bench_line
+
+    assert validate_bench_line(d) == []
+
+    # no emitter / unconverged warm solve / CPU platform: nothing offered
+    assert b._offer_failed_salvage(None, model, "cube", r0, extra, "x") \
+        is None
+    bad = types.SimpleNamespace(flag=1, relres=1.0, wall_s=1.0, iters=5)
+    assert b._offer_failed_salvage(Em(), model, "cube", bad, extra, "x") \
+        is None
+    cpu = dict(extra, platform="cpu (CPU FALLBACK)")
+    assert b._offer_failed_salvage(Em(), model, "cube", r0, cpu, "x") \
+        is None
+
+
 def test_salvage_trims_by_value_not_recency(monkeypatch, tmp_path):
     """Write pressure from warm/const/final lines across a live wave must
     never evict the highest-vs_baseline entry (the line the round-end

@@ -292,6 +292,69 @@ def test_aot_cached_step_roundtrip(tmp_path):
                                   np.asarray(fn(x)))
 
 
+def test_aot_corrupt_entry_quarantined_and_rebuilt(tmp_path):
+    """A corrupt/truncated serialized AOT step is a cache MISS, not a
+    crash: the bad blob is quarantined (<entry>.corrupt — kept for
+    toolchain-skew forensics, matching partition_cache's corrupt-entry
+    handling) and the step is re-exported in place (ISSUE 3 satellite;
+    regression for a truncated file from a killed writer / torn disk)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.cache import aot
+
+    fn = jax.jit(lambda x: x * 3.0)
+    abstract = (jax.ShapeDtypeStruct((8,), jnp.float32),)
+    rec = MetricsRecorder()
+    d = str(tmp_path)
+    assert aot.cached_step(d, "kq", fn, abstract, recorder=rec) is not None
+    entry = os.path.join(d, "aot", "kq.jaxexport")
+    assert os.path.exists(entry)
+
+    # truncate the entry to half its bytes (a killed writer's artifact)
+    blob = open(entry, "rb").read()
+    with open(entry, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    exp = aot.cached_step(d, "kq", fn, abstract, recorder=rec)
+    assert exp is not None                      # rebuilt, not crashed
+    assert rec.counters["cache.aot.corrupt"] == 1
+    assert rec.counters["cache.aot.miss"] == 2  # the corrupt read = miss
+    assert os.path.exists(entry + ".corrupt")   # quarantined for forensics
+    assert os.path.exists(entry)                # fresh export in place
+    x = jnp.arange(8, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(jax.jit(exp.call)(x)),
+                                  np.asarray(fn(x)))
+
+    # a zero-byte entry (torn write) reads the same way
+    with open(entry, "wb"):
+        pass
+    assert aot.cached_step(d, "kq", fn, abstract, recorder=rec) is not None
+    assert rec.counters["cache.aot.corrupt"] == 2
+
+
+def test_aot_quarantine_is_lru_evicted(tmp_path, monkeypatch):
+    """Quarantined .corrupt blobs share the LRU discipline (own suffix):
+    version bumps re-key entries, so per-key overwrite alone would let
+    them grow a long-lived shared cache dir unboundedly."""
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.cache import aot
+
+    d = str(tmp_path)
+    fn = jax.jit(lambda x: x * 3.0)
+    abstract = (jax.ShapeDtypeStruct((8,), jnp.float32),)
+    assert aot.cached_step(d, "kold", fn, abstract) is not None
+    old = os.path.join(d, "aot", "kold.jaxexport")
+    with open(old, "wb") as f:
+        f.write(b"garbage")
+    assert aot.load_step(d, "kold") is None     # -> kold.jaxexport.corrupt
+    assert os.path.exists(old + ".corrupt")
+    monkeypatch.setenv("PCG_TPU_CACHE_GB", str(1 / 2**30))  # ~1 byte cap
+    assert aot.cached_step(d, "knew", fn, abstract) is not None
+    assert not os.path.exists(old + ".corrupt")
+
+
 def test_persistent_compilation_cache_not_wired_on_cpu(tmp_path):
     """Regression: on the jax 0.4.x CPU backend, entries written to the
     persistent compilation cache deserialize into executables that crash

@@ -166,6 +166,91 @@ def test_probe_history_survives_resume(tmp_path, model):
                                plot_u(cfg_a.plot_path), rtol=1e-12)
 
 
+def test_latest_pointer_fallback_to_newest_valid(tmp_path, model):
+    """When the `latest` pointer references a missing or corrupt
+    ckpt_*.npz, resume falls back to the newest VALID checkpoint instead
+    of silently starting fresh (ISSUE 3 satellite)."""
+    import os
+
+    cfg = _cfg(tmp_path, run_id="fb", every=1)
+    s = Solver(model, cfg, mesh=make_mesh(4), n_parts=4)
+    s.solve()
+    mgr = CheckpointManager(cfg.checkpoint_path)
+    assert mgr.latest_step() == 3
+
+    # corrupt the pointer's target (truncated write): fall back to t=2
+    latest = os.path.join(cfg.checkpoint_path, "ckpt_000003.npz")
+    blob = open(latest, "rb").read()
+    with open(latest, "wb") as f:
+        f.write(blob[: len(blob) // 3])
+    with pytest.warns(UserWarning, match="falling back"):
+        assert mgr.latest_step() == 2
+    s2 = Solver(model, cfg, mesh=make_mesh(4), n_parts=4)
+    with pytest.warns(UserWarning, match="falling back"):
+        assert mgr.restore(s2) == 2
+
+    # remove it entirely (dangling pointer): same fallback
+    os.remove(latest)
+    with pytest.warns(UserWarning, match="falling back"):
+        assert mgr.latest_step() == 2
+
+    # no valid checkpoint at all -> None (fresh run), not a crash
+    for f in os.listdir(cfg.checkpoint_path):
+        if f.startswith("ckpt_"):
+            os.remove(os.path.join(cfg.checkpoint_path, f))
+    assert mgr.latest_step() is None
+
+
+def test_kill_and_resume_mid_solve_parity(tmp_path, model):
+    """ISSUE 3 acceptance (a): a chunked solve killed at a chunk
+    boundary (injected SimulatedKill) and resumed produces the same
+    final flag/relres and BIT-IDENTICAL convergence history as an
+    uninterrupted solve — the mid-Krylov snapshot loses at most one
+    chunk and the resumed Krylov recurrence replays exactly."""
+    from pcg_mpi_solver_tpu.resilience import FaultPlan, SimulatedKill
+
+    def _cfg_chunked(run_id):
+        cfg = _cfg(tmp_path, run_id=run_id, every=1)
+        cfg.solver.iters_per_dispatch = 12   # force the chunked path
+        cfg.solver.trace_resid = 64          # ring rides the snapshots
+        cfg.snapshot_every = 1
+        return cfg
+
+    cfg_a = _cfg_chunked("ka")
+    sa = Solver(model, cfg_a, mesh=make_mesh(4), n_parts=4)
+    sa.solve()
+    trace_a = sa.last_trace
+
+    cfg_b = _cfg_chunked("kb")
+    sb = Solver(model, cfg_b, mesh=make_mesh(4), n_parts=4)
+    sb.fault_plan = FaultPlan("kill@3")      # die mid-step at boundary 3
+    with pytest.raises(SimulatedKill):
+        sb.solve()
+    import os
+
+    snaps = [f for f in os.listdir(cfg_b.checkpoint_path)
+             if f.startswith("snap_")]
+    assert snaps, "the kill must leave a mid-Krylov snapshot behind"
+
+    sb2 = Solver(model, cfg_b, mesh=make_mesh(4), n_parts=4)
+    sb2.solve(resume=True)
+
+    # bit-identical history: same flags, EXACT relres/iters equality,
+    # exact solution bytes, and the in-graph convergence ring (which
+    # rode the snapshot across the kill) matches sample for sample
+    assert sb2.flags == sa.flags
+    assert sb2.iters == sa.iters
+    assert sb2.relres == sa.relres
+    np.testing.assert_array_equal(sb2.displacement_global(),
+                                  sa.displacement_global())
+    trace_b = sb2.last_trace
+    assert trace_b.n_recorded == trace_a.n_recorded
+    np.testing.assert_array_equal(trace_b.normr, trace_a.normr)
+    # completed steps discarded their snapshots
+    assert not [f for f in os.listdir(cfg_b.checkpoint_path)
+                if f.startswith("snap_")]
+
+
 def test_resume_rejects_flipped_stencil_knobs(tmp_path):
     """The matvec form and hybrid block layout change the stencil's
     summation order (same exact-resume hazard as the Pallas variants):

@@ -202,6 +202,50 @@ def test_mixed_progress_default_no_small_scale_regression():
     assert np.isclose(results[150].relres, results[0].relres, rtol=1e-6)
 
 
+@pytest.mark.parametrize("fault,flag_name", [("rho0@1", "flag4"),
+                                             ("inf@1", "flag2")])
+def test_breakdown_ladder_recovers_to_converged(fault, flag_name):
+    """Engineered flag-4 (rho/pq breakdown via a zeroed carry rho — the
+    resumed beta recurrence divides by zero) and flag-2 (Inf
+    preconditioner via an Inf residual) inputs on the chunked path: the
+    recovery ladder (resilience/) must restart from the min-residual
+    iterate and finish at flag=0 within the default retry budget, with
+    the recovery visible as a telemetry event (ISSUE 3 acceptance b)."""
+    from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
+    from pcg_mpi_solver_tpu.resilience import FaultPlan
+
+    class Cap:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, ev):
+            self.events.append(ev)
+
+        def close(self):
+            pass
+
+    model = make_cube_model(4, 3, 3, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    cfg = RunConfig(
+        solver=SolverConfig(tol=1e-8, max_iter=2000, iters_per_dispatch=15),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    cap = Cap()
+    s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1,
+               recorder=MetricsRecorder(sinks=[cap]))
+    s.fault_plan = FaultPlan(fault, recorder=s.recorder)
+    res = s.step(1.0)
+    assert res.flag == 0
+    assert res.relres <= 1e-8
+    recoveries = [e for e in cap.events if e["kind"] == "recovery"]
+    assert [(e["action"], e["trigger"]) for e in recoveries] == \
+        [("restart_minres", flag_name)]
+    # the recovered solution is the true solution, not just a flag
+    u_ref = scipy_solution(model)
+    np.testing.assert_allclose(s.displacement_global(), u_ref, rtol=1e-5,
+                               atol=1e-8 * np.abs(u_ref).max())
+
+
 def test_mixed_converges_with_plateau_default():
     model = make_cube_model(5, 4, 4, h=0.5, nu=0.3, load="traction",
                             heterogeneous=True)

@@ -1,0 +1,62 @@
+"""Fast lint (tier-1): every broad ``except`` in solver/, cache/ and
+resilience/ re-raises, logs a metrics/warning event, or carries a
+``# noqa: BLE001`` justification — via the same
+tools/check_recovery_paths.py entry point CI and humans run (wired like
+the telemetry-schema lint)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_recovery_paths.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import check_recovery_paths as lint  # noqa: E402
+
+
+def test_default_scope_is_clean():
+    files = lint.iter_py_files(lint.DEFAULT_SCOPE)
+    assert files, "expected solver/cache/resilience sources"
+    errors = []
+    for f in files:
+        errors.extend(lint.check_file(f))
+    assert errors == []
+
+
+def test_tool_cli_exit_codes(tmp_path):
+    ok = subprocess.run([sys.executable, TOOL], capture_output=True,
+                        text=True, cwd=REPO)
+    assert ok.returncode == 0, ok.stderr
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    r = subprocess.run([sys.executable, TOOL, str(bad)],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "broad `except`" in r.stderr
+
+
+def test_lint_rules():
+    ok_reraise = "try:\n    f()\nexcept Exception:\n    cleanup()\n    raise\n"
+    ok_logged = ("try:\n    f()\nexcept Exception as e:\n"
+                 "    rec.note(f'failed: {e}')\n")
+    ok_warn = ("import warnings\ntry:\n    f()\nexcept Exception as e:\n"
+               "    warnings.warn(str(e))\n")
+    ok_noqa = ("try:\n    f()\n"
+               "except Exception:  # noqa: BLE001\n    pass\n")
+    ok_narrow = "try:\n    f()\nexcept OSError:\n    pass\n"
+    bad_silent = "try:\n    f()\nexcept Exception:\n    pass\n"
+    bad_bare = "try:\n    f()\nexcept:\n    x = 1\n"
+    bad_base = "try:\n    f()\nexcept BaseException:\n    pass\n"
+    for src in (ok_reraise, ok_logged, ok_warn, ok_noqa, ok_narrow):
+        assert lint.check_source(src) == [], src
+    for src in (bad_silent, bad_bare, bad_base):
+        assert lint.check_source(src) != [], src

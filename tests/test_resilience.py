@@ -1,0 +1,379 @@
+"""Resilience subsystem: deterministic fault injection drives every
+recovery path end to end on CPU — breakdown ladder (flag 2/4, NaN
+carry), dispatch guard (device-loss redispatch from the mid-Krylov
+snapshot), f64 escalation in mixed mode, and the snapshot store's
+fingerprint/corruption guards.  Kill-and-resume parity lives in
+tests/test_checkpoint.py (it is a checkpoint-contract test); the
+engineered flag-2/flag-4 ladder recoveries also run in tests/test_pcg.py
+(they are a PCG-flag-contract test)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.config import RunConfig, SolverConfig, TimeHistoryConfig
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+from pcg_mpi_solver_tpu.resilience import (
+    DispatchGuard, FaultPlan, InjectedDispatchError, RecoveryLadder,
+    SimulatedKill, breakdown_trigger, is_device_loss)
+from pcg_mpi_solver_tpu.solver.driver import Solver
+
+
+class _Capture:
+    """Metrics sink collecting events for assertions."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, ev):
+        self.events.append(ev)
+
+    def close(self):
+        pass
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("PCG_TPU_RETRY_BACKOFF_S", "0.01")
+
+
+@pytest.fixture(scope="module")
+def model():
+    return make_cube_model(5, 4, 4, heterogeneous=True)
+
+
+def _solver(model, tmp_path=None, fault=None, recorder=None, n_dev=1,
+            snapshot_every=0, **solver_kw):
+    solver_kw.setdefault("tol", 1e-8)
+    solver_kw.setdefault("max_iter", 2000)
+    solver_kw.setdefault("iters_per_dispatch", 12)
+    cfg = RunConfig(
+        scratch_path=str(tmp_path) if tmp_path is not None else "./scratch",
+        solver=SolverConfig(**solver_kw),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+    cfg.snapshot_every = snapshot_every
+    s = Solver(model, cfg, mesh=make_mesh(n_dev), n_parts=n_dev,
+               recorder=recorder)
+    if fault is not None:
+        s.fault_plan = FaultPlan(fault, recorder=s.recorder)
+    return s
+
+
+def _recoveries(cap):
+    return [(e["action"], e["trigger"]) for e in cap.events
+            if e["kind"] == "recovery"]
+
+
+# ----------------------------------------------------------------------
+# Fault-plan plumbing
+# ----------------------------------------------------------------------
+
+def test_faultplan_parse_and_counters():
+    p = FaultPlan("exc@2*2, kill@5, rho0@1")
+    assert p.armed
+    # dispatch counter: exc fires before dispatch 2, twice (retry too)
+    p.dispatches = 2
+    with pytest.raises(InjectedDispatchError):
+        p.on_dispatch()
+    with pytest.raises(InjectedDispatchError):
+        p.on_dispatch()
+    p.on_dispatch()                      # third attempt proceeds
+    assert [f["mode"] for f in p.fired] == ["exc", "exc"]
+
+    with pytest.raises(ValueError, match="unknown fault mode"):
+        FaultPlan("frobnicate@1")
+    with pytest.raises(ValueError, match="bad fault term"):
+        FaultPlan("exc@")
+    assert not FaultPlan("").armed
+    assert FaultPlan.from_env() is None  # env unset
+
+
+def test_faultplan_boundary_poison_and_kill():
+    import jax.numpy as jnp
+
+    p = FaultPlan("inf@0, rho0@1, kill@2")
+    carry = {"r": jnp.asarray([0.0, 2.0, -1.0]),
+             "rho": jnp.asarray(3.0)}
+    c0 = p.at_boundary(dict(carry))
+    r0 = np.asarray(c0["r"])
+    assert np.isinf(r0[1]) and np.isinf(r0[2]) and r0[0] == 0.0
+    c1 = p.at_boundary(dict(carry))
+    assert float(c1["rho"]) == 0.0
+    with pytest.raises(SimulatedKill):
+        p.at_boundary(dict(carry))
+    # the original carry leaves were never mutated in place
+    assert float(carry["rho"]) == 3.0
+    assert np.all(np.isfinite(np.asarray(carry["r"])))
+
+    # a poison whose target leaf is absent (rho0 on the mixed outer
+    # state) must neither fire nor consume its count: a chaos drill must
+    # not read "exercised" off an injection that could not land
+    p2 = FaultPlan("rho0@0")
+    out = p2.at_boundary({"r": carry["r"]})
+    assert p2.fired == [] and p2.armed
+    assert np.all(np.isfinite(np.asarray(out["r"])))
+
+
+def test_device_loss_classification():
+    assert is_device_loss(InjectedDispatchError("x"))
+    assert is_device_loss(RuntimeError("rpc failed: UNAVAILABLE: socket"))
+    assert not is_device_loss(ValueError("shapes mismatch"))
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert is_device_loss(XlaRuntimeError("boom"))
+
+
+def test_breakdown_trigger_taxonomy():
+    assert breakdown_trigger(2, 0.5) == "flag2"
+    assert breakdown_trigger(4, 0.5) == "flag4"
+    assert breakdown_trigger(1, float("nan")) == "nan_carry"
+    assert breakdown_trigger(0, float("inf")) == "nan_carry"
+    assert breakdown_trigger(0, 1e-9) is None
+    assert breakdown_trigger(1, 0.5) is None     # budget: not recoverable
+    assert breakdown_trigger(3, 0.5) is None     # stagnation: not either
+
+
+def test_ladder_rung_order_and_budget():
+    lad = RecoveryLadder(precond="block3", mixed=True, max_recoveries=5)
+    acts = [lad.next_action("flag4") for _ in range(6)]
+    assert acts == ["restart_minres", "fallback_prec", "escalate_f64",
+                    "escalate_f64", "escalate_f64", None]
+    # scalar jacobi has no weaker fallback; direct mode no escalation
+    lad2 = RecoveryLadder(precond="jacobi", mixed=False, max_recoveries=2)
+    assert [lad2.next_action("flag2") for _ in range(3)] == \
+        ["restart_minres", "restart_minres", None]
+
+
+def test_dispatch_guard_budget():
+    g = DispatchGuard(retries=2)
+    e = InjectedDispatchError("x")
+    assert g.should_retry(e) and g.should_retry(e)
+    assert not g.should_retry(e)                 # budget spent
+    assert not DispatchGuard(retries=5).should_retry(ValueError("no"))
+    # deadline clamp (PCG_TPU_RETRY_DEADLINE_S via the driver): a past
+    # deadline refuses retries even with budget left
+    assert not DispatchGuard(retries=5, deadline_s=-1.0).should_retry(e)
+    assert DispatchGuard(retries=5, deadline_s=3600.0).should_retry(e)
+
+
+# ----------------------------------------------------------------------
+# End-to-end recovery on the chunked solve path (CPU, tier-1)
+# ----------------------------------------------------------------------
+
+def test_nan_carry_recovers(model):
+    """NaN poison trips NO in-graph flag (pcg.py BREAKDOWN_FLAGS) — the
+    host-side detection must break within one chunk and the ladder must
+    recover from the min-residual iterate to full convergence."""
+    cap = _Capture()
+    s = _solver(model, fault="nan@1",
+                recorder=MetricsRecorder(sinks=[cap]))
+    res = s.step(1.0)
+    assert res.flag == 0 and res.relres <= 1e-8
+    assert ("restart_minres", "nan_carry") in _recoveries(cap)
+
+
+def test_dispatch_exception_without_snapshot_restarts_step(model):
+    """Device loss with no snapshot to re-dispatch from: the guard has
+    nothing safe to restore (the donated carry may be gone with the
+    failed dispatch), so the ladder restarts the step from its start
+    state — visible as a device_loss recovery event."""
+    cap = _Capture()
+    s = _solver(model, fault="exc@2",
+                recorder=MetricsRecorder(sinks=[cap]))
+    res = s.step(1.0)
+    assert res.flag == 0 and res.relres <= 1e-8
+    assert ("restart_minres", "device_loss") in _recoveries(cap)
+
+
+def test_dispatch_exception_redispatches_from_snapshot(model, tmp_path):
+    """With mid-Krylov snapshots on, a device-loss exception re-dispatches
+    from the last snapshot via the guard — same final answer, and the
+    recovery event says redispatch, not a from-scratch restart."""
+    cap = _Capture()
+    s = _solver(model, tmp_path, fault="exc@3", snapshot_every=1,
+                recorder=MetricsRecorder(sinks=[cap]))
+    ref = _solver(model)
+    r_ref = ref.step(1.0)
+    res = s.step(1.0)
+    assert res.flag == 0
+    recs = _recoveries(cap)
+    assert ("redispatch", "device_loss") in recs
+    assert ("restart_minres", "device_loss") not in recs
+    # re-dispatching from the chunk-boundary snapshot replays the lost
+    # chunk exactly: iteration count and history match the clean solve
+    assert res.iters == r_ref.iters
+    assert res.relres == r_ref.relres
+    np.testing.assert_array_equal(s.displacement_global(),
+                                  ref.displacement_global())
+
+
+def test_recovery_budget_exhausts_to_honest_failure(model):
+    """More faults than budget: the solve reports the real flag instead
+    of looping — and the attempts are all on record."""
+    cap = _Capture()
+    s = _solver(model, fault="rho0@1,rho0@2,rho0@3,rho0@4,rho0@5,rho0@6",
+                max_recoveries=2, recorder=MetricsRecorder(sinks=[cap]))
+    res = s.step(1.0)
+    assert res.flag == 4
+    assert len(_recoveries(cap)) == 2
+
+
+def test_max_recoveries_zero_is_report_and_stop(model):
+    """The historical behavior is one knob away: no ladder, the
+    breakdown flag comes back to the caller untouched."""
+    cap = _Capture()
+    s = _solver(model, fault="rho0@1", max_recoveries=0,
+                recorder=MetricsRecorder(sinks=[cap]))
+    res = s.step(1.0)
+    assert res.flag == 4
+    assert _recoveries(cap) == []
+
+
+def test_block3_ladder_reaches_fallback_prec(model):
+    """Ladder rung 2 end to end: with the block-Jacobi preconditioner, a
+    second breakdown retries under the scalar-Jacobi fallback inverse
+    (ops/precond.fallback_kind) — a differently-shaped prec dispatched
+    through the same jitted engine — and converges."""
+    cap = _Capture()
+    s = _solver(model, fault="rho0@1,rho0@2", precond="block3",
+                recorder=MetricsRecorder(sinks=[cap]))
+    res = s.step(1.0)
+    assert res.flag == 0 and res.relres <= 1e-8
+    assert _recoveries(cap) == [("restart_minres", "flag4"),
+                                ("fallback_prec", "flag4")]
+
+
+def test_mixed_mode_ladder_escalates_to_f64(model):
+    """Mixed mode: a repeatedly-corrupted residual escalates past the
+    plain restart to direct-f64 cycles (ladder rung 3) and still
+    converges to the outer tolerance.  (An Inf residual in mixed mode is
+    caught by the engine's corrupted-residual check as nan_carry — the
+    inner pcg would otherwise mistake an Inf rhs for instant
+    convergence via tolb = tol * ||Inf|| = Inf and stall to flag 3.)"""
+    cap = _Capture()
+    s = _solver(model, fault="inf@0,inf@1", precision_mode="mixed",
+                dtype="float32", dot_dtype="float64", tol=1e-9,
+                max_iter=4000, inner_tol=0.1, max_recoveries=3,
+                recorder=MetricsRecorder(sinks=[cap]))
+    res = s.step(1.0)
+    assert res.flag == 0 and res.relres <= 1e-9
+    recs = _recoveries(cap)
+    assert recs[0] == ("restart_minres", "nan_carry")
+    assert ("escalate_f64", "nan_carry") in recs
+
+
+def test_healthy_solve_is_untouched(model):
+    """With the subsystem at defaults (ladder armed, no faults, no
+    snapshots), a healthy chunked solve runs the exact same dispatch
+    sequence and produces bit-identical results to max_recoveries=0."""
+    r_on = _solver(model).step(1.0)
+    r_off = _solver(model, max_recoveries=0).step(1.0)
+    assert r_on.flag == r_off.flag == 0
+    assert r_on.iters == r_off.iters
+    assert r_on.relres == r_off.relres
+
+
+def test_mixed_kill_resume_and_guard_redispatch(model, tmp_path):
+    """The mixed-path restore (outer refinement state at cycle
+    boundaries) round-trips both ways it is consumed: a kill-and-resume
+    reproduces the uninterrupted solve bit-identically, and a guarded
+    device-loss re-dispatch converges to the same answer."""
+    def mcfg(run_id):
+        cfg = RunConfig(
+            scratch_path=str(tmp_path), run_id=run_id, checkpoint_every=1,
+            solver=SolverConfig(tol=1e-9, max_iter=4000,
+                                iters_per_dispatch=12,
+                                precision_mode="mixed", dtype="float32",
+                                dot_dtype="float64", inner_tol=0.1),
+            time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0],
+                                           export_flag=False))
+        cfg.snapshot_every = 1
+        return cfg
+
+    sa = Solver(model, mcfg("mc"), mesh=make_mesh(4), n_parts=4)
+    sa.solve()
+    cb = mcfg("mk")
+    sk = Solver(model, cb, mesh=make_mesh(4), n_parts=4)
+    sk.fault_plan = FaultPlan("kill@2")
+    with pytest.raises(SimulatedKill):
+        sk.solve()
+    sk2 = Solver(model, cb, mesh=make_mesh(4), n_parts=4)
+    sk2.solve(resume=True)
+    assert sk2.flags == sa.flags and sk2.iters == sa.iters
+    assert sk2.relres == sa.relres
+    np.testing.assert_array_equal(sk2.displacement_global(),
+                                  sa.displacement_global())
+
+    sg = Solver(model, mcfg("mg"), mesh=make_mesh(4), n_parts=4)
+    sg.fault_plan = FaultPlan("exc@3")
+    rg = sg.solve()[0]
+    assert rg.flag == 0 and rg.relres <= 1e-9
+
+
+# ----------------------------------------------------------------------
+# Snapshot store contracts
+# ----------------------------------------------------------------------
+
+def test_snapshot_store_roundtrip_and_guards(tmp_path):
+    from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+    fp = {"model_hash": "abc", "tol": 1e-8}
+    store = SnapshotStore(str(tmp_path), fp)
+    state = {"kind": "direct", "chunk": 3, "total": 36,
+             "carry": {"x": np.arange(6.0).reshape(1, 6),
+                       "rho": np.float64(2.5),
+                       "trace": {"normr": np.ones(4, np.float32)}}}
+    store.save(1, state)
+    got = SnapshotStore(str(tmp_path), fp).load(1)
+    assert str(np.asarray(got["kind"])) == "direct"
+    assert int(got["total"]) == 36
+    np.testing.assert_array_equal(got["carry"]["x"], state["carry"]["x"])
+    np.testing.assert_array_equal(got["carry"]["trace"]["normr"],
+                                  state["carry"]["trace"]["normr"])
+
+    # fingerprint drift is refused loudly
+    with pytest.raises(ValueError, match="mismatch"):
+        SnapshotStore(str(tmp_path), {"model_hash": "abc",
+                                      "tol": 1e-4}).load(1)
+
+    # a truncated snapshot reads as absent (the step restarts cold)
+    f = os.path.join(str(tmp_path), "snap_000001.npz")
+    blob = open(f, "rb").read()
+    with open(f, "wb") as fh:
+        fh.write(blob[: len(blob) // 2])
+    with pytest.warns(UserWarning, match="unreadable"):
+        assert SnapshotStore(str(tmp_path), fp).load(1) is None
+
+    # absent / discarded
+    assert store.load(7) is None
+    store.save(2, state)
+    store.discard(2)
+    assert store.load(2) is None
+
+
+def test_snapshot_resume_requires_explicit_resume(model, tmp_path):
+    """A FRESH solve never consumes a stale snapshot: without
+    resume=True the persisted mid-step state is ignored (then discarded
+    when the step completes)."""
+    cap = _Capture()
+    s = _solver(model, tmp_path, snapshot_every=1,
+                recorder=MetricsRecorder(sinks=[cap]))
+    cfg = s.config
+    res = s.solve()
+    assert all(r.flag == 0 for r in res)
+    saves = [e for e in cap.events if e["kind"] == "snapshot"
+             and e["op"] == "save"]
+    assert saves, "expected mid-Krylov snapshots to be written"
+    assert not [e for e in cap.events if e["kind"] == "snapshot"
+                and e["op"] == "restore"]
+    # completed steps discarded their snapshots
+    leftover = ([f for f in os.listdir(cfg.checkpoint_path)
+                 if f.startswith("snap_")]
+                if os.path.isdir(cfg.checkpoint_path) else [])
+    assert leftover == []
