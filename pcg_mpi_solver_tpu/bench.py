@@ -480,6 +480,21 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
     # near-zero setup.  Off by default: the flagship cold number must
     # stay an honest cold number unless the driver asks for warm.
     cfg.cache_dir = os.environ.get("BENCH_CACHE_DIR", "")
+    # Preflight gate (validate/): a generator bug (NaN loads, degenerate
+    # octree cell) must fail HERE, in milliseconds, not after the
+    # minutes-scale partition+compile of a flagship round.  Run it once
+    # explicitly so the round log carries the verdict, then mark the
+    # config validated so Solver.__init__ does not re-scan the model.
+    from pcg_mpi_solver_tpu.validate import run_preflight
+
+    with _REC.span("preflight", emit=True):
+        checks = run_preflight(model, cfg, recorder=_REC,
+                               context={"kind": "quasi_static"})
+    if checks:
+        warned = sum(1 for c in checks if c.status == "warn")
+        _log(f"# preflight: {len(checks)} checks ok"
+             + (f" ({warned} warning(s))" if warned else ""))
+        cfg.preflight = "off"       # already validated this model/config
     t_part0 = time.perf_counter()
     # time_to_first_iter_s anchor: solver-construction start -> end of
     # the FIRST device dispatch (compile included), via a one-shot

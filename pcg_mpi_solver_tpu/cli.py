@@ -4,7 +4,10 @@ orchestrated by examples/run_basic_script.bash) as one typed CLI.
 
     pcg-tpu ingest    <archive.zip> <scratch>          # unpack MDF bundle
     pcg-tpu partition <scratch> <n_parts>              # element->part map
+    pcg-tpu validate  <scratch> [--preflight=]         # preflight checks only
     pcg-tpu solve     <scratch> <run_id> [options]     # SPMD PCG solve
+    pcg-tpu dynamics  <scratch> <run_id> [options]     # explicit time history
+    pcg-tpu newmark   <scratch> <run_id> [options]     # implicit time history
     pcg-tpu export    <scratch> <run_id> <vars> <mode> # frames -> .vtu
     pcg-tpu demo      [--nx ...]                       # synthetic end-to-end
     pcg-tpu bench                                      # benchmark harness
@@ -57,14 +60,16 @@ def _load_settings(path, args) -> "RunConfig":
 
 
 def _apply_telemetry_flags(cfg, args) -> None:
-    """Wire the obs/ telemetry flags (shared by solve and demo) into the
-    RunConfig: --telemetry-out (JSONL sink), --trace-resid (in-graph
-    convergence ring), --profile-spans (jax.profiler annotations)."""
+    """Wire the shared per-run flags into the RunConfig: --telemetry-out
+    (JSONL sink), --trace-resid (in-graph convergence ring),
+    --profile-spans (jax.profiler annotations), --cache-dir, and the
+    validate/ --preflight policy override."""
     cfg.telemetry_path = getattr(args, "telemetry_out", None) or ""
     cfg.solver.trace_resid = int(getattr(args, "trace_resid", None) or 0)
     if getattr(args, "profile_spans", False):
         cfg.telemetry_profile = True
     cfg.cache_dir = _resolve_cache_dir(args)
+    cfg.preflight = getattr(args, "preflight", None) or ""
 
 
 def _resolve_cache_dir(args) -> str:
@@ -118,6 +123,36 @@ def _finish_telemetry(solver, args) -> None:
     if getattr(args, "telemetry_out", None):
         print(f">telemetry: {args.telemetry_out}")
     solver.recorder.close()
+
+
+def _add_preflight_flag(p) -> None:
+    p.add_argument("--preflight", choices=["fail", "warn", "off"],
+                   default=None,
+                   help="model/config preflight gate (validate/): fail "
+                        "= reject pathological inputs before any "
+                        "partition/compile work (default), warn = "
+                        "report and proceed, off = skip the checks "
+                        "(env default: PCG_TPU_PREFLIGHT)")
+
+
+def _add_resilience_flags(p, granularity: str) -> None:
+    """--snapshot-every / --max-recoveries / --resume, shared by the
+    solve, dynamics and newmark subcommands; ``granularity`` names what
+    one snapshot interval means on that path."""
+    p.add_argument("--snapshot-every", type=int, default=0,
+                   help=f"resumable snapshots (resilience/): persist "
+                        f"state every N {granularity} so a "
+                        "killed/preempted run loses at most N and "
+                        "--resume continues where it left off (0 = off; "
+                        "on-disk retention: PCG_TPU_SNAP_KEEP, "
+                        "default 2)")
+    p.add_argument("--max-recoveries", type=int, default=None,
+                   help="recovery budget for breakdowns, NaN/Inf "
+                        "corruption and device-loss failures (default "
+                        "2; 0 = report-and-stop)")
+    p.add_argument("--resume", action="store_true",
+                   help=f"continue from the latest snapshot/checkpoint "
+                        f"of this run ({granularity} granularity)")
 
 
 def _add_telemetry_flags(p) -> None:
@@ -198,6 +233,120 @@ def cmd_solve(args):
               f"wall={r.wall_s:.2f}s")
     td = s.time_data()
     print(f">calculation time: {td['Mean_CalcTime']:.2f} sec")
+    _finish_telemetry(s, args)
+    print(">success!")
+
+
+def cmd_validate(args):
+    """Run the validate/ preflight checks against a scratch model and
+    report every one — the dry-run twin of the gate that solve/dynamics/
+    newmark apply at construction.  The --preflight policy drives the
+    exit code exactly as it would drive the gate: fail (default) exits
+    non-zero on any failed check, warn reports and exits zero, off skips
+    the scans entirely."""
+    from pcg_mpi_solver_tpu.models.mdf import read_mdf
+    from pcg_mpi_solver_tpu.validate import preflight_checks, resolve_policy
+
+    pol = resolve_policy(getattr(args, "preflight", None))
+    if pol == "off":
+        print(">validate: preflight policy is off; nothing checked")
+        return
+    cfg = _load_settings(args.settings, args)
+    model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    print(f">preflight: {model.n_elem} elems / {model.n_dof} dofs")
+    results = preflight_checks(model, cfg, context={"kind": "validate"})
+    n_fail = 0
+    for r in results:
+        tag = {"ok": "  ok ", "warn": " WARN", "fail": " FAIL"}[r.status]
+        n_fail += r.status == "fail"
+        print(f">[{tag}] {r.name}" + (f": {r.detail}" if r.detail else ""))
+    if n_fail and pol == "fail":
+        raise SystemExit(f"validate: {n_fail} failed check(s)")
+    if n_fail:
+        print(f">validate: {n_fail} failed check(s) (policy={pol}; "
+              "exit 0)")
+    else:
+        print(">validate: all checks passed")
+
+
+def _print_dyn_summary(store_dir, name, u, extra=""):
+    os.makedirs(store_dir, exist_ok=True)
+    out = os.path.join(store_dir, name)
+    np.save(out, u)
+    print(f">final displacement -> {out}.npy{extra}")
+
+
+def cmd_dynamics(args):
+    """Explicit central-difference time history (solver/dynamics.py),
+    preemption-safe: --snapshot-every N checkpoints the full state every
+    N TIMESTEPS, --resume continues mid-history bit-identically."""
+    from pcg_mpi_solver_tpu.models.mdf import read_mdf
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+    from pcg_mpi_solver_tpu.solver.dynamics import DynamicsSolver
+
+    cfg = _load_settings(args.settings, args)
+    cfg.scratch_path = args.scratch
+    cfg.run_id = args.run_id
+    cfg.snapshot_every = int(args.snapshot_every or 0)
+    if args.max_recoveries is not None:
+        cfg.solver.max_recoveries = int(args.max_recoveries)
+    model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    n_parts, _elem_part, n_dev, n_dev_used = _resolve_partition_mesh(
+        args.n_parts, args.scratch)
+    probe = tuple(int(d) for d in (args.probe_dofs or "").split(",") if d)
+    print(f">explicit dynamics on {n_dev_used}/{n_dev} device(s), "
+          f"{n_parts} parts, {args.n_steps} steps..")
+    dyn = DynamicsSolver(model, cfg, mesh=make_mesh(n_dev_used),
+                         n_parts=n_parts, dt=args.dt,
+                         damping=args.damping, probe_dofs=probe,
+                         backend=args.backend)
+    print(f">backend: {dyn.backend}  dt={dyn.dt:.4e}")
+    res = dyn.run(args.n_steps, export_every=args.export_every,
+                  resume=bool(args.resume))
+    print(f">integrated {args.n_steps} steps "
+          f"({len(res.frames)} frames, {res.probe_u.shape[0]} probes)")
+    _print_dyn_summary(cfg.result_path, "u_dynamics", res.u)
+    if len(probe):
+        np.save(os.path.join(cfg.result_path, "probe_dynamics"),
+                res.probe_u)
+        print(f">probe series -> {cfg.result_path}/probe_dynamics.npy")
+    _finish_telemetry(dyn, args)
+    print(">success!")
+
+
+def cmd_newmark(args):
+    """Implicit Newmark-beta time history (solver/newmark.py), one PCG
+    solve per step, preemption-safe: --snapshot-every N checkpoints the
+    kinematic state every N TIMESTEPS, --resume continues mid-history
+    bit-identically (including the per-step trace ring)."""
+    from pcg_mpi_solver_tpu.models.mdf import read_mdf
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+    from pcg_mpi_solver_tpu.solver.newmark import NewmarkSolver
+
+    cfg = _load_settings(args.settings, args)
+    cfg.scratch_path = args.scratch
+    cfg.run_id = args.run_id
+    cfg.snapshot_every = int(args.snapshot_every or 0)
+    if args.max_recoveries is not None:
+        cfg.solver.max_recoveries = int(args.max_recoveries)
+    model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    n_parts, _elem_part, n_dev, n_dev_used = _resolve_partition_mesh(
+        args.n_parts, args.scratch)
+    dt = args.dt if args.dt else (model.dt if model.dt > 0 else 1.0)
+    print(f">Newmark dynamics on {n_dev_used}/{n_dev} device(s), "
+          f"{n_parts} parts, {args.n_steps} steps, dt={dt:.4e}..")
+    s = NewmarkSolver(model, cfg, mesh=make_mesh(n_dev_used),
+                      n_parts=n_parts, dt=dt, beta=args.beta,
+                      gamma=args.gamma, damping=args.damping,
+                      backend=args.backend)
+    print(f">backend: {s.backend}")
+    res = s.run([1.0] * args.n_steps, resume=bool(args.resume))
+    t_first = len(s.flags) - len(res) + 1
+    for t, r in enumerate(res, t_first):
+        print(f">step {t}: flag={r.flag} iters={r.iters} "
+              f"relres={r.relres:.3e} wall={r.wall_s:.2f}s")
+    _print_dyn_summary(cfg.result_path, "u_newmark",
+                       s.displacement_global())
     _finish_telemetry(s, args)
     print(">success!")
 
@@ -361,23 +510,7 @@ def main(argv=None):
                         "(reference SpeedTestFlag)")
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="write a solver checkpoint every N time steps")
-    p.add_argument("--snapshot-every", type=int, default=0,
-                   help="mid-Krylov snapshots (resilience/): persist the "
-                        "resumable dispatch carry every N chunk "
-                        "boundaries, so a killed/preempted solve loses "
-                        "at most N chunks and --resume continues "
-                        "MID-SOLVE with bit-identical history "
-                        "(chunked dispatch path; 0 = off)")
-    p.add_argument("--max-recoveries", type=int, default=None,
-                   help="recovery-ladder budget for flag-2/4 breakdowns, "
-                        "NaN carries and device-loss dispatch failures: "
-                        "min-residual restart -> Jacobi fallback "
-                        "preconditioner -> f64 escalation (default 2; "
-                        "0 = report-and-stop)")
-    p.add_argument("--resume", action="store_true",
-                   help="continue from the latest checkpoint of this run "
-                        "(and from the latest mid-Krylov snapshot, with "
-                        "--snapshot-every)")
+    _add_resilience_flags(p, "mid-Krylov chunk boundaries")
     p.add_argument("--backend",
                    choices=["auto", "structured", "hybrid", "general"],
                    default="auto",
@@ -389,7 +522,76 @@ def main(argv=None):
                         "compute/collective split; ignored with --speed-test)")
     _add_telemetry_flags(p)
     _add_cache_flag(p)
+    _add_preflight_flag(p)
     p.set_defaults(fn=cmd_solve)
+
+    p = sub.add_parser("validate",
+                       help="run the validate/ preflight checks against "
+                            "a scratch model (dry run; no partition, no "
+                            "compile)")
+    p.add_argument("scratch")
+    p.add_argument("--settings", default=None)
+    p.add_argument("--tol", type=float, default=None)
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--precision", choices=["direct", "mixed"], default=None)
+    _add_preflight_flag(p)
+    p.set_defaults(fn=cmd_validate)
+
+    p = sub.add_parser("dynamics",
+                       help="explicit central-difference time history "
+                            "(preemption-safe: timestep-granular "
+                            "snapshots + --resume)")
+    p.add_argument("scratch")
+    p.add_argument("run_id")
+    p.add_argument("--n-steps", type=int, required=True,
+                   help="number of explicit timesteps to integrate")
+    p.add_argument("--dt", type=float, default=None,
+                   help="timestep (default: the model's dt, else the "
+                        "CFL estimate; an explicit value above the CFL "
+                        "bound is rejected by preflight)")
+    p.add_argument("--damping", type=float, default=0.0,
+                   help="mass-proportional damping coefficient c_m")
+    p.add_argument("--export-every", type=int, default=0,
+                   help="displacement frames every k steps (0 = none)")
+    p.add_argument("--probe-dofs", default="",
+                   help="comma-separated dof ids sampled every step")
+    p.add_argument("--settings", default=None)
+    p.add_argument("--n-parts", type=int, default=None)
+    p.add_argument("--backend", choices=["auto", "hybrid", "general"],
+                   default="auto")
+    _add_resilience_flags(p, "timesteps")
+    _add_telemetry_flags(p)
+    _add_preflight_flag(p)
+    p.set_defaults(fn=cmd_dynamics)
+
+    p = sub.add_parser("newmark",
+                       help="implicit Newmark-beta time history, one "
+                            "PCG solve per step (preemption-safe: "
+                            "timestep-granular snapshots + --resume)")
+    p.add_argument("scratch")
+    p.add_argument("run_id")
+    p.add_argument("--n-steps", type=int, required=True,
+                   help="number of implicit timesteps to integrate")
+    p.add_argument("--dt", type=float, default=None,
+                   help="timestep (default: the model's dt; "
+                        "unconditionally stable at beta=1/4 gamma=1/2, "
+                        "so dt is a resolution choice, not a CFL bound)")
+    p.add_argument("--beta", type=float, default=0.25)
+    p.add_argument("--gamma", type=float, default=0.5)
+    p.add_argument("--damping", type=float, default=0.0,
+                   help="mass-proportional damping coefficient c_m")
+    p.add_argument("--settings", default=None)
+    p.add_argument("--n-parts", type=int, default=None)
+    p.add_argument("--tol", type=float, default=None)
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--precision", choices=["direct", "mixed"], default=None)
+    p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    p.add_argument("--backend", choices=["auto", "hybrid", "general"],
+                   default="auto")
+    _add_resilience_flags(p, "timesteps")
+    _add_telemetry_flags(p)
+    _add_preflight_flag(p)
+    p.set_defaults(fn=cmd_newmark)
 
     p = sub.add_parser("export", help="export result frames to VTK")
     p.add_argument("scratch")
@@ -419,6 +621,7 @@ def main(argv=None):
                         "heterogeneous conductivity)")
     _add_telemetry_flags(p)
     _add_cache_flag(p)
+    _add_preflight_flag(p)
     p.set_defaults(fn=cmd_demo)
 
     p = sub.add_parser("warmup", help="pre-bake the warm-path caches "
@@ -441,6 +644,7 @@ def main(argv=None):
                    default="auto")
     _add_telemetry_flags(p)
     _add_cache_flag(p)
+    _add_preflight_flag(p)
     p.set_defaults(fn=cmd_warmup)
 
     p = sub.add_parser("cache-stats", help="show the warm-path cache table")

@@ -83,15 +83,17 @@ class SolverConfig:
     # tests/test_cache.py); off is a debugging escape hatch for
     # inspecting carries between dispatches.
     donate_carry: bool = True
-    # Resilience (resilience/ subsystem, the QUASI-STATIC chunked
-    # dispatch path — solver/driver.py; the Newmark dynamics driver does
-    # not consume these knobs yet):
-    # bounded recovery-ladder attempts for flag-2/4 breakdowns, NaN/Inf
-    # carries, and device-loss dispatch failures — min-residual restart
+    # Resilience (resilience/ subsystem): bounded recovery-ladder
+    # attempts for flag-2/4 breakdowns, NaN/Inf carries, and device-loss
+    # dispatch failures on the chunked dispatch paths (quasi-static
+    # solver/driver.py AND the Newmark stepper) — min-residual restart
     # -> scalar-Jacobi fallback preconditioner -> f64 escalation (mixed
-    # mode), each attempt an obs/metrics `recovery` event.  0 disables
-    # the ladder (the historical report-and-stop behavior).  Healthy
-    # solves never enter it, so the default is on.  CLI: --max-recoveries.
+    # mode), each attempt an obs/metrics `recovery` event.  The same
+    # budget bounds the time-history drivers' NaN/Inf
+    # rollback-to-last-snapshot (solver/dynamics.py, solver/newmark.py).
+    # 0 disables recovery (the historical report-and-stop behavior).
+    # Healthy solves never enter it, so the default is on.
+    # CLI: --max-recoveries.
     max_recoveries: int = 2
     # Device-loss dispatch retries per solve step (resilience dispatch
     # guard): a failed chunked dispatch is retried with backoff from the
@@ -146,15 +148,27 @@ class RunConfig:
     # steps (0 = off).  The reference is resumable only at pipeline-stage
     # granularity (SURVEY.md §5); this adds step granularity.
     checkpoint_every: int = 0
-    # Mid-Krylov snapshots (resilience/): on the quasi-static chunked
-    # dispatch path (not Newmark dynamics),
-    # persist the resumable Krylov carry every N chunk boundaries (0 =
-    # off) into the checkpoint dir via utils/checkpoint.SnapshotStore —
-    # a killed process or lost device then loses at most N chunks, and
-    # `solve(resume=True)` continues MID-SOLVE with bit-identical
-    # history.  Also the restore point the dispatch guard re-dispatches
-    # from after a device-loss exception.  CLI: --snapshot-every.
+    # Resumable snapshots (resilience/), one knob with path-appropriate
+    # granularity (CLI: --snapshot-every):
+    # * quasi-static chunked dispatch path: persist the resumable
+    #   Krylov carry every N CHUNK boundaries (snap_*.npz) — a killed
+    #   process or lost device loses at most N chunks, and
+    #   `solve(resume=True)` continues MID-SOLVE with bit-identical
+    #   history; also the restore point the dispatch guard re-dispatches
+    #   from after a device-loss exception.
+    # * dynamics/Newmark time histories: persist the full kinematic
+    #   state (u, v[, w], histories, probe series, frames) every N
+    #   completed TIMESTEPS (step_*.npz, retention-bounded by
+    #   PCG_TPU_SNAP_KEEP) — `run(..., resume=True)` continues
+    #   MID-TIME-HISTORY, and NaN/Inf rollback restores the last one.
+    # 0 = off.
     snapshot_every: int = 0
+    # Preflight gate (validate/ subsystem): sanity-check the ModelData
+    # and config cross-constraints BEFORE any partition build or XLA
+    # compile.  "" = environment default (PCG_TPU_PREFLIGHT, ultimately
+    # "fail"); explicit "fail" | "warn" | "off" overrides.  CLI:
+    # --preflight and the `validate` subcommand.
+    preflight: str = ""
     # Warm-path cache directory (cache/): when set, partitions are served
     # from a content-addressed on-disk cache, the jitted PCG step is
     # AOT-exported/deserialized (skipping re-tracing), and jax's

@@ -62,6 +62,12 @@ EVENT_KINDS: Dict[str, tuple] = {
     "fault": ("mode", "point", "at"),
     # one mid-Krylov snapshot operation (op = save | restore)
     "snapshot": ("op", "step"),
+    # one timestep-granular snapshot operation of a dynamics/Newmark
+    # time history (op = save | restore; resilience/engine.py)
+    "step_snapshot": ("op", "step"),
+    # one preflight gate run (validate/): the policy applied, the
+    # fail/warn counts, and the full per-check results list
+    "preflight": ("policy", "failed", "checks"),
     # end-of-step ladder summary (emitted only when recoveries happened)
     "recovery_done": ("flag", "attempts", "actions"),
     # end-of-run counter/gauge/span snapshot

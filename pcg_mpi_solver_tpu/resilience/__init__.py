@@ -14,14 +14,24 @@ than report them:
   (:class:`~pcg_mpi_solver_tpu.resilience.recovery.RecoveryLadder`);
 * a retry-with-backoff dispatch guard for XLA/device-loss exceptions
   (:class:`~pcg_mpi_solver_tpu.resilience.recovery.DispatchGuard`);
+* the shared recovery orchestration + timestep-granular time-history
+  harness (:mod:`pcg_mpi_solver_tpu.resilience.engine`:
+  :func:`~pcg_mpi_solver_tpu.resilience.engine.run_with_recovery`,
+  :class:`~pcg_mpi_solver_tpu.resilience.engine.TimeHistoryGuard`) —
+  one copy of the machinery, consumed by the quasi-static driver, the
+  implicit Newmark stepper and the explicit dynamics driver;
 * deterministic fault injection so every path above is exercised in
-  tier-1 on CPU (:mod:`pcg_mpi_solver_tpu.resilience.faultinject`).
+  tier-1 on CPU (:mod:`pcg_mpi_solver_tpu.resilience.faultinject`),
+  including the step domain (``kill@s:N``) for time histories.
 
 Import contract: jax-free at module load (the fault poisoners and the
 state put/fetch closures import jax lazily), matching ``cache/`` and
 ``obs/``.
 """
 
+from pcg_mpi_solver_tpu.resilience.engine import (
+    RecoveryHooks, TimeHistoryGuard, kinematic_state_io,
+    run_with_recovery)
 from pcg_mpi_solver_tpu.resilience.faultinject import (
     FaultPlan, InjectedDispatchError, SimulatedKill)
 from pcg_mpi_solver_tpu.resilience.recovery import (
@@ -33,8 +43,12 @@ __all__ = [
     "InjectedDispatchError",
     "SimulatedKill",
     "DispatchGuard",
+    "RecoveryHooks",
     "RecoveryLadder",
     "ResilienceContext",
+    "TimeHistoryGuard",
     "breakdown_trigger",
     "is_device_loss",
+    "kinematic_state_io",
+    "run_with_recovery",
 ]

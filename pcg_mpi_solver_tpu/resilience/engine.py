@@ -1,0 +1,278 @@
+"""Reusable resilience harness.
+
+PR 3 inlined the recovery orchestration in ``solver/driver.py``; this
+module is that machinery extracted so the quasi-static driver, the
+implicit Newmark stepper and the explicit dynamics driver share ONE copy
+of each behavior:
+
+* :func:`run_with_recovery` — the ladder budget loop around
+  :meth:`ChunkedEngine.run` (breakdown classification, bounded
+  escalation through :class:`RecoveryHooks`, device-loss restarts, the
+  ``recovery_done`` event).  Ex ``driver._step_chunked``.
+* :func:`kinematic_state_io` — sharding-faithful device<->host transfer
+  closures for a named-leaf state dict (the snapshot payloads).
+* :class:`TimeHistoryGuard` — timestep-granular checkpoints for the
+  time-history drivers: snapshot cadence into a
+  ``utils/checkpoint.SnapshotStore`` (``step_*.npz``), kill-and-resume
+  that continues MID-TIME-HISTORY, step-domain fault injection, and
+  NaN/Inf rollback-to-last-checkpoint instead of silently integrating
+  garbage.
+
+Import contract: jax-free at module load, like the rest of
+``resilience/`` (the transfer closures import jax lazily).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from pcg_mpi_solver_tpu.resilience.recovery import (
+    RecoveryLadder, breakdown_trigger, is_device_loss)
+
+
+# ----------------------------------------------------------------------
+# Per-step recovery ladder around a ChunkedEngine
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RecoveryHooks:
+    """Driver-supplied recovery programs for :func:`run_with_recovery`.
+
+    ``restart(x) -> (carry, normr)``: a cold Krylov carry at the ladder's
+    restart iterate (the driver routes the matvec through its shared
+    out-of-loop amul program so the restart costs no extra stencil
+    instantiation).
+
+    ``cold_restart() -> (carry, normr, prec)``: rebuild the step's cold
+    start state after a device loss (the in-flight carry may be gone
+    with the failed dispatch); the returned prec replaces the original
+    when the loop was still using it.
+
+    ``fallback_prec() -> prec``: the weaker-but-safer preconditioner
+    inverse (ladder rung 2, ``ops/precond.fallback_kind``).
+
+    ``escalation() -> (engine, data, prec)``: the f64 escalation engine
+    (ladder rung 3, mixed mode).
+    """
+
+    restart: Callable[[Any], Tuple[Any, Any]]
+    cold_restart: Optional[Callable[[], Tuple[Any, Any, Any]]] = None
+    fallback_prec: Optional[Callable[[], Any]] = None
+    escalation: Optional[Callable[[], Tuple[Any, Any, Any]]] = None
+
+
+def run_with_recovery(engine, data, fext, carry, normr0, n2b, prec, *,
+                      scfg, mixed: bool, recorder, hooks: RecoveryHooks,
+                      resilience=None, total0: int = 0):
+    """Run a chunked solve to termination through the bounded recovery
+    ladder (resilience posture: ISSUE 3 / arXiv:2501.03743).
+
+    When the budget loop terminates on a flag-2/4 breakdown, a NaN/Inf
+    carry, or a device-loss exception, the solve restarts from the
+    engine's tracked min-residual iterate through a bounded escalation —
+    plain restart -> fallback preconditioner -> f64 escalation — instead
+    of reporting the failure and discarding thousands of Krylov
+    iterations.  The total iteration budget (``scfg.max_iter``) spans
+    all attempts.
+
+    Returns ``(engine_used, x_fin, flag, relres, total)`` — the engine
+    that ran the final attempt (its ``last_trace`` holds the ring).
+    """
+    rec = recorder
+    note = rec.note if rec is not None else (lambda s: None)
+    eng, eng_data, eng_prec = engine, data, prec
+    ladder = None
+    total = int(total0)
+    while True:
+        err = None
+        try:
+            x_fin, flag, relres, total = eng.run(
+                eng_data, fext, carry, normr0, n2b, eng_prec,
+                vlog=note, resilience=resilience, total0=total)
+            trigger = breakdown_trigger(flag, relres)
+            restart_x = eng.restart_x
+        except Exception as e:          # noqa: BLE001 — classified below
+            # the engine's guard already retried from the snapshot;
+            # reaching here means the guard budget is spent (or there
+            # was no snapshot to re-dispatch from)
+            if scfg.max_recoveries <= 0 or not is_device_loss(e):
+                raise
+            trigger, restart_x, err = "device_loss", None, e
+        if trigger is None:
+            break
+        if ladder is None:
+            ladder = RecoveryLadder(
+                precond=scfg.precond, mixed=mixed,
+                max_recoveries=scfg.max_recoveries, recorder=rec)
+        action = ladder.next_action(trigger)
+        if action is None:              # recovery budget spent
+            if err is not None:
+                raise err
+            note(f"recovery budget exhausted ({ladder.attempt} "
+                 f"attempts); reporting flag={flag} relres={relres:.3e}")
+            break
+        note(f"recovery attempt {ladder.attempt}/{scfg.max_recoveries}: "
+             f"{action} after {trigger} (total={total})")
+        if action == "fallback_prec" and hooks.fallback_prec is not None:
+            eng_prec = hooks.fallback_prec()
+        elif action == "escalate_f64" and hooks.escalation is not None:
+            eng, eng_data, eng_prec = hooks.escalation()
+        if restart_x is None:
+            # device loss: the in-flight carry may be gone with the
+            # failed dispatch — rebuild the step's cold start state
+            if hooks.cold_restart is None:
+                raise err if err is not None else RuntimeError(
+                    "device_loss recovery without a cold_restart hook")
+            carry, normr0, prec0 = hooks.cold_restart()
+            if eng_prec is prec:
+                eng_prec = prec0
+            prec = prec0
+        else:
+            # min-residual-iterate restart: a cold Krylov carry at the
+            # best iterate seen
+            carry, normr0 = hooks.restart(restart_x)
+    if ladder is not None and ladder.attempt and rec is not None:
+        rec.event("recovery_done", flag=flag, relres=relres,
+                  attempts=ladder.attempt,
+                  actions=list(ladder.actions_taken))
+    return eng, x_fin, flag, relres, total
+
+
+# ----------------------------------------------------------------------
+# Snapshot state transfer
+# ----------------------------------------------------------------------
+
+def kinematic_state_io(mesh, part_spec, dtype, device_keys):
+    """``(fetch, put)`` closures for a flat state dict whose
+    ``device_keys`` leaves are parts-sharded ``(n_parts, n_loc)`` device
+    vectors (the kinematic state) and whose remaining leaves are host
+    numpy (histories, counters, schedules).
+
+    ``fetch`` is collective on multi-host (every process participates in
+    the all-gathers; only the primary later writes); ``put`` restores
+    the device leaves sharding-faithfully and passes host leaves
+    through unchanged."""
+    device_keys = frozenset(device_keys)
+
+    def fetch(state: Dict[str, Any]) -> Dict[str, Any]:
+        from pcg_mpi_solver_tpu.parallel.distributed import fetch_global
+
+        return {k: (fetch_global(v, mesh) if k in device_keys
+                    else np.asarray(v))
+                for k, v in state.items()}
+
+    def put(state: Dict[str, Any]) -> Dict[str, Any]:
+        from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
+
+        return {k: (put_sharded(np.asarray(v, dtype), mesh, part_spec)
+                    if k in device_keys else v)
+                for k, v in state.items()}
+
+    return fetch, put
+
+
+# ----------------------------------------------------------------------
+# Timestep-granular checkpoint / rollback / fault harness
+# ----------------------------------------------------------------------
+
+class TimeHistoryGuard:
+    """Resilience harness for the time-history drivers (explicit
+    ``solver/dynamics.py`` and implicit ``solver/newmark.py``).
+
+    Three hooks, all driven by the host time loop:
+
+    * :meth:`load_resume` — restore the newest persisted step snapshot
+      (``step_*.npz`` under the checkpoint dir) so ``--resume``
+      continues MID-TIME-HISTORY with bit-identical probe/frame/trace
+      history;
+    * :meth:`boundary` — after each completed timestep: snapshot the
+      full kinematic state at cadence (clean state FIRST), then let
+      step-domain faults fire (``kill`` raises after the snapshot, like
+      a real preemption; poisons corrupt the live state the snapshot
+      just protected);
+    * :meth:`rollback` — a NaN/Inf state detected after a step restores
+      the last good snapshot (memory-first, so no disk round-trip)
+      instead of silently integrating garbage; bounded by
+      ``max_recoveries`` like the Krylov ladder.
+    """
+
+    def __init__(self, *, store=None, snapshot_every: int = 0,
+                 fetch_state=None, put_state=None, recorder=None,
+                 faults=None, max_recoveries: int = 0):
+        self.store = store
+        self.snapshot_every = int(snapshot_every)
+        self.fetch_state = fetch_state or (lambda s: s)
+        self.put_state = put_state or (lambda s: s)
+        self.recorder = recorder
+        self.faults = faults
+        self.max_recoveries = int(max_recoveries)
+        self.recoveries = 0
+        self._mem: Optional[Tuple[int, Dict[str, Any]]] = None
+
+    # -- resume ---------------------------------------------------------
+    def load_resume(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """Newest persisted step snapshot as ``(t, device_state)``, or
+        None when there is nothing to resume from.  The restored host
+        copy doubles as the first rollback point."""
+        if self.store is None:
+            return None
+        t = self.store.latest()
+        if t is None:
+            return None
+        state = self.store.load(t)
+        if state is None:
+            return None
+        self._mem = (t, state)
+        if self.recorder is not None:
+            self.recorder.event("step_snapshot", op="restore", step=t)
+            self.recorder.inc("resilience.step_snapshot.restore")
+        return t, self.put_state(state)
+
+    # -- per-step boundary ----------------------------------------------
+    def boundary(self, t: int, state_fn: Callable[[], Dict[str, Any]]) \
+            -> Optional[Dict[str, Any]]:
+        """Completed-timestep hook.  ``state_fn`` builds the full device
+        state dict lazily (with snapshots and faults both idle this
+        costs nothing).  Returns the possibly-poisoned device state the
+        caller must continue with, or None when untouched."""
+        state = None
+        if self.snapshot_every > 0 and t % self.snapshot_every == 0:
+            state = state_fn()
+            host = self.fetch_state(state)
+            self._mem = (t, host)
+            if self.store is not None:
+                self.store.save(t, host)
+                if self.recorder is not None:
+                    self.recorder.event("step_snapshot", op="save",
+                                        step=t)
+                    self.recorder.inc("resilience.step_snapshot.save")
+        if self.faults is not None and self.faults.step_armed:
+            if state is None:
+                state = state_fn()
+            state = self.faults.at_step(t, state)
+        return state
+
+    # -- poison rollback ------------------------------------------------
+    def rollback(self, t: int) -> Tuple[int, Dict[str, Any]]:
+        """Non-finite state detected after timestep ``t``: the state to
+        roll back to as ``(t0, device_state)``.  Consumes one recovery;
+        raises :class:`FloatingPointError` when there is no snapshot or
+        the budget is spent (an honest failure beats looping on a
+        deterministic instability)."""
+        if self._mem is None or self.recoveries >= self.max_recoveries:
+            raise FloatingPointError(
+                f"non-finite state after timestep {t} and no rollback "
+                f"available (snapshot={'yes' if self._mem else 'no'}, "
+                f"recoveries={self.recoveries}/{self.max_recoveries}); "
+                "for explicit dynamics check dt against stable_dt()")
+        self.recoveries += 1
+        t0, host = self._mem
+        if self.recorder is not None:
+            self.recorder.event("recovery", action="rollback",
+                                attempt=self.recoveries,
+                                trigger="nan_carry", step=t, to_step=t0)
+            self.recorder.inc("resilience.recovery.rollback")
+        return t0, self.put_state(host)

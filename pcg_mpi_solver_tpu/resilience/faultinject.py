@@ -10,21 +10,32 @@ A :class:`FaultPlan` is parsed from a spec string (env ``PCG_TPU_FAULTS``
 or passed programmatically, e.g. ``Solver.fault_plan = FaultPlan(...)``):
 
     spec     := term ("," term)*
-    term     := mode "@" index ["*" count]
+    term     := mode "@" ["s:"] index ["*" count]
     mode     := "kill" | "exc" | "nan" | "inf" | "rho0"
-    index    := 0-based position in the mode's counter (see below)
+    index    := 0-based position in the mode's counter (see below);
+                with the "s:" prefix, the ABSOLUTE timestep number of a
+                time-history run instead
     count    := consecutive firings (default 1; "exc@3*2" also fails the
                 first retry of dispatch 3)
 
-Two counters, both monotone over the life of the plan (they keep running
-across recovery restarts, so a second fault can be aimed at a later
-ladder rung):
+Three counter domains.  The first two are monotone over the life of the
+plan (they keep running across recovery restarts, so a second fault can
+be aimed at a later ladder rung):
 
 * the DISPATCH counter advances once per successfully completed Krylov
   dispatch ("exc" fires *before* the dispatch with that index runs);
 * the BOUNDARY counter advances once per chunk boundary — after a direct
   chunk / mixed refinement cycle completes and any due snapshot is taken
-  ("kill" / "nan" / "inf" / "rho0" fire *at* that boundary).
+  ("kill" / "nan" / "inf" / "rho0" fire *at* that boundary);
+* the STEP domain ("s:" prefix — ``kill@s:3``, ``nan@s:5``) is indexed
+  by the absolute completed-timestep number of a dynamics/Newmark time
+  history (:meth:`FaultPlan.at_step`, driven by
+  ``resilience/engine.TimeHistoryGuard``): the fault fires at EXACTLY
+  timestep N, after any due step snapshot — so a rollback/resume that
+  replays past N does not re-fire a consumed fault, while ``*count``
+  deliberately re-fires it to exercise budget exhaustion.  Step-domain
+  modes are ``kill``/``nan``/``inf`` (poison lands on the kinematic
+  state leaf ``u``).
 
 Modes and the recovery path each one exercises:
 
@@ -54,6 +65,7 @@ from typing import Dict, List, Optional
 MODES = ("kill", "exc", "nan", "inf", "rho0")
 _DISPATCH_MODES = ("exc",)
 _BOUNDARY_MODES = ("kill", "nan", "inf", "rho0")
+_STEP_MODES = ("kill", "nan", "inf")
 
 
 class SimulatedKill(BaseException):
@@ -70,9 +82,11 @@ class InjectedDispatchError(RuntimeError):
     UNAVAILABLE from a dropped tunnel or preempted device)."""
 
 
-def _parse(spec: str) -> Dict[str, Dict[int, int]]:
-    """spec string -> {mode: {index: remaining_count}}."""
+def _parse(spec: str):
+    """spec string -> ({mode: {index: count}}, {mode: {step: count}})
+    for the dispatch/boundary domains and the step domain."""
     out: Dict[str, Dict[int, int]] = {}
+    steps: Dict[str, Dict[int, int]] = {}
     for term in (t.strip() for t in spec.split(",")):
         if not term:
             continue
@@ -82,10 +96,12 @@ def _parse(spec: str) -> Dict[str, Dict[int, int]]:
             if "*" in rest:
                 rest, c = rest.split("*", 1)
                 count = int(c)
-            idx = int(rest)
+            rest = rest.strip()
+            step_domain = rest.startswith("s:")
+            idx = int(rest[2:] if step_domain else rest)
         except ValueError:
             raise ValueError(
-                f"bad fault term {term!r} (want mode@index[*count])")
+                f"bad fault term {term!r} (want mode@[s:]index[*count])")
         mode = mode.strip()
         if mode not in MODES:
             raise ValueError(f"unknown fault mode {mode!r} "
@@ -93,8 +109,15 @@ def _parse(spec: str) -> Dict[str, Dict[int, int]]:
         if idx < 0 or count < 1:
             raise ValueError(f"bad fault term {term!r}: index >= 0, "
                              f"count >= 1")
-        out.setdefault(mode, {})[idx] = count
-    return out
+        if step_domain:
+            if mode not in _STEP_MODES:
+                raise ValueError(
+                    f"fault mode {mode!r} has no step-domain trigger "
+                    f"(valid at s: indices: {', '.join(_STEP_MODES)})")
+            steps.setdefault(mode, {})[idx] = count
+        else:
+            out.setdefault(mode, {})[idx] = count
+    return out, steps
 
 
 class FaultPlan:
@@ -106,7 +129,7 @@ class FaultPlan:
     """
 
     def __init__(self, spec: str, recorder=None):
-        self._faults = _parse(spec)
+        self._faults, self._step_faults = _parse(spec)
         self.recorder = recorder
         self.dispatches = 0         # completed Krylov dispatches
         self.boundaries = 0         # completed chunk boundaries
@@ -120,7 +143,20 @@ class FaultPlan:
 
     @property
     def armed(self) -> bool:
-        return any(self._faults.values())
+        return any(self._faults.values()) or self.step_armed
+
+    @property
+    def step_armed(self) -> bool:
+        """Any step-domain fault still pending."""
+        return any(self._step_faults.values())
+
+    def next_step_fault(self, after: int) -> Optional[int]:
+        """Smallest pending step-domain index > ``after``, or None — the
+        time loop splits its device chunks there so the fault's timestep
+        is an actual host boundary."""
+        pending = [i for m in self._step_faults.values() for i in m
+                   if i > after]
+        return min(pending) if pending else None
 
     def _take(self, mode: str, idx: int) -> bool:
         pending = self._faults.get(mode, {})
@@ -176,12 +212,42 @@ class FaultPlan:
                 f"injected kill at chunk boundary {idx} (PCG_TPU_FAULTS)")
         return carry
 
+    def _take_step(self, mode: str, t: int) -> bool:
+        pending = self._step_faults.get(mode, {})
+        if pending.get(t, 0) <= 0:
+            return False
+        pending[t] -= 1
+        if pending[t] <= 0:
+            del pending[t]
+        return True
 
-def _poison(carry: dict, mode: str) -> dict:
+    def at_step(self, t: int, state: dict) -> dict:
+        """Called after completed timestep ``t`` of a time history,
+        AFTER any due step snapshot (the snapshot must hold the clean
+        state — corruption happens to the live run, as it would on real
+        hardware).  Poison lands on the kinematic leaf ``u``; ``kill``
+        raises :class:`SimulatedKill` last, so a poison+kill at the same
+        step persists the poison-free snapshot first.  Indexed by the
+        ABSOLUTE timestep number: a rollback or resume that replays past
+        ``t`` does not re-fire a consumed fault."""
+        for mode in ("nan", "inf"):
+            if "u" in state and self._take_step(mode, t):
+                self._fire(mode, "step", t)
+                state = _poison(state, mode, leaf="u")
+        if self._take_step("kill", t):
+            self._fire("kill", "step", t)
+            raise SimulatedKill(
+                f"injected kill at timestep {t} (PCG_TPU_FAULTS)")
+        return state
+
+
+def _poison(carry: dict, mode: str, leaf: str = "r") -> dict:
     """Corrupt a device-resident carry dict (new leaves, never in-place:
     the donated-carry contract means the input dict's leaves may be the
     fresh outputs of the previous dispatch — poisoning builds replacement
-    arrays and leaves the originals to the garbage collector)."""
+    arrays and leaves the originals to the garbage collector).  ``leaf``
+    is the poison target: the Krylov residual ``r`` at chunk boundaries,
+    the kinematic state ``u`` at timestep boundaries."""
     import jax.numpy as jnp
 
     out = dict(carry)
@@ -189,14 +255,15 @@ def _poison(carry: dict, mode: str) -> dict:
         if "rho" in out:
             out["rho"] = jnp.zeros_like(out["rho"])
         return out
-    r = out.get("r")
+    r = out.get(leaf)
     if r is None:
         return out
     if mode == "nan":
-        out["r"] = r * jnp.asarray(float("nan"), r.dtype)
+        out[leaf] = r * jnp.asarray(float("nan"), r.dtype)
     elif mode == "inf":
         # only the nonzero entries: constrained dofs stay exactly 0, so
         # the Inf lands where the preconditioner inverse is > 0 and the
         # next apply_prec trips the flag-2 Inf-preconditioner exit
-        out["r"] = jnp.where(r != 0, jnp.asarray(float("inf"), r.dtype), r)
+        out[leaf] = jnp.where(r != 0, jnp.asarray(float("inf"), r.dtype),
+                              r)
     return out

@@ -135,6 +135,18 @@ class Solver:
                 jsonl_path=self.config.telemetry_path or None,
                 profile=True if self.config.telemetry_profile else None))
         self._rec = self.recorder
+        # ---- preflight gate (validate/): reject a pathological model or
+        # config BEFORE any partition build or XLA compile is paid (the
+        # flagship pays minutes of both).  Policy: config.preflight >
+        # PCG_TPU_PREFLIGHT > fail.
+        from pcg_mpi_solver_tpu.validate import run_preflight
+
+        # No n_steps in the context: on this path snapshot_every counts
+        # CHUNK boundaries, not time steps, so the cadence-vs-schedule
+        # cross-check does not apply (it would false-warn on every
+        # protected multi-chunk solve).
+        run_preflight(model, self.config, recorder=self._rec,
+                      context={"kind": "quasi_static"})
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
         if n_parts is None:
@@ -805,16 +817,14 @@ class Solver:
         for-iteration identical to one long solve, and chunk boundaries
         align with refinement cycles in mixed mode.
 
-        This is also where the recovery ladder lives (resilience/): when
-        the budget loop terminates on a flag-2/4 breakdown, a NaN/Inf
-        carry, or a device-loss exception, the solve restarts from the
-        engine's tracked min-residual iterate through a bounded
-        escalation — plain restart -> scalar-Jacobi fallback
-        preconditioner -> f64 escalation (mixed) — instead of reporting
-        the failure and discarding thousands of Krylov iterations.  The
-        total iteration budget (``max_iter``) spans all attempts."""
-        from pcg_mpi_solver_tpu.resilience.recovery import (
-            RecoveryLadder, breakdown_trigger, is_device_loss)
+        The recovery orchestration (breakdown ladder, device-loss
+        restart) is the shared :func:`resilience.engine.run_with_recovery`
+        harness — this method supplies the recovery PROGRAMS (restart
+        carry through the shared out-of-loop amul, fallback
+        preconditioner, f64 escalation engine, cold-start rebuild) as
+        :class:`~pcg_mpi_solver_tpu.resilience.engine.RecoveryHooks`."""
+        from pcg_mpi_solver_tpu.resilience.engine import (
+            RecoveryHooks, run_with_recovery)
 
         rec = self._rec
         scfg = self.config.solver
@@ -835,68 +845,34 @@ class Solver:
             self.last_trace = empty_trace() if self.trace_len else None
             return 0, 0.0, 0
         ctx = self._make_resilience()
-        engine, eng_data, eng_prec = self._engine, self.data, prec
-        ladder = None
-        total = 0
-        while True:
-            err = None
-            try:
-                x_fin, flag, relres, total = engine.run(
-                    eng_data, fext, carry, normr0, n2b, eng_prec,
-                    vlog=rec.note, resilience=ctx, total0=total)
-                trigger = breakdown_trigger(flag, relres)
-                restart_x = engine.restart_x
-            except Exception as e:          # noqa: BLE001 — classified below
-                # the engine's guard already retried from the snapshot;
-                # reaching here means the guard budget is spent (or there
-                # was no snapshot to re-dispatch from)
-                if scfg.max_recoveries <= 0 or not is_device_loss(e):
-                    raise
-                trigger, restart_x, err = "device_loss", None, e
-            if trigger is None:
-                break
-            if ladder is None:
-                ladder = RecoveryLadder(
-                    precond=scfg.precond, mixed=self.mixed,
-                    max_recoveries=scfg.max_recoveries, recorder=rec)
-            action = ladder.next_action(trigger)
-            if action is None:              # recovery budget spent
-                if err is not None:
-                    raise err
-                rec.note(f"recovery budget exhausted "
-                         f"({ladder.attempt} attempts); reporting "
-                         f"flag={flag} relres={relres:.3e}")
-                break
-            rec.note(f"recovery attempt {ladder.attempt}/"
-                     f"{scfg.max_recoveries}: {action} after {trigger} "
-                     f"(total={total})")
-            if action == "fallback_prec":
-                eng_prec = self._fallback_prec()
-            elif action == "escalate_f64":
-                engine, eng_data, eng_prec = self._escalation()
-            if restart_x is None:
-                # device loss: the in-flight carry may be gone with the
-                # failed dispatch — rebuild the step's cold start state
-                # (fext/x0/kx0 are intact: the start programs never
-                # donate their operands)
-                with rec.dispatch("start"):
-                    carry, normr0, _n2b, prec0 = self._start_post_fn(
-                        self.data, fext, x0, kx0)
-                if eng_prec is prec:
-                    eng_prec = prec = prec0
-            else:
-                # min-residual-iterate restart: a cold Krylov carry at the
-                # best iterate seen, through the SHARED out-of-loop amul
-                # program (no extra stencil instantiation)
-                with rec.dispatch("restart"):
-                    kx = self._amul64_fn(self.data, restart_x)
-                    carry, normr0 = self._restart_post()(
-                        self.data, fext, restart_x, kx)
-                    jax.block_until_ready(normr0)
-        if ladder is not None and ladder.attempt:
-            rec.event("recovery_done", flag=flag, relres=relres,
-                      attempts=ladder.attempt,
-                      actions=list(ladder.actions_taken))
+
+        def _restart(x):
+            # min-residual-iterate restart: a cold Krylov carry at the
+            # best iterate seen, through the SHARED out-of-loop amul
+            # program (no extra stencil instantiation)
+            with rec.dispatch("restart"):
+                kx = self._amul64_fn(self.data, x)
+                c, nr = self._restart_post()(self.data, fext, x, kx)
+                jax.block_until_ready(nr)
+            return c, nr
+
+        def _cold_restart():
+            # device loss: rebuild the step's cold start state (fext/x0/
+            # kx0 are intact: the start programs never donate their
+            # operands)
+            with rec.dispatch("start"):
+                c, nr, _n2b, prec0 = self._start_post_fn(
+                    self.data, fext, x0, kx0)
+            return c, nr, prec0
+
+        engine, x_fin, flag, relres, total = run_with_recovery(
+            self._engine, self.data, fext, carry, normr0, n2b, prec,
+            scfg=scfg, mixed=self.mixed, recorder=rec,
+            hooks=RecoveryHooks(restart=_restart,
+                                cold_restart=_cold_restart,
+                                fallback_prec=self._fallback_prec,
+                                escalation=self._escalation),
+            resilience=ctx)
         if self.trace_len:
             tr = engine.last_trace
             self.last_trace = (unpack_trace(tr) if tr is not None

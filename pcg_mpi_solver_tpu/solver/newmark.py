@@ -32,6 +32,8 @@ mixed-precision refinement path work unchanged.
 from __future__ import annotations
 
 import dataclasses
+import math
+import time
 from typing import List, Optional, Sequence
 
 import jax
@@ -40,8 +42,13 @@ import numpy as np
 
 from pcg_mpi_solver_tpu.config import RunConfig
 from pcg_mpi_solver_tpu.models.model_data import ModelData
+from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
+from pcg_mpi_solver_tpu.obs.trace import (
+    ConvergenceTrace, clamp_trace_len, empty_trace, trace_init,
+    unpack_trace)
 from pcg_mpi_solver_tpu.ops.matvec import Ops
 from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
+from pcg_mpi_solver_tpu.resilience.faultinject import FaultPlan
 from pcg_mpi_solver_tpu.solver.driver import StepResult, _data_specs
 from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_mixed
 
@@ -116,14 +123,29 @@ class NewmarkSolver:
         gamma: float = 0.5,
         damping: float = 0.0,          # c_m: C = c_m * M
         backend: str = "auto",         # "auto" | "hybrid" | "general"
+        recorder: Optional[MetricsRecorder] = None,
     ):
         self.config = config or RunConfig()
         scfg = self.config.solver
+        # Telemetry: same default wiring as the quasi-static driver
+        # (stderr sink iff PCG_TPU_VERBOSE=1, JSONL sink iff
+        # config.telemetry_path is set).
+        self.recorder = recorder if recorder is not None else (
+            MetricsRecorder.default(
+                jsonl_path=self.config.telemetry_path or None,
+                profile=True if self.config.telemetry_profile else None))
+        self._rec = self.recorder
         from pcg_mpi_solver_tpu.ops.precond import VALID_PRECONDS
 
         if scfg.precond not in VALID_PRECONDS:
             raise ValueError(f"SolverConfig.precond must be one of "
                              f"{VALID_PRECONDS}, got {scfg.precond!r}")
+        # Preflight gate (validate/): reject a pathological model/config
+        # before the partition build below is paid.
+        from pcg_mpi_solver_tpu.validate import run_preflight
+
+        run_preflight(model, self.config, recorder=self._rec,
+                      context={"kind": "newmark"})
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
         n_parts = n_parts or max(self.config.n_parts, n_dev)
@@ -279,6 +301,14 @@ class NewmarkSolver:
             in_specs=(self._specs, P_, P_, P_, P_, R_),
             out_specs=(P_, P_, P_, R_, R_, R_), check_vma=False))
 
+        # In-graph convergence trace (obs/trace.py), chunked path only:
+        # the one-shot step program keeps its pre-telemetry shape.
+        self.trace_len = (clamp_trace_len(scfg.trace_resid, scfg.max_iter)
+                          if scfg.trace_resid > 0 else 0)
+        self._trace_dtype = (jnp.float32 if self.mixed
+                             else jnp.dtype(scfg.dot_dtype))
+        self.last_trace: Optional[ConvergenceTrace] = None
+
         # ---- dispatch-chunked step path (large problems) ------------------
         # Same machinery as the quasi-static driver (solver/chunked.py):
         # the Newmark start step swaps Dirichlet lifting for the history
@@ -289,11 +319,16 @@ class NewmarkSolver:
         self._dispatch_cap = auto_dispatch_cap(
             scfg, self.pm.glob_n_dof,
             self.pm.n_loc * (self.pm.n_parts // n_dev))
+        # donation-safe here too: the carry is built fresh by
+        # _start_ch_fn each step and never read after run()
+        self._donate = bool(getattr(scfg, "donate_carry", False))
         if self._dispatch_cap > 0:
             from pcg_mpi_solver_tpu.solver.pcg import (
                 carry_part_specs, cold_carry)
 
-            carry_specs = carry_part_specs(P_, R_)
+            trace_direct = self.trace_len > 0 and not self.mixed
+            carry_specs = carry_part_specs(P_, R_, trace=trace_direct)
+            trace_len, trace_dtype = self.trace_len, self._trace_dtype
 
             def _start_ch(data, u, v, w, delta_next):
                 data64 = data["f64"] if self.mixed else data
@@ -304,7 +339,10 @@ class NewmarkSolver:
                 r0 = fext - eff * self.ops.matvec(data64, x0)
                 n2b = jnp.sqrt(self.ops.wdot(wts, fext, fext))
                 normr0 = jnp.sqrt(self.ops.wdot(wts, r0, r0))
-                carry0 = cold_carry(x0, r0, normr0, self.ops.dot_dtype)
+                carry0 = cold_carry(
+                    x0, r0, normr0, self.ops.dot_dtype,
+                    trace=(trace_init(trace_len, trace_dtype)
+                           if trace_direct else None))
                 return udi, fext, carry0, normr0, n2b
 
             self._start_ch_fn = jax.jit(jax.shard_map(
@@ -327,9 +365,8 @@ class NewmarkSolver:
                 glob_n_dof_eff=glob_n_eff, cap=self._dispatch_cap,
                 mixed=self.mixed,
                 ops32=self.ops32 if self.mixed else None,
-                # donation-safe here too: the carry is built fresh by
-                # _start_ch_fn each step and never read after run()
-                donate=bool(getattr(scfg, "donate_carry", False)))
+                trace_len=self.trace_len, recorder=self._rec,
+                donate=self._donate)
 
         # A = K + c*M is CONSTANT over the run (unlike the quasi-static
         # driver, whose per-step Jacobi rebuild is reference parity):
@@ -361,26 +398,227 @@ class NewmarkSolver:
             in_specs=(self._specs, P_, P_, R_), out_specs=P_,
             check_vma=False))
 
+        # ---- resilience (resilience/): per-step recovery ladder on the
+        # chunked path + timestep-granular snapshots/rollback in run().
+        # `fault_plan` is settable (tests inject programmatically;
+        # PCG_TPU_FAULTS drives chaos runs — incl. the step domain
+        # `kill@s:N`).
+        self.fault_plan = FaultPlan.from_env(recorder=self._rec)
+        self._amulA_fn = None           # lazy: shifted-operator amul
+        self._restart_post_fn = None    # lazy: ladder restart program
+        self._fallback_prec_fn = None   # lazy: scalar-Jacobi fallback
+        self._esc_engine = None         # lazy: f64 escalation engine
+        self._esc_prec_fn = None
+        self._finite_fn = jax.jit(lambda a: jnp.isfinite(a).all())
+        self._model = model             # checkpoint fingerprint content
+
         self.flags: List[int] = []
         self.relres: List[float] = []
         self.iters: List[int] = []
 
+    # ------------------------------------------------------------------
+    # Resilience (resilience/): recovery programs + step harness
+    # ------------------------------------------------------------------
+    def _build_restart(self):
+        """Lazily-built ladder restart programs on the SHIFTED operator:
+        one amul program ``(data, v) -> eff * A.v`` shared by every
+        restart, plus ``(data, fext, x, kx) -> (cold carry at x, ||r||)``
+        — compiled only if a recovery ever fires (mirrors
+        driver._restart_post)."""
+        if self._restart_post_fn is not None:
+            return
+        from pcg_mpi_solver_tpu.solver.pcg import carry_part_specs, cold_carry
+
+        mixed = self.mixed
+        trace_direct = self.trace_len > 0 and not mixed
+        P, R = self._part_spec, self._rep_spec
+        carry_specs = carry_part_specs(P, R, trace=trace_direct)
+        trace_len, trace_dtype = self.trace_len, self._trace_dtype
+
+        def _amulA(data, v):
+            d = data["f64"] if mixed else data
+            return d["eff"] * self.ops.matvec(d, v)
+
+        self._amulA_fn = jax.jit(jax.shard_map(
+            _amulA, mesh=self.mesh, in_specs=(self._specs, P),
+            out_specs=P, check_vma=False))
+
+        def _restart(data, fext, x, kx):
+            d = data["f64"] if mixed else data
+            w = d["weight"] * d["eff"]
+            r = fext - kx
+            normr = jnp.sqrt(self.ops.wdot(w, r, r))
+            tr = (trace_init(trace_len, trace_dtype)
+                  if trace_direct else None)
+            return cold_carry(x, r, normr, self.ops.dot_dtype,
+                              trace=tr), normr
+
+        self._restart_post_fn = jax.jit(jax.shard_map(
+            _restart, mesh=self.mesh, in_specs=(self._specs, P, P, P),
+            out_specs=(carry_specs, R), check_vma=False))
+
+    def _fallback_prec(self):
+        """Scalar-Jacobi fallback inverse on the shifted operator
+        (ladder rung 2; the mass shift rides ops.diag, so the fallback
+        is still a preconditioner of A, not of K)."""
+        from pcg_mpi_solver_tpu.ops.precond import make_prec
+
+        if self._fallback_prec_fn is None:
+            mixed = self.mixed
+
+            def _fb(data):
+                if mixed:
+                    return make_prec(self.ops32, data["f32"], "jacobi")
+                return make_prec(self.ops, data, "jacobi")
+
+            self._fallback_prec_fn = jax.jit(jax.shard_map(
+                _fb, mesh=self.mesh, in_specs=(self._specs,),
+                out_specs=self._part_spec, check_vma=False))
+        with self._rec.dispatch("fallback_prec"):
+            prec = self._fallback_prec_fn(self.data)
+            jax.block_until_ready(prec)
+        return prec
+
+    def _escalation(self):
+        """f64 escalation (ladder rung 3, mixed mode): finish the step
+        with direct f64 Krylov cycles on the shifted f64 ops/data — a
+        second ChunkedEngine built lazily, exactly like the quasi-static
+        driver's."""
+        from pcg_mpi_solver_tpu.ops.precond import make_prec
+        from pcg_mpi_solver_tpu.solver.chunked import ChunkedEngine
+
+        if self._esc_engine is None:
+            specs64 = self._specs["f64"]
+            self._esc_engine = ChunkedEngine(
+                mesh=self.mesh, data_specs=specs64,
+                part_spec=self._part_spec, rep_spec=self._rep_spec,
+                ops=self.ops, scfg=self.config.solver,
+                glob_n_dof_eff=self.pm.glob_n_dof_eff,
+                cap=self._dispatch_cap, mixed=False, trace_len=0,
+                recorder=self._rec, donate=self._donate)
+
+            def _p64(data):
+                return make_prec(self.ops, data, "jacobi")
+
+            self._esc_prec_fn = jax.jit(jax.shard_map(
+                _p64, mesh=self.mesh, in_specs=(specs64,),
+                out_specs=self._part_spec, check_vma=False))
+        with self._rec.dispatch("esc_prec"):
+            prec = self._esc_prec_fn(self.data["f64"])
+            jax.block_until_ready(prec)
+        return self._esc_engine, self.data["f64"], prec
+
+    def _make_resilience(self):
+        """Chunk-level resilience context for one step's budget loop
+        (fault hooks + dispatch guard), or None when idle.  Timestep-
+        granular snapshots live one level up (the TimeHistoryGuard in
+        :meth:`run`); mid-Krylov snapshot cadence stays a quasi-static-
+        path feature."""
+        scfg = self.config.solver
+        plan = self.fault_plan
+        if scfg.max_recoveries <= 0 and plan is None:
+            return None
+        from pcg_mpi_solver_tpu.resilience.recovery import (
+            DispatchGuard, ResilienceContext)
+
+        return ResilienceContext(
+            step=len(self.flags) + 1,
+            guard=DispatchGuard(retries=scfg.dispatch_retries,
+                                recorder=self._rec),
+            faults=plan, recorder=self._rec,
+            ladder_armed=scfg.max_recoveries > 0)
+
+    def _make_guard(self, resume: bool):
+        """Timestep-granular resilience harness for :meth:`run`
+        (resilience/engine.TimeHistoryGuard): step snapshots at
+        ``config.snapshot_every`` completed steps, step-domain fault
+        triggers, NaN/Inf rollback bounded by ``max_recoveries``."""
+        every = int(getattr(self.config, "snapshot_every", 0))
+        plan = self.fault_plan
+        if every <= 0 and plan is None and not resume:
+            return None
+        from pcg_mpi_solver_tpu.resilience.engine import (
+            TimeHistoryGuard, kinematic_state_io)
+
+        store = None
+        if every > 0 or resume:
+            from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+            store = SnapshotStore.for_time_solver(self)
+        fetch, put = kinematic_state_io(self.mesh, self._part_spec,
+                                        self.dtype, ("u", "v", "w"))
+        return TimeHistoryGuard(
+            store=store, snapshot_every=every, fetch_state=fetch,
+            put_state=put, recorder=self._rec, faults=plan,
+            max_recoveries=int(self.config.solver.max_recoveries))
+
+    def _history_state(self, t: int, deltas) -> dict:
+        """Full resumable state after completed step ``t``: kinematic
+        vectors (device) + solve histories + the schedule prefix guard."""
+        return {"u": self.u, "v": self.v, "w": self.w,
+                "t": np.int64(t),
+                "flags": np.asarray(self.flags, np.int64),
+                "relres": np.asarray(self.relres, np.float64),
+                "iters": np.asarray(self.iters, np.int64),
+                "deltas": np.asarray(deltas, np.float64)}
+
     def _step_chunked(self, delta_next):
+        """Chunked step through the shared recovery harness
+        (resilience/engine.run_with_recovery): flag-2/4 breakdowns,
+        NaN/Inf carries and device-loss dispatch failures restart from
+        the min-residual iterate through the bounded ladder — restart ->
+        scalar-Jacobi fallback prec -> f64 escalation (mixed)."""
+        from pcg_mpi_solver_tpu.resilience.engine import (
+            RecoveryHooks, run_with_recovery)
+
+        rec = self._rec
         d = jnp.asarray(delta_next, self.dtype)
-        udi, fext, carry, normr0, n2b = self._start_ch_fn(
-            self.data, self.u, self.v, self.w, d)
-        if float(n2b) == 0.0:
-            x_fin, flag, relres, total = jnp.zeros_like(carry["x"]), 0, 0.0, 0
+        with rec.dispatch("start"):
+            udi, fext, carry, normr0, n2b = self._start_ch_fn(
+                self.data, self.u, self.v, self.w, d)
+            n2b_f = float(n2b)
+        if n2b_f == 0.0:
+            x_fin, flag, relres, total = (jnp.zeros_like(carry["x"]),
+                                          0, 0.0, 0)
+            if self.trace_len:
+                self.last_trace = empty_trace()
         else:
-            x_fin, flag, relres, total = self._engine.run(
-                self.data, fext, carry, normr0, n2b, self._prec)
+            def _restart(x):
+                self._build_restart()
+                with rec.dispatch("restart"):
+                    kx = self._amulA_fn(self.data, x)
+                    c, nr = self._restart_post_fn(self.data, fext, x, kx)
+                    jax.block_until_ready(nr)
+                return c, nr
+
+            def _cold_restart():
+                # device loss: rebuild the step's cold start state (the
+                # kinematic vectors are intact — the start program never
+                # donates them); the constant prec is always live
+                with rec.dispatch("start"):
+                    _u2, _f2, c, nr, _n = self._start_ch_fn(
+                        self.data, self.u, self.v, self.w, d)
+                    jax.block_until_ready(nr)
+                return c, nr, self._prec
+
+            engine, x_fin, flag, relres, total = run_with_recovery(
+                self._engine, self.data, fext, carry, normr0, n2b,
+                self._prec,
+                scfg=self.config.solver, mixed=self.mixed, recorder=rec,
+                hooks=RecoveryHooks(restart=_restart,
+                                    cold_restart=_cold_restart,
+                                    fallback_prec=self._fallback_prec,
+                                    escalation=self._escalation),
+                resilience=self._make_resilience())
+            if self.trace_len:
+                tr = engine.last_trace
+                self.last_trace = (unpack_trace(tr) if tr is not None
+                                   else empty_trace())
         self.u, self.v, self.w = self._finish_ch_fn(
             self.data, x_fin, udi, self.u, self.v, self.w, d)
         return flag, relres, total
 
     def step(self, delta_next: float) -> StepResult:
-        import time
-
         t0 = time.perf_counter()
         if self._dispatch_cap > 0:
             flag, relres, iters = self._step_chunked(delta_next)
@@ -389,24 +627,89 @@ class NewmarkSolver:
                 self.data, self._prec, self.u, self.v, self.w,
                 jnp.asarray(delta_next, self.dtype))
             self.u, self.v, self.w = u, v, w
-        res = StepResult(int(flag), float(relres), int(iters),
-                         time.perf_counter() - t0)
+        wall = time.perf_counter() - t0
+        res = StepResult(int(flag), float(relres), int(iters), wall)
         self.flags.append(res.flag)
         self.relres.append(res.relres)
         self.iters.append(res.iters)
+        step_i = len(self.flags)
+        self._rec.event("step", step=step_i, flag=res.flag,
+                        relres=res.relres, iters=res.iters,
+                        wall_s=round(wall, 6))
+        if self.trace_len and self.last_trace is not None:
+            self._rec.event("resid_trace",
+                            **self.last_trace.to_event_fields(step_i))
         return res
 
     def run(self, load_factor: Sequence[float],
-            init_accel_delta: Optional[float] = None) -> List[StepResult]:
+            init_accel_delta: Optional[float] = None,
+            resume: bool = False) -> List[StepResult]:
         """Integrate one step per load factor (load_factor[t] scales F, Ud
         and Vd at t_{t+1}, like the quasi-static schedule).  With
         ``init_accel_delta`` set, w is (re)initialized consistently from
         the CURRENT state, w = M^-1 (F*delta - K u - C v) — standard when
-        F(t_0) != 0, and also correct for continuing a run."""
-        if init_accel_delta is not None:
+        F(t_0) != 0, and also correct for continuing a run.
+
+        Resilience (resilience/engine.TimeHistoryGuard): with
+        ``config.snapshot_every > 0`` the full kinematic state
+        ``(u, v, w, histories)`` is checkpointed every N completed steps
+        (``step_*.npz``, retention-bounded by ``PCG_TPU_SNAP_KEEP``);
+        ``resume=True`` restores the newest one and continues
+        MID-TIME-HISTORY with bit-identical histories.  A non-finite
+        state after a step rolls back to the last snapshot (bounded by
+        ``config.solver.max_recoveries``) instead of integrating
+        garbage.  Returns results for the steps run in THIS call."""
+        deltas = [float(d) for d in load_factor]
+        guard = self._make_guard(resume)
+        t = 0
+        if resume and guard is not None:
+            got = guard.load_resume()
+            if got is not None:
+                t0, st = got
+                saved = np.asarray(st["deltas"])
+                if not np.array_equal(saved[:t0],
+                                      np.asarray(deltas)[:t0]):
+                    raise ValueError(
+                        "resume schedule mismatch: the snapshot was "
+                        "written under a different load_factor prefix")
+                self.u, self.v, self.w = st["u"], st["v"], st["w"]
+                self.flags = [int(x) for x in np.asarray(st["flags"])]
+                self.relres = [float(x) for x in np.asarray(st["relres"])]
+                self.iters = [int(x) for x in np.asarray(st["iters"])]
+                t = int(t0)
+        if init_accel_delta is not None and t == 0:
             self.w = self._init_fn(self.data, self.u, self.v,
                                    jnp.asarray(init_accel_delta, self.dtype))
-        return [self.step(d) for d in load_factor]
+        t_start = t
+        results: List[StepResult] = []
+        while t < len(deltas):
+            res = self.step(deltas[t])
+            t += 1
+            results.append(res)
+            finite = (math.isfinite(res.relres)
+                      and bool(self._finite_fn(self.u)))
+            if not finite:
+                if guard is None:
+                    raise FloatingPointError(
+                        f"non-finite state after Newmark step {t} and no "
+                        "snapshot to roll back to (set snapshot_every)")
+                t0, st = guard.rollback(t)
+                self.u, self.v, self.w = st["u"], st["v"], st["w"]
+                self.flags = self.flags[:t0]
+                self.relres = self.relres[:t0]
+                self.iters = self.iters[:t0]
+                del results[max(t0 - t_start, 0):]
+                t = t0
+                continue
+            if guard is not None:
+                st = guard.boundary(
+                    t, lambda: self._history_state(t, deltas))
+                if st is not None:
+                    self.u, self.v, self.w = st["u"], st["v"], st["w"]
+        # End-of-run counter/gauge snapshot, like the quasi-static
+        # driver's solve() and the explicit dynamics run().
+        self._rec.emit_run_summary()
+        return results
 
     def displacement_global(self) -> np.ndarray:
         from pcg_mpi_solver_tpu.parallel.distributed import gather_owned_global

@@ -20,9 +20,15 @@ Two record granularities share the directory and the fingerprint guard:
   carry of the chunked budget loop, persisted every N chunks so a killed
   process or lost device loses at most one snapshot interval and
   ``--resume`` continues mid-solve with bit-identical history.
+* ``step_{t:06d}.npz`` — full kinematic state after COMPLETED timestep
+  ``t`` of a dynamics/Newmark time history (:class:`SnapshotStore` with
+  ``prefix="step"``, driven by ``resilience/engine.TimeHistoryGuard``):
+  kill-and-resume continues MID-TIME-HISTORY with bit-identical
+  probe/frame history, and on-disk retention is bounded to the newest K
+  files (``PCG_TPU_SNAP_KEEP``).
 
-A fingerprint of the model and solver configuration guards both against
-resuming with mismatched state.
+A fingerprint of the model and solver configuration guards all of them
+against resuming with mismatched state.
 """
 
 from __future__ import annotations
@@ -337,21 +343,89 @@ class SnapshotStore:
     resumable state — direct-mode carry or mixed-mode outer-cycle
     state) flattened with ``/``-joined keys.
 
-    The record is a mid-STEP artifact: the owning step deletes it on
-    completion (:meth:`discard`), so a later resume can never replay a
-    snapshot past the state it belongs to.
+    The record is a mid-STEP artifact on the quasi-static path: the
+    owning step deletes it on completion (:meth:`discard`), so a later
+    resume can never replay a snapshot past the state it belongs to.
+    The time-history drivers (dynamics/Newmark) reuse the store with
+    ``prefix="step"`` for their timestep-granular checkpoints
+    (``step_*.npz``), where records deliberately outlive their step —
+    they are the resume points — and on-disk retention is bounded
+    instead: after each successful write only the newest K files of the
+    store's prefix are kept (``PCG_TPU_SNAP_KEEP``, default 2), so a
+    week-long time history cannot fill the disk.
     """
 
-    def __init__(self, path: str, fingerprint: Optional[dict] = None):
+    def __init__(self, path: str, fingerprint: Optional[dict] = None,
+                 prefix: str = "snap"):
         self.path = path
         self.fingerprint = fingerprint
+        self.prefix = prefix
 
     @classmethod
     def for_solver(cls, solver) -> "SnapshotStore":
         return cls(solver.config.checkpoint_path, _fingerprint(solver))
 
+    @classmethod
+    def for_time_solver(cls, solver) -> "SnapshotStore":
+        """Timestep-granular store for the dynamics/Newmark drivers:
+        same fingerprint guard, distinct ``step_*.npz`` namespace so a
+        quasi-static mid-Krylov snapshot in the same checkpoint dir can
+        never be mistaken for a completed-timestep state."""
+        return cls(solver.config.checkpoint_path, _fingerprint(solver),
+                   prefix="step")
+
     def _file(self, t: int) -> str:
-        return os.path.join(self.path, f"snap_{t:06d}.npz")
+        return os.path.join(self.path, f"{self.prefix}_{t:06d}.npz")
+
+    @staticmethod
+    def retention() -> int:
+        """On-disk retention bound: keep the newest K files per prefix
+        (``PCG_TPU_SNAP_KEEP``, default 2 — the newest plus one spare in
+        case the newest write raced a kill).  A malformed value must not
+        disable the bound it configures."""
+        raw = os.environ.get("PCG_TPU_SNAP_KEEP", "").strip()
+        if not raw:
+            return 2
+        try:
+            k = int(raw)
+        except ValueError:
+            warnings.warn(f"PCG_TPU_SNAP_KEEP={raw!r} is not an integer; "
+                          "keeping the default 2 snapshots")
+            return 2
+        return max(k, 1)
+
+    def _prune(self) -> None:
+        """Drop all but the newest K snapshots of this prefix.  Runs
+        only after a successful atomic publish, so the newest file is
+        always a complete record; zero-padded names sort by step."""
+        files = sorted(_glob.glob(
+            os.path.join(self.path, f"{self.prefix}_*.npz")))
+        for p in files[:-self.retention()]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass        # a racing reader/cleaner already has it
+
+    def latest(self) -> Optional[int]:
+        """Newest restorable step index of this prefix, or None.  A
+        corrupt/truncated newest file costs one retention slot, not the
+        resume (same posture as CheckpointManager.latest_step)."""
+        steps = []
+        for p in _glob.glob(os.path.join(self.path,
+                                         f"{self.prefix}_*.npz")):
+            stem = os.path.basename(p)[len(self.prefix) + 1:-4]
+            try:
+                steps.append(int(stem))
+            except ValueError:
+                continue
+        for t in sorted(steps, reverse=True):
+            try:
+                with np.load(self._file(t)) as z:
+                    if "__t" in z.files:
+                        return t
+            except Exception:                           # noqa: BLE001
+                continue        # corrupt reads as absent; older file next
+        return None
 
     def save(self, t: int, state: Dict[str, Any]) -> str:
         """Persist the (host numpy) state pytree for in-flight step
@@ -369,6 +443,7 @@ class SnapshotStore:
             json.dumps(self.fingerprint or {}, sort_keys=True).encode(),
             dtype=np.uint8).copy()
         write_atomic(out, lambda f: np.savez_compressed(f, **flat))
+        self._prune()
         return out
 
     def load(self, t: int) -> Optional[Dict[str, Any]]:

@@ -1,0 +1,27 @@
+"""Model/config preflight subsystem: reject pathological inputs BEFORE
+any partition build or XLA compile is paid.
+
+The flagship workloads burn minutes of partitioning and 100s-of-seconds
+XLA compiles per solver construction; a ``ModelData`` with NaN loads, a
+zero-volume element, or no Dirichlet constraint at all would happily
+consume all of it and then fail (or worse, converge to garbage) deep in
+the solve.  ``run_preflight`` is wired into ``Solver.__init__``, both
+dynamics drivers, ``cli.py`` (the ``validate`` subcommand and
+``--preflight=``) and ``bench.py``; the policy is
+``PCG_TPU_PREFLIGHT=fail|warn|off`` (default ``fail``).
+
+Import contract: jax-free at module load (numpy only), matching
+``obs/`` and ``resilience/``.
+"""
+
+from pcg_mpi_solver_tpu.validate.preflight import (
+    CheckResult, PreflightError, preflight_checks, resolve_policy,
+    run_preflight)
+
+__all__ = [
+    "CheckResult",
+    "PreflightError",
+    "preflight_checks",
+    "resolve_policy",
+    "run_preflight",
+]

@@ -1,0 +1,339 @@
+"""Time-history resilience (ISSUE 4): the recovery stack wired into the
+dynamics/Newmark drivers — timestep-granular snapshots
+(resilience/engine.TimeHistoryGuard + utils/checkpoint.SnapshotStore
+``step_*.npz``), kill-and-resume bit-identity MID-TIME-HISTORY, NaN/Inf
+rollback instead of silently integrating garbage, the per-step PCG
+breakdown ladder for Newmark, step-domain fault injection
+(``mode@s:N``), and the on-disk retention bound (PCG_TPU_SNAP_KEEP)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.config import RunConfig, SolverConfig
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+from pcg_mpi_solver_tpu.resilience import FaultPlan, SimulatedKill
+from pcg_mpi_solver_tpu.solver.dynamics import DynamicsSolver, stable_dt
+from pcg_mpi_solver_tpu.solver.newmark import NewmarkSolver
+
+
+class _Capture:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, ev):
+        self.events.append(ev)
+
+    def close(self):
+        pass
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("PCG_TPU_RETRY_BACKOFF_S", "0.01")
+
+
+@pytest.fixture(scope="module")
+def model():
+    return make_cube_model(4, 3, 3, heterogeneous=True)
+
+
+@pytest.fixture(scope="module")
+def dyn_model():
+    return make_cube_model(4, 3, 3, E=100.0, nu=0.25, rho=1.0,
+                           load="traction", load_value=1.0,
+                           heterogeneous=True)
+
+
+DELTAS = [0.5, 1.0, 1.0, 0.7, 0.3]
+
+
+def _ncfg(tmp_path, run_id, ipd=0, snap=0, trace=0, **kw):
+    kw.setdefault("tol", 1e-10)
+    cfg = RunConfig(
+        scratch_path=str(tmp_path), run_id=run_id,
+        solver=SolverConfig(max_iter=2000, iters_per_dispatch=ipd,
+                            trace_resid=trace, **kw))
+    cfg.snapshot_every = snap
+    return cfg
+
+
+# ----------------------------------------------------------------------
+# Step-domain fault plan
+# ----------------------------------------------------------------------
+
+def test_step_domain_parse_and_fire():
+    import jax.numpy as jnp
+
+    p = FaultPlan("kill@s:3, nan@s:5, exc@2")
+    assert p.armed and p.step_armed
+    assert p.next_step_fault(0) == 3
+    assert p.next_step_fault(3) == 5
+    assert p.next_step_fault(5) is None
+    state = {"u": jnp.asarray([1.0, 2.0]), "v": jnp.asarray([0.0, 1.0])}
+    clean = p.at_step(1, dict(state))               # nothing fires at 1
+    assert np.isfinite(np.asarray(clean["u"])).all()
+    out = p.at_step(5, dict(state))
+    assert np.isnan(np.asarray(out["u"])).all()
+    np.testing.assert_array_equal(np.asarray(out["v"]),
+                                  np.asarray(state["v"]))
+    with pytest.raises(SimulatedKill):
+        p.at_step(3, dict(state))
+    # absolute indexing: a consumed step fault never re-fires
+    out2 = p.at_step(5, dict(state))
+    assert np.isfinite(np.asarray(out2["u"])).all()
+
+    # modes without a step-domain trigger are rejected at parse
+    with pytest.raises(ValueError, match="step-domain"):
+        FaultPlan("exc@s:1")
+    with pytest.raises(ValueError, match="bad fault term"):
+        FaultPlan("kill@s:")
+
+
+# ----------------------------------------------------------------------
+# SnapshotStore: step prefix, latest(), retention bound
+# ----------------------------------------------------------------------
+
+def test_snapshot_retention_bound(tmp_path, monkeypatch):
+    from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+    store = SnapshotStore(str(tmp_path), {"v": 1}, prefix="step")
+    for t in range(1, 7):
+        store.save(t, {"u": np.full(3, float(t))})
+    files = sorted(os.path.basename(p) for p in
+                   glob.glob(str(tmp_path / "step_*.npz")))
+    assert files == ["step_000005.npz", "step_000006.npz"]   # default K=2
+    assert store.latest() == 6
+
+    monkeypatch.setenv("PCG_TPU_SNAP_KEEP", "4")
+    for t in range(7, 10):
+        store.save(t, {"u": np.full(3, float(t))})
+    files = glob.glob(str(tmp_path / "step_*.npz"))
+    assert len(files) == 4
+
+    monkeypatch.setenv("PCG_TPU_SNAP_KEEP", "not-a-number")
+    with pytest.warns(UserWarning, match="PCG_TPU_SNAP_KEEP"):
+        assert store.retention() == 2
+
+
+def test_snapshot_latest_skips_corrupt(tmp_path):
+    from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+    store = SnapshotStore(str(tmp_path), None, prefix="step")
+    store.save(3, {"u": np.ones(2)})
+    store.save(4, {"u": np.ones(2)})
+    newest = str(tmp_path / "step_000004.npz")
+    blob = open(newest, "rb").read()
+    with open(newest, "wb") as f:
+        f.write(blob[: len(blob) // 3])
+    assert store.latest() == 3      # corrupt newest costs one slot
+    # the two prefixes never cross: a snap_* store sees nothing here
+    assert SnapshotStore(str(tmp_path), None).latest() is None
+
+
+# ----------------------------------------------------------------------
+# Newmark: ladder, kill-and-resume bit-identity, NaN rollback
+# ----------------------------------------------------------------------
+
+def test_newmark_per_step_ladder(tmp_path, model):
+    """A rho0 breakdown injected at a chunk boundary inside a Newmark
+    step recovers through the shared ladder (restart_minres) and still
+    converges — the driver-layer posture, now on the Newmark path."""
+    cap = _Capture()
+    s = NewmarkSolver(model, _ncfg(tmp_path, "lad", ipd=7),
+                      mesh=make_mesh(2), n_parts=2, dt=0.2,
+                      recorder=MetricsRecorder(sinks=[cap]))
+    s.fault_plan = FaultPlan("rho0@1", recorder=s.recorder)
+    res = s.run(DELTAS)
+    assert all(r.flag == 0 for r in res)
+    recs = [(e["action"], e["trigger"]) for e in cap.events
+            if e["kind"] == "recovery"]
+    assert ("restart_minres", "flag4") in recs
+
+
+def test_newmark_block3_fallback_prec(tmp_path, model):
+    """Ladder rung 2 on the SHIFTED operator: block3 breakdowns retry
+    under the scalar-Jacobi fallback of A = K + c*M."""
+    cap = _Capture()
+    # ipd=3 + tight tol: enough chunk boundaries inside step 1 (before
+    # AND after the first restart) that both injected breakdowns hit the
+    # SAME step's ladder (rung 1, then rung 2)
+    s = NewmarkSolver(model, _ncfg(tmp_path, "fb", ipd=3,
+                                   precond="block3", tol=1e-13),
+                      mesh=make_mesh(2), n_parts=2, dt=0.2,
+                      recorder=MetricsRecorder(sinks=[cap]))
+    s.fault_plan = FaultPlan("rho0@1,rho0@2", recorder=s.recorder)
+    res = s.run(DELTAS)
+    assert all(r.flag == 0 for r in res)
+    recs = [(e["action"], e["attempt"]) for e in cap.events
+            if e["kind"] == "recovery"]
+    assert ("fallback_prec", 2) in recs, recs
+
+
+def test_newmark_kill_and_resume_bit_identity(tmp_path, model):
+    """ISSUE 4 acceptance: a PCG_TPU_FAULTS-injected kill at timestep N
+    of a Newmark run, followed by --resume, reproduces the uninterrupted
+    run's displacement history and trace ring bit-identically."""
+    ref = NewmarkSolver(model, _ncfg(tmp_path, "ref", ipd=7, trace=32),
+                        mesh=make_mesh(2), n_parts=2, dt=0.2)
+    ref.run(DELTAS)
+
+    cap = _Capture()
+    kcfg = _ncfg(tmp_path, "kill", ipd=7, snap=1, trace=32)
+    k1 = NewmarkSolver(model, kcfg, mesh=make_mesh(2), n_parts=2, dt=0.2)
+    k1.fault_plan = FaultPlan("kill@s:3")
+    with pytest.raises(SimulatedKill):
+        k1.run(DELTAS)
+    snaps = glob.glob(os.path.join(kcfg.checkpoint_path, "step_*.npz"))
+    assert snaps, "the kill must leave timestep snapshots behind"
+
+    k2 = NewmarkSolver(model, kcfg, mesh=make_mesh(2), n_parts=2, dt=0.2,
+                       recorder=MetricsRecorder(sinks=[cap]))
+    res = k2.run(DELTAS, resume=True)
+    assert len(res) == 2            # steps 4..5 only
+    assert k2.flags == ref.flags and k2.iters == ref.iters
+    assert k2.relres == ref.relres
+    for a, b in zip(k2.state_global(), ref.state_global()):
+        np.testing.assert_array_equal(a, b)
+    # the per-step convergence ring of the resumed steps matches exactly
+    np.testing.assert_array_equal(k2.last_trace.normr,
+                                  ref.last_trace.normr)
+    assert [e["op"] for e in cap.events
+            if e["kind"] == "step_snapshot"][0] == "restore"
+
+
+def test_newmark_resume_schedule_mismatch(tmp_path, model):
+    cfg = _ncfg(tmp_path, "sched", snap=1)
+    s = NewmarkSolver(model, cfg, mesh=make_mesh(2), n_parts=2, dt=0.2)
+    s.fault_plan = FaultPlan("kill@s:2")
+    with pytest.raises(SimulatedKill):
+        s.run(DELTAS)
+    s2 = NewmarkSolver(model, cfg, mesh=make_mesh(2), n_parts=2, dt=0.2)
+    with pytest.raises(ValueError, match="schedule mismatch"):
+        s2.run([9.0] * 5, resume=True)
+
+
+def test_newmark_nan_rollback(tmp_path, model):
+    """A NaN injected into the kinematic state at timestep N rolls back
+    to the last step snapshot and re-integrates — final state
+    bit-identical to a clean run, with a rollback recovery event."""
+    ref = NewmarkSolver(model, _ncfg(tmp_path, "c0"), mesh=make_mesh(2),
+                        n_parts=2, dt=0.2)
+    ref.run(DELTAS)
+    cap = _Capture()
+    s = NewmarkSolver(model, _ncfg(tmp_path, "c1", snap=1),
+                      mesh=make_mesh(2), n_parts=2, dt=0.2,
+                      recorder=MetricsRecorder(sinks=[cap]))
+    s.fault_plan = FaultPlan("nan@s:2", recorder=s.recorder)
+    res = s.run(DELTAS)
+    assert all(r.flag == 0 for r in res)
+    assert s.flags == ref.flags and s.iters == ref.iters
+    np.testing.assert_array_equal(s.state_global()[0],
+                                  ref.state_global()[0])
+    rolls = [e for e in cap.events if e["kind"] == "recovery"
+             and e["action"] == "rollback"]
+    assert rolls and rolls[0]["trigger"] == "nan_carry"
+
+
+def test_newmark_rollback_budget_exhausts(tmp_path, model):
+    """Persistent poison exhausts max_recoveries into an honest
+    FloatingPointError instead of looping forever."""
+    s = NewmarkSolver(model,
+                      _ncfg(tmp_path, "bud", snap=1, max_recoveries=2),
+                      mesh=make_mesh(2), n_parts=2, dt=0.2)
+    s.fault_plan = FaultPlan("nan@s:1,nan@s:2,nan@s:3")
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        s.run(DELTAS)
+
+
+# ----------------------------------------------------------------------
+# Explicit dynamics: kill-and-resume, NaN rollback, chunk splitting
+# ----------------------------------------------------------------------
+
+def _dcfg(tmp_path, run_id, snap=0):
+    cfg = RunConfig(scratch_path=str(tmp_path), run_id=run_id)
+    cfg.snapshot_every = snap
+    return cfg
+
+
+def test_dynamics_kill_and_resume_bit_identity(tmp_path, dyn_model):
+    """Kill at timestep N mid explicit history; resume reproduces the
+    uninterrupted run's probe series and export frames bit-identically
+    (the probe series is the explicit path's 'trace ring')."""
+    dt = stable_dt(dyn_model, safety=0.5)
+    ref = DynamicsSolver(dyn_model, _dcfg(tmp_path, "r"),
+                         mesh=make_mesh(4), n_parts=4, dt=dt,
+                         damping=0.05, probe_dofs=(6, 13))
+    res_ref = ref.run(25, export_every=5)
+
+    kcfg = _dcfg(tmp_path, "k", snap=4)
+    d1 = DynamicsSolver(dyn_model, kcfg, mesh=make_mesh(4), n_parts=4,
+                        dt=dt, damping=0.05, probe_dofs=(6, 13))
+    d1.fault_plan = FaultPlan("kill@s:12")
+    with pytest.raises(SimulatedKill):
+        d1.run(25, export_every=5)
+    # retention bound holds mid-history (default keep 2)
+    snaps = sorted(os.path.basename(p) for p in glob.glob(
+        os.path.join(kcfg.checkpoint_path, "step_*.npz")))
+    assert snaps == ["step_000008.npz", "step_000012.npz"]
+
+    d2 = DynamicsSolver(dyn_model, kcfg, mesh=make_mesh(4), n_parts=4,
+                        dt=dt, damping=0.05, probe_dofs=(6, 13))
+    res = d2.run(25, export_every=5, resume=True)
+    np.testing.assert_array_equal(res.probe_u, res_ref.probe_u)
+    np.testing.assert_array_equal(res.u, res_ref.u)
+    assert res.frame_times == res_ref.frame_times
+    for a, b in zip(res.frames, res_ref.frames):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dynamics_nan_rollback_bit_identity(tmp_path, dyn_model):
+    dt = stable_dt(dyn_model, safety=0.5)
+    ref = DynamicsSolver(dyn_model, _dcfg(tmp_path, "r2"),
+                         mesh=make_mesh(4), n_parts=4, dt=dt,
+                         damping=0.05, probe_dofs=(6,))
+    res_ref = ref.run(25, export_every=5)
+    cap = _Capture()
+    d = DynamicsSolver(dyn_model, _dcfg(tmp_path, "n2", snap=5),
+                       mesh=make_mesh(4), n_parts=4, dt=dt,
+                       damping=0.05, probe_dofs=(6,),
+                       recorder=MetricsRecorder(sinks=[cap]))
+    d.fault_plan = FaultPlan("nan@s:10", recorder=d.recorder)
+    res = d.run(25, export_every=5)
+    np.testing.assert_array_equal(res.probe_u, res_ref.probe_u)
+    np.testing.assert_array_equal(res.u, res_ref.u)
+    assert [e["action"] for e in cap.events
+            if e["kind"] == "recovery"] == ["rollback"]
+
+
+def test_dynamics_unguarded_nonfinite_raises(dyn_model):
+    """Without snapshots there is nothing to roll back to: the run must
+    fail loudly instead of silently integrating garbage (the historical
+    behavior was to return NaN results with no signal)."""
+    dt = stable_dt(dyn_model, safety=0.5)
+    d = DynamicsSolver(dyn_model, RunConfig(), mesh=make_mesh(1),
+                       n_parts=1, dt=dt)
+    d.fault_plan = FaultPlan("nan@s:3")
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        d.run(10)
+
+
+def test_dynamics_chunk_splitting_is_bitwise_neutral(tmp_path, dyn_model):
+    """Snapshot-cadence chunk splitting changes the device dispatch
+    pattern but not the per-step math: probe series bit-identical to
+    the single-chunk run."""
+    dt = stable_dt(dyn_model, safety=0.5)
+    a = DynamicsSolver(dyn_model, _dcfg(tmp_path, "s0"),
+                       mesh=make_mesh(2), n_parts=2, dt=dt,
+                       probe_dofs=(6,))
+    ra = a.run(20)
+    b = DynamicsSolver(dyn_model, _dcfg(tmp_path, "s3", snap=3),
+                       mesh=make_mesh(2), n_parts=2, dt=dt,
+                       probe_dofs=(6,))
+    rb = b.run(20)
+    np.testing.assert_array_equal(ra.probe_u, rb.probe_u)
+    np.testing.assert_array_equal(ra.u, rb.u)

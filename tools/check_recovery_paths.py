@@ -14,15 +14,15 @@ solve/cache/recovery layers does one of three things:
   failure legitimately degrades to a miss).
 
 A bare ``except:``/``except Exception:`` that silently ``pass``es in
-``solver/``, ``cache/`` or ``resilience/`` is exactly how a breakdown
-or device loss turns into a wrong answer with no trail — this lint
-makes that unrepresentable.
+``solver/``, ``cache/``, ``resilience/`` or ``validate/`` is exactly
+how a breakdown or device loss turns into a wrong answer with no trail
+— this lint makes that unrepresentable.
 
 Usage::
 
     python tools/check_recovery_paths.py [PATH ...]
 
-With no PATH arguments, scans the default scope (the three packages
+With no PATH arguments, scans the default scope (the four packages
 above).  Exits non-zero listing each violation; wired into tier-1 via
 ``tests/test_recovery_paths.py`` like the telemetry-schema lint.
 """
@@ -40,6 +40,7 @@ DEFAULT_SCOPE = (
     os.path.join(PKG, "solver"),
     os.path.join(PKG, "cache"),
     os.path.join(PKG, "resilience"),
+    os.path.join(PKG, "validate"),
 )
 
 # Exception names considered "broad" when caught: anything narrower
