@@ -81,7 +81,9 @@ BENCH_WALL_BUDGET_S, BENCH_PROV_NX, BENCH_PROVISIONAL (internal:
 marks the fast-fallback subprocess), BENCH_CPU_UPGRADE,
 BENCH_UPGRADE_NX/BENCH_UPGRADE_MODE/BENCH_UPGRADE_DTYPE, BENCH_SALVAGE,
 BENCH_SALVAGE_MAX_AGE_S, BENCH_PLATEAU (mixed-mode inner
-plateau-exit window, 0=off); plus the solver-level performance knobs
+plateau-exit window, 0=off), BENCH_PCG_VARIANT (classic|fused PCG loop
+formulation — the classic-vs-fused ms/iteration A/B knob; the engaged
+variant is reported in detail.pcg_variant); plus the solver-level performance knobs
 PCG_TPU_MATVEC_FORM / PCG_TPU_PALLAS_V / PCG_TPU_PALLAS_PLANES /
 PCG_TPU_HYBRID_BLOCK (docs/RUNBOOK.md knob table) — the engaged form is
 reported in detail.matvec_form.
@@ -377,6 +379,12 @@ def _run_config_extra(solver, dtype, mode, pallas_on, n_parts, t_part,
         "dtype": dtype,
         "mode": mode,
         "backend": solver.backend,
+        # classic-vs-fused A/B field: the engaged PCG loop formulation,
+        # so hardware-window lines are directly comparable across
+        # BENCH_PCG_VARIANT settings
+        "pcg_variant": getattr(
+            getattr(getattr(solver, "config", None), "solver", None),
+            "pcg_variant", "classic"),
         "pallas": bool(pallas_on),
         # ops without a form attribute (general backend) never read the
         # form knob; the stencil ops PIN it at construction
@@ -469,6 +477,10 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
         solver=SolverConfig(tol=tol, max_iter=20000, dtype=dtype,
                             dot_dtype="float64", precision_mode=mode,
                             pallas=os.environ.get("BENCH_PALLAS", "auto"),
+                            # classic|fused A/B knob for the hardware
+                            # windows (fused = one collective/iteration)
+                            pcg_variant=os.environ.get(
+                                "BENCH_PCG_VARIANT", "classic"),
                             mixed_plateau_window=int(
                                 os.environ.get("BENCH_PLATEAU", 0)),
                             **solver_kw),

@@ -116,17 +116,25 @@ def step_cache_key(*, abstract: Any, mesh: Any, backend: str,
                    solver: Dict[str, Any], trace_len: int,
                    glob_n_dof_eff: int, donate: bool,
                    jax_version: str,
+                   pcg_variant: str = "classic",
                    extra: Optional[Dict[str, Any]] = None) -> str:
     """Key for one AOT-exported PCG step program: the ABSTRACT signature
     (shapes/dtypes/shardings repr), the mesh layout, and every scalar the
     step closure bakes in as a compile-time constant (solver config,
-    effective dof count, trace ring length, donation)."""
+    effective dof count, trace ring length, donation).
+
+    ``pcg_variant`` (SolverConfig.pcg_variant) is carried as its own
+    structural component on top of the solver dict: the classic and
+    fused loop bodies are different programs with different carry
+    pytrees, and an AOT/compile-cache hit across variants would
+    deserialize the wrong one."""
     return _digest({
         "kind": "aot-step",
         "abstract": abstract,
         "mesh": mesh,
         "backend": backend,
         "solver": solver,
+        "pcg_variant": str(pcg_variant),
         "trace_len": int(trace_len),
         "glob_n_dof_eff": int(glob_n_dof_eff),
         "donate": bool(donate),

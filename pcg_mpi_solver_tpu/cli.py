@@ -45,6 +45,10 @@ def _load_settings(path, args) -> "RunConfig":
         max_iter=int(getattr(args, "max_iter", None) or sp.get("MaxIter", 10000)),
         precision_mode=getattr(args, "precision", None) or sp.get("PrecisionMode", "direct"),
         precond=getattr(args, "precond", None) or sp.get("Precond", "jacobi"),
+        # classic stays the bit-exact reference-parity default; "fused"
+        # opts into the single-reduction Chronopoulos–Gear loop
+        pcg_variant=(getattr(args, "pcg_variant", None)
+                     or sp.get("PcgVariant", "classic")),
     )
     time_history = TimeHistoryConfig(
         time_step_delta=th.get("TimeStepDelta", [0.0, 1.0]),
@@ -123,6 +127,19 @@ def _finish_telemetry(solver, args) -> None:
     if getattr(args, "telemetry_out", None):
         print(f">telemetry: {args.telemetry_out}")
     solver.recorder.close()
+
+
+def _add_variant_flag(p) -> None:
+    p.add_argument("--pcg-variant", choices=["classic", "fused"],
+                   default=None, dest="pcg_variant",
+                   help="PCG loop formulation: classic = MATLAB-"
+                        "compatible 3-reduction loop (bit-exact "
+                        "reference parity; default), fused = "
+                        "Chronopoulos-Gear single-reduction recurrence "
+                        "(ONE collective per iteration — cuts the "
+                        "between-matvec latency at scale; iteration "
+                        "counts differ by O(1), see docs/RUNBOOK.md "
+                        "'Choosing pcg_variant')")
 
 
 def _add_preflight_flag(p) -> None:
@@ -505,6 +522,7 @@ def main(argv=None):
                    help="preconditioner: scalar Jacobi (reference parity) "
                         "or 3x3 node-block Jacobi (stronger on "
                         "heterogeneous elasticity)")
+    _add_variant_flag(p)
     p.add_argument("--speed-test", action="store_true",
                    help="disable all exports for clean timing "
                         "(reference SpeedTestFlag)")
@@ -586,6 +604,7 @@ def main(argv=None):
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
     p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    _add_variant_flag(p)
     p.add_argument("--backend", choices=["auto", "hybrid", "general"],
                    default="auto")
     _add_resilience_flags(p, "timesteps")
@@ -610,6 +629,7 @@ def main(argv=None):
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default="mixed")
     p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    _add_variant_flag(p)
     p.add_argument("--octree", action="store_true",
                    help="graded octree model with transition pattern types "
                         "(nx/ny/nz = base cells; solved on the hybrid "
@@ -639,6 +659,7 @@ def main(argv=None):
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
     p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    _add_variant_flag(p)
     p.add_argument("--backend",
                    choices=["auto", "structured", "hybrid", "general"],
                    default="auto")

@@ -57,6 +57,26 @@ class SolverConfig:
     mixed_progress_min_gain: float = 30.0
     # MATLAB-pcg compatibility knobs (pcg_solver.py:399-404)
     max_stag_steps: int = 3
+    # PCG loop formulation (solver/pcg.py):
+    #   "classic" — the MATLAB-pcg-compatible loop: three serialized
+    #               scalar/fused psums per iteration (rho+inf-prec, p.q,
+    #               fused 3-norm).  Bit-exact reference parity; default.
+    #   "fused"   — Chronopoulos–Gear single-reduction recurrence: rho,
+    #               the p.q denominator, the residual norm, the
+    #               stagnation norms and the inf-preconditioner flag all
+    #               come from ONE fused psum per iteration, and A.p
+    #               advances by recurrence (q = A.z + beta*q) so the
+    #               stencil still runs once per iteration.  Cuts the
+    #               per-iteration latency spent between the matvecs at
+    #               scale (the BENCH_r05 profile: 24.994 ms/iter vs
+    #               13.741 ms/matvec at 10.33M dofs).  Convergence
+    #               checks lag the iterate by one iteration (the
+    #               pipelined-CG tradeoff), so iteration counts differ
+    #               from classic by O(1) and results are NOT bit-exact
+    #               with the reference — see docs/RUNBOOK.md "Choosing
+    #               pcg_variant".  CLI: --pcg-variant; bench:
+    #               BENCH_PCG_VARIANT.
+    pcg_variant: str = "classic"
     # Preconditioner: "jacobi" (scalar diag(K)^-1 — the reference's only
     # choice, pcg_solver.py:346-352) or "block3" (assembled 3x3 node-block
     # Jacobi, inverted per node — stronger on vector-valued elasticity;

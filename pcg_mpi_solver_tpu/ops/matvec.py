@@ -407,20 +407,27 @@ class Ops:
         """Full assembled K.x across all parts (reference calcMPFint)."""
         return self.iface_assemble(data, self.matvec_local(data, x))
 
-    def comm_estimate(self, storage_dtype=None) -> dict:
+    def comm_estimate(self, storage_dtype=None,
+                      variant: str = "classic") -> dict:
         """Static per-PCG-iteration collective estimate from the ops
-        shapes, for the telemetry gauges (obs/metrics.py): each iteration
-        runs 3 scalar/fused psums (rho+inf, pq, fused 3-norm — 6 reduced
-        scalars total) plus the interface-assembly psum inside the matvec,
-        whose payload is the shared-dof vector.  ``bytes_per_iter_est`` is
-        the per-device psum payload, not link traffic (the actual wire
-        cost depends on the all-reduce algorithm and topology)."""
+        shapes, for the telemetry gauges (obs/metrics.py).  ``variant``
+        is the PCG loop formulation (SolverConfig.pcg_variant): classic
+        runs 3 serialized scalar/fused psums per iteration (rho+inf, pq,
+        fused 3-norm — 6 reduced scalars total); the fused
+        Chronopoulos–Gear variant folds all 6 scalars into ONE psum.
+        Either way the interface-assembly psum inside the matvec adds
+        one collective whose payload is the shared-dof vector.
+        ``bytes_per_iter_est`` is the per-device psum payload, not link
+        traffic (the actual wire cost depends on the all-reduce
+        algorithm and topology)."""
         itemsize = jnp.dtype(storage_dtype if storage_dtype is not None
                              else self.dot_dtype).itemsize
         dot_bytes = jnp.dtype(self.dot_dtype).itemsize
         n_iface = int(self.n_iface)
+        scalar_psums = 1 if variant == "fused" else 3
         return {
-            "psums_per_iter": 4 if n_iface else 3,
+            "pcg_variant": variant,
+            "psums_per_iter": scalar_psums + (1 if n_iface else 0),
             "iface_dofs": n_iface,
             "reduce_scalars_per_iter": 6,
             "bytes_per_iter_est": n_iface * itemsize + 6 * dot_bytes,

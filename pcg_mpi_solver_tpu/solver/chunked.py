@@ -87,9 +87,17 @@ class ChunkedEngine:
         self._rec = recorder
         self.last_trace = None
         self.donate = bool(donate)
+        # Loop formulation (SolverConfig.pcg_variant): threads through
+        # every resumable pcg() call below and sizes the carry schema —
+        # the fused (Chronopoulos–Gear) variant rides q/alpha/fresh
+        # recurrence state alongside the classic Krylov carry, so capped
+        # fused dispatches stay bit-identical to one long fused solve.
+        variant = self.variant = getattr(scfg, "pcg_variant", "classic")
+        fused_v = variant == "fused"
         cap = int(cap)
         P, R = part_spec, rep_spec
-        carry_specs = carry_part_specs(P, R, trace=self.trace_len > 0)
+        carry_specs = carry_part_specs(P, R, trace=self.trace_len > 0,
+                                       fused=fused_v)
 
         def smap(f, in_specs, out_specs, donate_argnums=()):
             return jax.jit(jax.shard_map(
@@ -115,7 +123,8 @@ class ChunkedEngine:
                 # ||rhat||_w = ||r||_w / normr = 1 exactly; no matvec needed.
                 one = jnp.asarray(1.0, ops32.dot_dtype)
                 carry0 = cold_carry(jnp.zeros_like(rhat32), rhat32, one,
-                                    ops32.dot_dtype, trace=trace)
+                                    ops32.dot_dtype, trace=trace,
+                                    fused=fused_v)
                 return rhat32, tol_cycle, carry0
 
             in_start = (data_specs, P, R, R) + (
@@ -141,7 +150,8 @@ class ChunkedEngine:
                     progress_min_gain=scfg.mixed_progress_min_gain,
                     # inner iterations run on r/normr: the ring records
                     # absolute residuals via the cycle's refresh norm
-                    trace_scale=scale)
+                    trace_scale=scale,
+                    variant=variant)
                 return res.x, carry2, res.flag
 
             in_cycle = (data_specs, P, P, R, carry_specs, R) + (
@@ -186,8 +196,11 @@ class ChunkedEngine:
 
             def _final32(data, rhat32, carry32):
                 """f32 min-residual selection when an inner solve fails
-                (matches the one-shot pcg_mixed's finalize_bad)."""
-                x, _ = select_best(ops32, data["f32"], rhat32, carry32)
+                (matches the one-shot pcg_mixed's finalize_bad; fused
+                carries never evaluated their last iterate, so they
+                take the min unconditionally)."""
+                x, _ = select_best(ops32, data["f32"], rhat32, carry32,
+                                   always_min=fused_v)
                 return x
 
             self._final32_fn = smap(
@@ -206,7 +219,8 @@ class ChunkedEngine:
                     glob_n_dof_eff=glob_n_dof_eff,
                     max_stag_steps=scfg.max_stag_steps,
                     max_iter_nominal=scfg.max_iter,
-                    carry_in=carry, return_carry=True)
+                    carry_in=carry, return_carry=True,
+                    variant=variant)
                 return res.x, carry2, res.flag, res.relres
 
             # donated carry: the resumable Krylov state is aliased across
@@ -216,8 +230,11 @@ class ChunkedEngine:
                 (P, carry_specs, R, R), donate_argnums=(3,))
 
             def _final(data, fext, carry):
-                """Min-residual selection at terminal failure (once/step)."""
-                return select_best(ops, data, fext, carry)
+                """Min-residual selection at terminal failure (once/step);
+                fused carries never evaluated their last iterate, so
+                they take the min unconditionally."""
+                return select_best(ops, data, fext, carry,
+                                   always_min=fused_v)
 
             self._final_fn = smap(
                 _final, (data_specs, P, carry_specs), (P, R))
@@ -571,7 +588,15 @@ class ChunkedEngine:
                 # min-residual fallback to here (once per step).
                 with self._disp("final"):
                     x_fin, relres_dev = self._final_fn(data, fext, carry)
-                    relres = float(relres_dev)
+                    best = float(relres_dev)
+                # a NaN-poisoned carry must stay visible to the ladder's
+                # nan_carry trigger: classic's select_best propagates the
+                # non-finite normr_act through its NaN-false compare, but
+                # the fused always-min selection reports the (finite)
+                # recomputed min residual — keep the poison marker either
+                # way and let the ladder restart from restart_x
+                if math.isfinite(relres):
+                    relres = best
             self.last_trace = carry.get("trace")
             # min-residual restart iterate for the recovery ladder (only
             # ever updated by committed finite iterations, so it stays

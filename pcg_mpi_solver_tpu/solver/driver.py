@@ -158,10 +158,15 @@ class Solver:
 
         solver_cfg = self.config.solver
         from pcg_mpi_solver_tpu.ops.precond import VALID_PRECONDS
+        from pcg_mpi_solver_tpu.solver.pcg import VALID_PCG_VARIANTS
 
         if solver_cfg.precond not in VALID_PRECONDS:
             raise ValueError(f"SolverConfig.precond must be one of "
                              f"{VALID_PRECONDS}, got {solver_cfg.precond!r}")
+        if solver_cfg.pcg_variant not in VALID_PCG_VARIANTS:
+            raise ValueError(
+                f"SolverConfig.pcg_variant must be one of "
+                f"{VALID_PCG_VARIANTS}, got {solver_cfg.pcg_variant!r}")
         self.mixed = solver_cfg.precision_mode == "mixed"
         dtype = jnp.dtype(jnp.float64) if self.mixed else jnp.dtype(solver_cfg.dtype)
         dot_dtype = jnp.dtype(solver_cfg.dot_dtype)
@@ -423,11 +428,16 @@ class Solver:
         self._rec.gauge("n_parts", int(self.pm.n_parts))
         self._rec.gauge("n_dof", int(self.pm.glob_n_dof))
         self._rec.gauge("precision_mode", solver_cfg.precision_mode)
+        self._rec.gauge("pcg_variant", solver_cfg.pcg_variant)
         # mixed mode: the Krylov iterations (vectors AND dot reductions)
-        # run on the f32 ops, so that is the ops object to size from
+        # run on the f32 ops, so that is the ops object to size from;
+        # the variant sets the per-iteration collective count (fused =
+        # one scalar psum, classic = three)
         est_ops = self.ops32 if self.mixed else self.ops
         iter_dtype = jnp.float32 if self.mixed else dtype
-        for k, v in est_ops.comm_estimate(storage_dtype=iter_dtype).items():
+        for k, v in est_ops.comm_estimate(
+                storage_dtype=iter_dtype,
+                variant=solver_cfg.pcg_variant).items():
             self._rec.gauge(f"comm.{k}", v)
 
         # In-graph convergence trace: ring length (0 = off) and its float
@@ -474,6 +484,7 @@ class Solver:
                     progress_ratio=solver_cfg.mixed_progress_ratio,
                     progress_min_gain=solver_cfg.mixed_progress_min_gain,
                     trace_in=trace0,
+                    variant=solver_cfg.pcg_variant,
                 )
             else:
                 # preconditioner rebuild (pcg_solver.py:346-352)
@@ -484,6 +495,7 @@ class Solver:
                     glob_n_dof_eff=glob_n_eff,
                     max_stag_steps=solver_cfg.max_stag_steps,
                     trace_in=trace0,
+                    variant=solver_cfg.pcg_variant,
                 )
             if trace_len:
                 res, trace = res
@@ -648,6 +660,11 @@ class Solver:
             backend=self.backend,
             # every SolverConfig scalar is baked into the traced program
             solver=_dc.asdict(self.config.solver),
+            # also a STRUCTURAL key component (cache/keys.py): the
+            # variant reshapes the loop body and the carry pytree, so
+            # classic/fused programs must never collide even if the
+            # solver dict's serialization ever changes
+            pcg_variant=self.config.solver.pcg_variant,
             trace_len=self.trace_len,
             glob_n_dof_eff=int(self.pm.glob_n_dof_eff),
             donate=bool(donate_step),
@@ -716,9 +733,12 @@ class Solver:
         P, R = self._part_spec, self._rep_spec
         # Direct mode threads the convergence ring through the dispatch
         # carry built here; in mixed mode the engine owns the ring (it
-        # rides the f32 inner carries instead).
+        # rides the f32 inner carries instead).  The fused variant adds
+        # its recurrence leaves to the carry schema (pcg.cold_carry).
+        fused_v = scfg.pcg_variant == "fused"
         trace_direct = self.trace_len > 0 and not mixed
-        carry_specs = carry_part_specs(P, R, trace=trace_direct)
+        carry_specs = carry_part_specs(P, R, trace=trace_direct,
+                                       fused=fused_v)
 
         # The ONE program holding the out-of-loop f64 stencil: Dirichlet
         # lifting, r0, and every refinement's true-residual matvec all
@@ -786,7 +806,8 @@ class Solver:
             carry0 = cold_carry(
                 x0, r0, normr0, self.ops.dot_dtype,
                 trace=(trace_init(self.trace_len, self._trace_dtype)
-                       if trace_direct else None))
+                       if trace_direct else None),
+                fused=fused_v)
             # preconditioner rebuild once per step (not per dispatch /
             # refinement cycle): f32 for the mixed inner solves.
             if mixed:
@@ -974,9 +995,11 @@ class Solver:
                 carry_part_specs, cold_carry)
 
             mixed = self.mixed
+            fused_v = self.config.solver.pcg_variant == "fused"
             trace_direct = self.trace_len > 0 and not mixed
             P, R = self._part_spec, self._rep_spec
-            carry_specs = carry_part_specs(P, R, trace=trace_direct)
+            carry_specs = carry_part_specs(P, R, trace=trace_direct,
+                                           fused=fused_v)
             trace_len, trace_dtype = self.trace_len, self._trace_dtype
 
             def _restart(data, fext, x, kx):
@@ -987,7 +1010,7 @@ class Solver:
                 tr = (trace_init(trace_len, trace_dtype)
                       if trace_direct else None)
                 return cold_carry(x, r, normr, self.ops.dot_dtype,
-                                  trace=tr), normr
+                                  trace=tr, fused=fused_v), normr
 
             self._restart_post_fn = jax.jit(jax.shard_map(
                 _restart, mesh=self.mesh,

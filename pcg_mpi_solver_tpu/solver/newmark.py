@@ -136,10 +136,16 @@ class NewmarkSolver:
                 profile=True if self.config.telemetry_profile else None))
         self._rec = self.recorder
         from pcg_mpi_solver_tpu.ops.precond import VALID_PRECONDS
+        from pcg_mpi_solver_tpu.solver.pcg import VALID_PCG_VARIANTS
 
         if scfg.precond not in VALID_PRECONDS:
             raise ValueError(f"SolverConfig.precond must be one of "
                              f"{VALID_PRECONDS}, got {scfg.precond!r}")
+        if scfg.pcg_variant not in VALID_PCG_VARIANTS:
+            raise ValueError(
+                f"SolverConfig.pcg_variant must be one of "
+                f"{VALID_PCG_VARIANTS}, got {scfg.pcg_variant!r}")
+        self._rec.gauge("pcg_variant", scfg.pcg_variant)
         # Preflight gate (validate/): reject a pathological model/config
         # before the partition build below is paid.
         from pcg_mpi_solver_tpu.validate import run_preflight
@@ -285,13 +291,15 @@ class NewmarkSolver:
                     tol=scfg.tol, max_iter=scfg.max_iter,
                     glob_n_dof_eff=glob_n_eff,
                     max_stag_steps=scfg.max_stag_steps,
-                    inner_tol=scfg.inner_tol)
+                    inner_tol=scfg.inner_tol,
+                    variant=scfg.pcg_variant)
             else:
                 res = pcg(
                     self.ops, data64, fext, x0, prec,
                     tol=scfg.tol, max_iter=scfg.max_iter,
                     glob_n_dof_eff=glob_n_eff,
-                    max_stag_steps=scfg.max_stag_steps)
+                    max_stag_steps=scfg.max_stag_steps,
+                    variant=scfg.pcg_variant)
             u2, v2, w2 = _kinematics(data64, res.x, udi, u, v, w, delta_next)
             return u2, v2, w2, res.flag, res.relres, res.iters
 
@@ -326,8 +334,10 @@ class NewmarkSolver:
             from pcg_mpi_solver_tpu.solver.pcg import (
                 carry_part_specs, cold_carry)
 
+            fused_v = scfg.pcg_variant == "fused"
             trace_direct = self.trace_len > 0 and not self.mixed
-            carry_specs = carry_part_specs(P_, R_, trace=trace_direct)
+            carry_specs = carry_part_specs(P_, R_, trace=trace_direct,
+                                           fused=fused_v)
             trace_len, trace_dtype = self.trace_len, self._trace_dtype
 
             def _start_ch(data, u, v, w, delta_next):
@@ -342,7 +352,8 @@ class NewmarkSolver:
                 carry0 = cold_carry(
                     x0, r0, normr0, self.ops.dot_dtype,
                     trace=(trace_init(trace_len, trace_dtype)
-                           if trace_direct else None))
+                           if trace_direct else None),
+                    fused=fused_v)
                 return udi, fext, carry0, normr0, n2b
 
             self._start_ch_fn = jax.jit(jax.shard_map(
@@ -430,9 +441,11 @@ class NewmarkSolver:
         from pcg_mpi_solver_tpu.solver.pcg import carry_part_specs, cold_carry
 
         mixed = self.mixed
+        fused_v = self.config.solver.pcg_variant == "fused"
         trace_direct = self.trace_len > 0 and not mixed
         P, R = self._part_spec, self._rep_spec
-        carry_specs = carry_part_specs(P, R, trace=trace_direct)
+        carry_specs = carry_part_specs(P, R, trace=trace_direct,
+                                       fused=fused_v)
         trace_len, trace_dtype = self.trace_len, self._trace_dtype
 
         def _amulA(data, v):
@@ -451,7 +464,7 @@ class NewmarkSolver:
             tr = (trace_init(trace_len, trace_dtype)
                   if trace_direct else None)
             return cold_carry(x, r, normr, self.ops.dot_dtype,
-                              trace=tr), normr
+                              trace=tr, fused=fused_v), normr
 
         self._restart_post_fn = jax.jit(jax.shard_map(
             _restart, mesh=self.mesh, in_specs=(self._specs, P, P, P),

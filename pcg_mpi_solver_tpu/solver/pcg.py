@@ -12,9 +12,24 @@ Flags (reference pcg_solver.py:399,449,467-469,492-498,560-562):
   0 converged; 1 max-iterations; 2 inf preconditioner; 3 stagnation /
   tolerance too small; 4 rho/pq breakdown.
 
-Per iteration: 3 scalar/fused psums + 1 interface-assembly psum inside the
-matvec — the same communication count as the reference's 3 allreduces + 1
-halo exchange (SURVEY.md §3.1).
+Per iteration (``variant="classic"``): 3 scalar/fused psums + 1
+interface-assembly psum inside the matvec — the same communication count
+as the reference's 3 allreduces + 1 halo exchange (SURVEY.md §3.1).
+
+``variant="fused"`` restructures the loop body around the
+Chronopoulos–Gear recurrence (the single-reduction CG of arXiv:2105.06176
+§2): the matvec runs on the preconditioned residual (w = A.z), the search
+direction and its A-image advance by recurrence (p = z + beta*p,
+q = w + beta*q), and rho = <r,z>, the p.Ap denominator
+(mu - beta*rho/alpha_prev), the residual norm, the stagnation norms and
+the inf-preconditioner predicate are all read from ONE fused psum — so a
+fused iteration is 1 scalar psum + the interface psum, vs classic's 3+1,
+and no axpy is serialized between reductions.  The price: convergence/
+stagnation tests see the residual of the iterate committed one trip
+earlier (the pipelined lag), so iteration counts differ from classic by
+O(1) and the variant is NOT bit-exact with the MATLAB reference (classic
+stays the parity default).  The deferred true-residual check (mode 1)
+and the flag taxonomy are shared between variants.
 """
 
 from __future__ import annotations
@@ -41,6 +56,11 @@ from pcg_mpi_solver_tpu.ops.matvec import Ops
 # host-side budget loop's job (solver/chunked.py).
 BREAKDOWN_FLAGS = (2, 4)
 
+# Loop formulations (SolverConfig.pcg_variant): "classic" is the
+# MATLAB-compatible 3-reduction body, "fused" the Chronopoulos–Gear
+# single-reduction recurrence (see module docstring).
+VALID_PCG_VARIANTS = ("classic", "fused")
+
 
 class PCGResult(NamedTuple):
     x: jnp.ndarray        # (P, n_loc) solution on effective dofs (0 elsewhere)
@@ -49,7 +69,8 @@ class PCGResult(NamedTuple):
     iters: jnp.ndarray    # () int32  (1-based, MATLAB-compatible)
 
 
-def cold_carry(x0, r0, normr0, dot_dtype, trace=None) -> dict:
+def cold_carry(x0, r0, normr0, dot_dtype, trace=None,
+               fused: bool = False) -> dict:
     """Cold-start Krylov carry for resumable ``pcg`` calls: with p=0, rho=1
     the resumed beta/p recurrence reduces to the standard first iteration
     p = z.  The single schema shared by every chunked-dispatch call site.
@@ -76,20 +97,37 @@ def cold_carry(x0, r0, normr0, dot_dtype, trace=None) -> dict:
         since_best=zero_i, best_at_reset=jnp.asarray(normr0, dd),
         win_start=jnp.asarray(normr0, dd), win_count=zero_i,
         normr_act=jnp.asarray(normr0, dd), exec=zero_i)
+    if fused:
+        # Chronopoulos–Gear recurrence state (pcg ``variant="fused"``):
+        # ``q`` tracks A.p alongside p and ``alpha`` is the previous step
+        # size.  The cold values make the first fused trip reduce to the
+        # classic first iteration: with p = q = 0 the direction
+        # recurrence collapses to p = z, q = w, and alpha = +inf zeroes
+        # the denominator correction exactly (beta*rho/inf == 0 in
+        # IEEE), leaving alpha = rho/mu — the textbook first step.
+        # ``fresh`` gates candidate true-residual checks on a committed
+        # update since the last check (see the fused body in ``pcg``).
+        out["q"] = jnp.zeros_like(x0)
+        out["alpha"] = jnp.asarray(np.inf, dd)
+        out["fresh"] = jnp.asarray(1, jnp.int32)
     if trace is not None:
         out["trace"] = trace
     return out
 
 
-def carry_part_specs(part_spec, rep_spec, trace: bool = False) -> dict:
+def carry_part_specs(part_spec, rep_spec, trace: bool = False,
+                     fused: bool = False) -> dict:
     """shard_map PartitionSpecs for the carry dict (vectors on the parts
     axis, bookkeeping scalars replicated; the optional trace ring is
-    replicated scalar streams)."""
+    replicated scalar streams; ``fused`` adds the Chronopoulos–Gear
+    leaves — the A.p vector and two replicated scalars)."""
     P, R = part_spec, rep_spec
     out = dict(x=P, r=P, p=P, rho=R, stag=R, moresteps=R,
                normrmin=R, xmin=P, imin=R, since_best=R, best_at_reset=R,
                win_start=R, win_count=R,
                normr_act=R, exec=R)
+    if fused:
+        out.update(q=P, alpha=R, fresh=R)
     if trace:
         out["trace"] = trace_specs(R)
     return out
@@ -104,22 +142,32 @@ def refine_tol(tolb, normr, inner_tol):
                     inner_tol, 0.25).astype(jnp.float32)
 
 
-def select_best(ops: Ops, data: dict, fext: jnp.ndarray, carry: dict):
+def select_best(ops: Ops, data: dict, fext: jnp.ndarray, carry: dict,
+                always_min: bool = False):
     """Min-residual fallback for a terminally-failed resumable solve.
 
     The ``return_carry`` path of ``pcg`` skips MATLAB pcg's min-residual
     finalize (it would cost one matvec + psum per dispatch whose result the
     resuming caller discards); the driver applies this once, at actual
-    termination.  Returns (x, relres) matching finalize_bad's semantics."""
+    termination.  Returns (x, relres) matching finalize_bad's semantics.
+
+    ``always_min`` (the fused variant): the carry ``x`` is the
+    pipelined-lag fresh iterate whose residual was never evaluated and
+    ``normr_act`` belongs to its predecessor, so the MATLAB
+    last-vs-min comparison has no honest operand pair — return the
+    min-residual iterate with its recomputed true residual
+    unconditionally (an internally consistent (x, relres) pair)."""
     eff = data["eff"]
     w = data["weight"] * eff
     n2b = jnp.sqrt(ops.wdot(w, fext, fext))
     r_min = fext - eff * ops.matvec(data, carry["xmin"])
     normr_min = jnp.sqrt(ops.wdot(w, r_min, r_min))
+    den = jnp.maximum(n2b, jnp.asarray(np.finfo(np.float32).tiny, n2b.dtype))
+    if always_min:
+        return carry["xmin"], normr_min / den
     use_min = normr_min < carry["normr_act"]
     x = jnp.where(use_min, carry["xmin"], carry["x"])
-    relres = jnp.where(use_min, normr_min, carry["normr_act"]) / jnp.maximum(
-        n2b, jnp.asarray(np.finfo(np.float32).tiny, n2b.dtype))
+    relres = jnp.where(use_min, normr_min, carry["normr_act"]) / den
     return x, relres
 
 
@@ -146,9 +194,19 @@ def pcg(
     progress_min_gain: float = 30.0,
     trace_in: Optional[dict] = None,
     trace_scale=None,
+    variant: str = "classic",
 ):
     """Returns PCGResult, or (PCGResult, carry) with ``return_carry``, or
     (PCGResult, trace) when tracing is on without ``return_carry``.
+
+    ``variant`` selects the loop formulation (``VALID_PCG_VARIANTS``):
+    "classic" is the MATLAB-compatible 3-reduction body below; "fused"
+    the Chronopoulos–Gear single-reduction recurrence (module
+    docstring).  Both share the carry schema (``cold_carry`` /
+    ``carry_part_specs`` with the matching ``fused`` flag), the flag
+    taxonomy, the deferred true-residual check, the trace ring and the
+    resumable-dispatch contract — a sequence of capped fused calls is
+    bit-identical to one long fused solve, exactly like classic.
 
     ``trace_in`` (an ``obs/trace.py`` ring dict) enables in-graph
     convergence tracing: each committed iteration appends
@@ -200,6 +258,10 @@ def pcg(
     solve — the dispatch-chunked driver path relies on this.  When given,
     it overrides ``x0`` and the initial-residual matvec.
     """
+    if variant not in VALID_PCG_VARIANTS:
+        raise ValueError(f"pcg variant must be one of "
+                         f"{VALID_PCG_VARIANTS}, got {variant!r}")
+    fused = variant == "fused"
     warm = carry_in is not None
     if warm and "trace" in carry_in:
         # resumable dispatch: the ring continues from the previous call
@@ -236,7 +298,14 @@ def pcg(
         normr0 = jnp.sqrt(ops.wdot(w, r0, r0))
 
     zero_rhs = n2b == 0
-    initial_ok = normr0 <= tolb
+    if fused and warm:
+        # the warm fused normr0 is the PREDECESSOR iterate's norm (the
+        # pipelined lag): never flag-0 the unevaluated resumed iterate
+        # off it — the first trip reduces the fresh norm and the
+        # deferred check gates flag 0 on a true residual as usual
+        initial_ok = jnp.asarray(False)
+    else:
+        initial_ok = normr0 <= tolb
 
     carry0 = dict(
         x=x0,
@@ -267,18 +336,36 @@ def pcg(
         # exit, so it never rides the exported resume carry
         mode=jnp.asarray(0, jnp.int32),
     )
+    if fused:
+        # Chronopoulos–Gear state (see cold_carry): cold values make the
+        # first trip the textbook first CG step; warm values continue
+        # the recurrence exactly across dispatch boundaries.
+        carry0["q"] = carry_in["q"] if warm else jnp.zeros_like(x0)
+        carry0["alpha"] = (carry_in["alpha"] if warm
+                           else jnp.asarray(np.inf, ops.dot_dtype))
+        carry0["fresh"] = (carry_in["fresh"] if warm
+                           else jnp.asarray(1, jnp.int32))
     if traced:
         carry0["trace"] = trace0
 
     def cond(c):
         return (c["flag"] == 1) & (c["i"] < max_iter)
 
-    def _resolve(c, x, r, p, rho, stag, normr_act, candidate, i):
+    def _resolve(c, x, r, p, rho, stag, normr_act, candidate, i,
+                 extra=None, record=None):
         """Shared iteration epilogue (reference pcg_solver.py:536-562):
         stag reset / MoreSteps / min-residual / plateau bookkeeping and
         the flag decision, with ``candidate`` marking a true-residual
         check (then ``normr_act`` is the recomputed actual residual
-        norm, else the recurrence norm)."""
+        norm, else the recurrence norm).  ``extra`` overrides/extends
+        the output carry entries AFTER the bookkeeping — the fused body
+        uses it to track the min residual against the lagged iterate
+        ``x`` while committing the freshly-updated vectors (and its
+        q/alpha/fresh recurrence state) to the carry.  ``record`` (a
+        traced bool, default always-on) gates the trace-ring append:
+        the fused trip after a FAILED true-residual check resolves the
+        same iterate a second time and must not write a duplicate
+        slot."""
         converged = candidate & (normr_act <= tolb)
         # not converged on candidate: stag reset + MoreSteps bookkeeping
         # (reference pcg_solver.py:544-552)
@@ -342,13 +429,22 @@ def pcg(
             win_start=win_start, win_count=win_count,
             mode=jnp.asarray(0, jnp.int32),
         )
+        if extra:
+            out.update(extra)
         if traced:
             # each committed iteration reaches _resolve exactly once
             # (immediately, or via the deferred mode-1 check with the TRUE
-            # residual norm) — one ring slot per iteration
-            out["trace"] = trace_record(
+            # residual norm) — one ring slot per iteration; the fused
+            # body's re-resolve after a failed check sets record=False
+            rec_tr = trace_record(
                 c["trace"], normr=normr_act, rho=rho, stag=stag, flag=flag,
                 scale=trace_scale)
+            if record is None:
+                out["trace"] = rec_tr
+            else:
+                out["trace"] = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(record, a, b),
+                    rec_tr, c["trace"])
         return out
 
     def body(c):
@@ -463,7 +559,148 @@ def pcg(
         return jax.lax.cond(is_check, post_check, post_iterate,
                             (c, operand, q, aux))
 
-    c = jax.lax.while_loop(cond, body, carry0)
+    def body_fused(c):
+        """One trip of the fused-collective (Chronopoulos–Gear) variant:
+        z = M^-1.r, w = A.z (still the ONE stencil instantiation per
+        body), then EVERY per-iteration reduction of the classic loop —
+        rho = <r,z>, the p.Ap denominator via mu = <z,w>, the residual
+        norm, the stagnation norms and the inf-preconditioner predicate
+        — in a SINGLE fused psum.  The search direction and its A-image
+        advance by recurrence (p = z + beta*p, q = w + beta*q;
+        <p,Ap> = mu - beta*rho/alpha_prev in exact arithmetic), so no
+        reduction serializes against an axpy.
+
+        Pipelined lag: the reduction reads the residual of the iterate
+        committed LAST trip, so the epilogue (stag / min-residual /
+        candidate detection) resolves that iterate while this trip's
+        update is computed — iteration counts differ from classic by
+        O(1).  Mode 1 is the same deferred true-residual check as
+        classic, but gated by the ``fresh`` carry bit so a failed check
+        always commits an update before re-checking (MATLAB's MoreSteps
+        alternation; without the gate the moresteps>0 clause would
+        re-check the same iterate forever)."""
+        i = c["i"]
+        is_check = c["mode"] == 1
+
+        def pre_iterate(c):
+            # scalar or block-Jacobi inverse (classic pre_iterate's z)
+            return ops.apply_prec(inv_diag, c["r"])
+
+        def pre_check(c):
+            return c["x"]
+
+        operand = jax.lax.cond(is_check, pre_check, pre_iterate, c)
+        kop = amul(operand)   # the ONE stencil instantiation in the body
+
+        def post_iterate(args):
+            c, z, wz = args
+            # the inf-preconditioner predicate rides the same collective
+            # (classic fuses it into the rho psum the same way)
+            inf_loc = jnp.any(jnp.isinf(z)).astype(ops.dot_dtype)
+            red = ops.wdots(w, [(c["r"], z), (z, wz),
+                                (c["r"], c["r"]), (c["p"], c["p"]),
+                                (c["x"], c["x"])], extra=[inf_loc])
+            rho, mu = red[0], red[1]
+            normr = jnp.sqrt(red[2])
+            normp, normx = jnp.sqrt(red[3]), jnp.sqrt(red[4])
+            flag2 = red[5] > 0
+
+            # lagged stagnation bookkeeping: the update committed LAST
+            # trip moved x by alpha_prev * p (both ride the carry).  On
+            # a cold start p = 0 and alpha_prev = inf make the product
+            # NaN, which compares False — no increment, as there is no
+            # update to check yet.  MATLAB compares against ||x_old||;
+            # the fused form uses the post-update ||x|| already in the
+            # reduction (an eps-scale test — the variant is documented
+            # non-bit-exact).
+            # fresh == 0 means the CURRENT iterate's epilogue was already
+            # resolved by the preceding (failed) true-residual check —
+            # the same update must not be stag-checked twice, and the
+            # ring must not get a duplicate slot (record below)
+            already = c["fresh"] == 0
+            small = normp * jnp.abs(c["alpha"]) < eps * normx
+            stag = jnp.where(already, c["stag"],
+                             jnp.where(small, c["stag"] + 1,
+                                       0)).astype(jnp.int32)
+            candidate = (((normr <= tolb) | (stag >= max_stag_steps)
+                          | (c["moresteps"] > 0)) & ~already)
+
+            # Chronopoulos–Gear scalars; same breakdown taxonomy as
+            # classic (bad denominator <=0/Inf <=> classic's bad pq —
+            # SPD demands <p,Ap> > 0).  A candidate trip skips them: rho
+            # legitimately collapses as r -> 0, and the true-residual
+            # check decides before a spurious flag 4 can.
+            bad_rho = (rho == 0) | jnp.isinf(rho)
+            beta = rho / c["rho"]
+            bad_beta = (beta == 0) | jnp.isinf(beta)
+            pq = mu - beta * rho / c["alpha"]
+            bad_pq = (pq <= 0) | jnp.isinf(pq)
+            alpha = rho / pq
+            bad_alpha = jnp.isinf(alpha)
+            breakdown = bad_rho | bad_beta | bad_pq | bad_alpha
+            new_flag = jnp.where(flag2, 2,
+                                 jnp.where(breakdown, 4, 1)).astype(jnp.int32)
+
+            def on_break(c):
+                out = dict(c)
+                out["flag"] = new_flag
+                out["iter_out"] = i
+                out["rho"] = rho
+                if traced:
+                    out["trace"] = trace_record(
+                        c["trace"], normr=normr, rho=rho,
+                        stag=stag, flag=new_flag, scale=trace_scale)
+                return out
+
+            def on_continue(c):
+                beta_dt = beta.astype(dt)
+                alpha_dt = alpha.astype(dt)
+                p2 = z + beta_dt * c["p"]        # p = 0 cold => p2 = z
+                q2 = wz + beta_dt * c["q"]       # A.p by recurrence
+                x2 = c["x"] + alpha_dt * p2
+                r2 = c["r"] - alpha_dt * q2
+                # Epilogue of the LAGGED iterate (min residual tracked
+                # against c["x"], whose norm this trip's reduction
+                # computed), while the carry commits the fresh update.
+                resolved = _resolve(
+                    c, x=c["x"], r=c["r"], p=c["p"], rho=rho, stag=stag,
+                    normr_act=normr.astype(ops.dot_dtype),
+                    candidate=jnp.asarray(False), i=i,
+                    extra=dict(x=x2, r=r2, p=p2, q=q2,
+                               alpha=alpha.astype(ops.dot_dtype),
+                               fresh=jnp.asarray(1, jnp.int32)),
+                    record=~already)
+                # Candidate: defer to the next trip's true-residual
+                # check of the CURRENT iterate; nothing is committed.
+                pending = dict(c, stag=stag, iter_out=i,
+                               mode=jnp.asarray(1, jnp.int32))
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(candidate, a, b),
+                    pending, resolved)
+
+            return jax.lax.cond((flag2 | breakdown) & ~candidate,
+                                on_break, on_continue, c)
+
+        def post_check(args):
+            c, _x, kx = args
+            # kx = amul(x): recompute the ACTUAL residual before
+            # declaring convergence (same contract as classic).  ``i``
+            # must not advance (no update was committed on the candidate
+            # trip), and ``fresh`` drops so a failed check cannot
+            # re-fire without an intervening committed update.
+            r_true = fext - kx
+            normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
+            return _resolve(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
+                            stag=c["stag"], normr_act=normr_act,
+                            candidate=jnp.asarray(True), i=i,
+                            extra=dict(q=c["q"], alpha=c["alpha"],
+                                       fresh=jnp.asarray(0, jnp.int32),
+                                       i=i))
+
+        return jax.lax.cond(is_check, post_check, post_iterate,
+                            (c, operand, kop))
+
+    c = jax.lax.while_loop(cond, body_fused if fused else body, carry0)
 
     # ---- finalize (reference pcg_solver.py:566-584): on any non-converged
     # exit return the minimal-residual iterate (MATLAB pcg semantics).
@@ -479,6 +716,13 @@ def pcg(
         # we keep x consistent with the reported numbers instead.)
         r_min = fext - amul(c["xmin"])
         normr_min = jnp.sqrt(ops.wdot(w, r_min, r_min))
+        if fused:
+            # pipelined lag: the carry x is the fresh iterate whose
+            # residual was never evaluated, and normr_act belongs to its
+            # predecessor — the min-residual iterate is the only
+            # candidate with an honest (recomputed) residual, so return
+            # it unconditionally (x/relres/iters stay consistent)
+            return c["xmin"], normr_min / n2b, c["imin"]
         use_min = normr_min < c["normr_act"]
         relres = jnp.where(use_min, normr_min, c["normr_act"]) / n2b
         iters = jnp.where(use_min, c["imin"], c["iter_out"])
@@ -509,10 +753,15 @@ def pcg(
         # Every entry comes out of the while_loop carry (fresh outputs of
         # the traced program), which is what makes the chunked engine's
         # donated-carry dispatch safe (see cold_carry's donation contract).
-        carry = {k: c[k] for k in ("x", "r", "p", "rho", "stag", "moresteps",
-                                   "normrmin", "xmin", "imin", "since_best",
-                                   "best_at_reset", "win_start", "win_count",
-                                   "normr_act")}
+        keys = ["x", "r", "p", "rho", "stag", "moresteps",
+                "normrmin", "xmin", "imin", "since_best",
+                "best_at_reset", "win_start", "win_count", "normr_act"]
+        if fused:
+            # the Chronopoulos–Gear recurrence state resumes like the
+            # rest of the Krylov carry (q = A.p, the previous alpha, and
+            # the update-since-check gate)
+            keys += ["q", "alpha", "fresh"]
+        carry = {k: c[k] for k in keys}
         # Executed body-iteration count for host-side budget accounting
         # (result.iters reports the min-residual index on failure, which
         # would undercount).
@@ -546,8 +795,14 @@ def pcg_mixed(
     progress_ratio: float = 0.7,
     progress_min_gain: float = 30.0,
     trace_in: Optional[dict] = None,
+    variant: str = "classic",
 ) -> PCGResult:
     """Mixed-precision PCG by iterative refinement (TPU performance path).
+
+    ``variant`` selects the inner f32 Krylov loop's formulation
+    (``pcg``'s classic 3-reduction body or the fused Chronopoulos–Gear
+    single-reduction recurrence); the f64 refinement shell is identical
+    either way.
 
     ``trace_in`` (f32 ring dict, obs/trace.py) threads in-graph convergence
     tracing through the f32 inner cycles: recorded norms are rescaled by
@@ -638,6 +893,7 @@ def pcg_mixed(
                 # inner iterations run on r/normr: rescale recorded norms
                 # to absolute residuals
                 trace_scale=normr if traced else None,
+                variant=variant,
             )
             # return_carry skips the min-residual finalize, so inner.x is
             # the LAST iterate.  CG's residual is non-monotone: on a

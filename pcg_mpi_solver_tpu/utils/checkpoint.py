@@ -86,6 +86,12 @@ def _fingerprint(solver) -> dict:
         "dtype": str(np.dtype(solver.dtype)),
         "precision_mode": cfg.solver.precision_mode,
         "precond": cfg.solver.precond,
+        # the PCG loop formulation reshapes the resumable carry pytree
+        # itself (the fused variant rides q/alpha/fresh recurrence
+        # leaves) and changes the iteration sequence — a cross-variant
+        # resume must fail HERE, as a clear fingerprint mismatch, not as
+        # a pytree/in_specs error deep in the shard_map dispatch
+        "pcg_variant": getattr(cfg.solver, "pcg_variant", "classic"),
         "tol": float(cfg.solver.tol),
         "max_iter": int(cfg.solver.max_iter),
         "deltas": [float(d) for d in th.time_step_delta],
@@ -277,6 +283,9 @@ class CheckpointManager:
             # Checkpoints written before the precond field existed can only
             # have come from the scalar-Jacobi path.
             saved.setdefault("precond", "jacobi")
+            # Checkpoints written before the pcg_variant field existed
+            # can only have come from the classic loop.
+            saved.setdefault("pcg_variant", "classic")
             want = _fingerprint(solver)
             # Checkpoints that predate the stencil-form/level-dims fields
             # did not record which formulation/layout produced them (the

@@ -634,3 +634,60 @@ def test_salvage_trims_by_value_not_recency(monkeypatch, tmp_path):
     vs = [json.loads(e["line"])["vs_baseline"] for e in data["lines"]]
     assert len(vs) <= 8
     assert 21.9 in vs, f"flagship line evicted: {vs}"
+
+
+def test_salvage_evicts_age_expired_before_value_trim(monkeypatch,
+                                                      tmp_path):
+    """ADVICE r05 #2: entries older than BENCH_SALVAGE_MAX_AGE_S are
+    unusable by _read_salvage, so they must be evicted FIRST — a stale
+    high-vs_baseline line must never permanently occupy a slot a fresh
+    (usable) lower-value line needs."""
+    import json
+    import time
+
+    from pcg_mpi_solver_tpu import bench as b
+
+    monkeypatch.chdir(tmp_path)
+
+    def line(v, tag):
+        return json.dumps({"metric": "m", "value": v * 1e6, "unit": "u",
+                           "vs_baseline": v,
+                           "detail": {"platform": "tpu", "tag": tag}})
+
+    # fill every slot with stale, unbeatably-high-value entries
+    for i in range(8):
+        b._write_salvage(line(100.0 + i, f"stale{i}"))
+    data = json.load(open(b._SALVAGE_PATH))
+    now = time.time()
+    for e in data["lines"]:
+        e["unix_time"] = now - 2 * 43200        # 2x the default max age
+    with open(b._SALVAGE_PATH, "w") as f:
+        json.dump(data, f)
+
+    # a fresh, modest line must displace them all (they can never be
+    # read again), not lose the value-based trim to them
+    b._write_salvage(line(1.5, "fresh"))
+    data = json.load(open(b._SALVAGE_PATH))
+    tags = [json.loads(e["line"])["detail"]["tag"] for e in data["lines"]]
+    assert tags == ["fresh"], tags
+    got = json.loads(b._read_salvage())
+    assert got["detail"]["tag"] == "fresh"
+
+
+def test_emitter_explicit_line_persists_even_after_watchdog_emit(
+        monkeypatch, tmp_path):
+    """ADVICE r05 #1: when the watchdog emitted first (done=True), main's
+    fresh measured-live emit(line) must STILL persist the line to the
+    salvage file — the done check only suppresses the duplicate stdout
+    print, never the persist."""
+    import json
+
+    from pcg_mpi_solver_tpu import bench as b
+
+    monkeypatch.chdir(tmp_path)
+    em = b._Emitter("init")
+    assert em.emit() is True                # the watchdog won the race
+    fresh = _live_line(3.0)
+    assert em.emit(fresh) is False          # stdout stays single-line...
+    data = json.load(open(b._SALVAGE_PATH))
+    assert [e["line"] for e in data["lines"]] == [fresh]  # ...persisted

@@ -1,0 +1,41 @@
+"""Static collective-count contract of the PCG loop body
+(tools/check_collectives.py): the fused Chronopoulos–Gear variant must
+run exactly ONE scalar-reduction psum per iteration, and classic must
+keep its documented three — a regression here silently re-serializes
+the hot loop and only shows up as ms/iteration in a scarce hardware
+window."""
+
+from tools.check_collectives import (
+    EXPECTED_BODY_PSUMS, iteration_psum_count, run_checks)
+
+
+def test_documented_psum_counts_hold():
+    """classic = 5 body psums (iface + rho/inf + pq + 3-norm + deferred
+    check), fused = 3 (iface + THE fused reduction + deferred check)."""
+    assert run_checks() == []
+
+
+def test_fused_saves_exactly_two_psums():
+    classic = iteration_psum_count("classic")
+    fused = iteration_psum_count("fused")
+    assert classic == EXPECTED_BODY_PSUMS["classic"]
+    assert fused == EXPECTED_BODY_PSUMS["fused"]
+    assert fused == classic - 2
+
+
+def test_comm_estimate_gauges_match_the_claim():
+    """Ops.comm_estimate (the telemetry gauge source) must advertise the
+    same per-iteration psum counts the traced bodies prove: classic
+    3 scalar psums + iface, fused 1 + iface."""
+    import dataclasses
+
+    from pcg_mpi_solver_tpu.ops.matvec import Ops
+
+    ops = Ops(n_loc=8, n_iface=4)
+    assert ops.comm_estimate()["psums_per_iter"] == 4
+    assert ops.comm_estimate(variant="fused")["psums_per_iter"] == 2
+    assert ops.comm_estimate(variant="fused")["pcg_variant"] == "fused"
+    # no interface (single part): the matvec psum disappears either way
+    ops1 = dataclasses.replace(ops, n_iface=0)
+    assert ops1.comm_estimate()["psums_per_iter"] == 3
+    assert ops1.comm_estimate(variant="fused")["psums_per_iter"] == 1

@@ -74,3 +74,23 @@ def test_run_step_ok_rcs_verdict_exits(tmp_path, monkeypatch):
     hw_session.run_step(str(log), "verdict step strict", [str(v)],
                         timeout=30, gate_s=0)
     assert hw_session._last_step_ok is False
+
+
+def test_parse_ab_missing_marker_or_file_returns_none(tmp_path):
+    """ADVICE r05 #3: a missing marker (step died before its section
+    header) or an unreadable log must not raise out of _parse_ab — the
+    remaining independent steps of a scarce hardware window continue,
+    and maybe_engage_flagship's None gate keeps the engage closed."""
+    from tools.hw_v9_ab import _parse_ab, maybe_engage_flagship
+
+    log = tmp_path / "log.txt"
+    log.write_text("window opened; step never started\n")
+    assert _parse_ab(str(log), "=== matvec A/B v9: ") == (None, None)
+    # the anomaly breadcrumb landed in the log instead of an exception
+    assert "parse anomaly" in log.read_text()
+
+    missing = tmp_path / "never_written.txt"
+    assert _parse_ab(str(missing), "=== whatever: ") == (None, None)
+
+    # downstream: no v9 number => no engaged flagship run (and no crash)
+    assert maybe_engage_flagship(str(log), None, None) is False
