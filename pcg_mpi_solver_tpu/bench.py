@@ -80,7 +80,11 @@ BENCH_OT_LADDER, BENCH_CPU_FALLBACK, BENCH_REF_TIMEOUT_S,
 BENCH_WALL_BUDGET_S, BENCH_PROV_NX, BENCH_PROVISIONAL (internal:
 marks the fast-fallback subprocess), BENCH_CPU_UPGRADE,
 BENCH_UPGRADE_NX/BENCH_UPGRADE_MODE/BENCH_UPGRADE_DTYPE, BENCH_SALVAGE,
-BENCH_SALVAGE_MAX_AGE_S, BENCH_PLATEAU (mixed-mode inner
+BENCH_SALVAGE_MAX_AGE_S, BENCH_NRHS (batched multi-RHS block width: the
+timed leg solves an nrhs-wide block of the reference load via
+Solver.solve_many and the line carries detail.nrhs +
+detail.dof_iter_rhs_per_s — the nrhs ∈ {1, 4, 16} A/B for a hardware
+window), BENCH_PLATEAU (mixed-mode inner
 plateau-exit window, 0=off), BENCH_PCG_VARIANT (classic|fused PCG loop
 formulation — the classic-vs-fused ms/iteration A/B knob; the engaged
 variant is reported in detail.pcg_variant); plus the solver-level performance knobs
@@ -390,6 +394,13 @@ def _run_config_extra(solver, dtype, mode, pallas_on, n_parts, t_part,
         # form knob; the stencil ops PIN it at construction
         "matvec_form": getattr(solver.ops, "form", "n/a"),
         "combine": getattr(solver.ops, "combine", "n/a"),
+        # batched multi-RHS A/B field: the SolverConfig.nrhs block width
+        # this round solved (BENCH_NRHS sets it at cfg build) —
+        # schema-validated (obs/schema.BENCH_DETAIL_NUMERIC) and present
+        # on the insurance/salvage lines too, so an interrupted window
+        # still records which width it was measuring
+        "nrhs": int(getattr(getattr(getattr(solver, "config", None),
+                                    "solver", None), "nrhs", 1) or 1),
         "n_parts": n_parts,
         "partition_s": round(t_part, 2),
         "platform": platform,
@@ -437,6 +448,15 @@ def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
         "ref_measured_on": ref_note,
     }
     detail.update(extra)
+    # batched-throughput field: dof*iter*rhs/s — equals the primary value
+    # at nrhs=1, and shows the batched-matvec amortization at nrhs>1 (the
+    # primary metric stays the per-column rate for cross-round
+    # comparability).  Emitted on EVERY line (incl. salvage/insurance,
+    # which share this function) so the next hardware window can A/B
+    # nrhs in one queue entry.
+    nrhs = int(detail.get("nrhs", 1) or 1)
+    detail["nrhs"] = nrhs
+    detail["dof_iter_rhs_per_s"] = round(dof_iters_per_sec * nrhs, 1)
     detail["phases"] = {k: round(v["total_s"], 3)
                        for k, v in _REC.span_stats().items()}
     return json.dumps({
@@ -481,6 +501,11 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
                             # windows (fused = one collective/iteration)
                             pcg_variant=os.environ.get(
                                 "BENCH_PCG_VARIANT", "classic"),
+                            # batched multi-RHS block width: the timed
+                            # leg solves this many load cases at once
+                            # (Solver.solve_many)
+                            nrhs=int(os.environ.get("BENCH_NRHS", "1")
+                                     or 1),
                             mixed_plateau_window=int(
                                 os.environ.get("BENCH_PLATEAU", 0)),
                             **solver_kw),
@@ -594,6 +619,12 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
         # labeled as such; the timed line displaces it at equal rank.
         warm_extra = dict(
             run_extra,
+            # the warm solve is the SCALAR step: its line must report
+            # the measured width (1), never fabricate nrhs-x batched
+            # throughput that was never run; the configured sweep width
+            # stays visible as nrhs_planned
+            nrhs=1,
+            nrhs_planned=run_extra.get("nrhs", 1),
             timing="warm (first solve; wall incl. compile/start "
                    "overhead — conservative)",
             baseline_source="validated-constant",
@@ -613,9 +644,40 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
     # fallback chain — the round artifact then records both the number
     # and WHY the timed leg is missing.
     s.reset_state()
+    nrhs = int(getattr(cfg.solver, "nrhs", 1) or 1)
     try:
-        with _REC.span("timed_solve", emit=True):
-            r1 = s.step(1.0)
+        if nrhs > 1:
+            # Batched multi-RHS leg (BENCH_NRHS -> SolverConfig.nrhs):
+            # solve an nrhs-wide
+            # block of the reference load against the SAME warm
+            # operator (Solver.solve_many — one lockstep Krylov loop,
+            # collective count independent of nrhs).  A warm blocked
+            # solve first so the timed one pays no blocked-program
+            # compile, mirroring the scalar warm/timed split.
+            from pcg_mpi_solver_tpu.solver.driver import StepResult
+
+            fblk = np.repeat(np.asarray(model.F)[:, None], nrhs, axis=1)
+            with _REC.span("warm_solve_many", emit=True):
+                s.solve_many(fblk)
+            with _REC.span("timed_solve", emit=True):
+                mres = s.solve_many(fblk)
+            # solve_wall_s excludes the per-call host rhs staging
+            # (validate + global->local map + upload): the scalar
+            # baseline's step() derives fext in-graph from device data,
+            # so the blocked A/B number must not absorb PCIe/host cost
+            # the classic leg never pays
+            r1 = StepResult(flag=int(mres.flags.max(initial=0)),
+                            relres=float(mres.relres.max(initial=0.0)),
+                            iters=int(mres.iters.max(initial=0)),
+                            wall_s=mres.solve_wall_s)
+            _log(f"# timed blocked solve: nrhs={nrhs} "
+                 f"flags={mres.flags.tolist()} "
+                 f"iters={mres.iters.tolist()} wall={r1.wall_s:.3f}s "
+                 f"(+{mres.wall_s - mres.solve_wall_s:.3f}s rhs staging, "
+                 "excluded)")
+        else:
+            with _REC.span("timed_solve", emit=True):
+                r1 = s.step(1.0)
     except Exception as e:                              # noqa: BLE001
         _offer_failed_salvage(
             emitter, model, kind, r0, run_extra,
@@ -643,6 +705,10 @@ def _offer_failed_salvage(emitter, model, kind, r0, extra, reason):
         model, kind, r0, max(r0.iters, 1), VALIDATED_REF_NS_PER_DOF_ITER,
         _VALIDATED_NOTE,
         dict(extra, failed=True, fail_reason=reason,
+             # the salvaged numbers come from the SCALAR warm solve —
+             # report the measured width (1), keep the planned sweep
+             # width visible instead of fabricating batched throughput
+             nrhs=1, nrhs_planned=extra.get("nrhs", 1),
              timing="warm (timed solve failed; wall incl. compile/start "
                     "overhead — conservative)",
              baseline_source="validated-constant"))

@@ -117,6 +117,7 @@ def step_cache_key(*, abstract: Any, mesh: Any, backend: str,
                    glob_n_dof_eff: int, donate: bool,
                    jax_version: str,
                    pcg_variant: str = "classic",
+                   nrhs: int = 1,
                    extra: Optional[Dict[str, Any]] = None) -> str:
     """Key for one AOT-exported PCG step program: the ABSTRACT signature
     (shapes/dtypes/shardings repr), the mesh layout, and every scalar the
@@ -127,7 +128,12 @@ def step_cache_key(*, abstract: Any, mesh: Any, backend: str,
     structural component on top of the solver dict: the classic and
     fused loop bodies are different programs with different carry
     pytrees, and an AOT/compile-cache hit across variants would
-    deserialize the wrong one."""
+    deserialize the wrong one.  ``nrhs`` is the same kind of structural
+    component for the batched multi-RHS programs (solve_many): the
+    blocked body's carry pytree and every vector shape differ per block
+    width, so programs of different nrhs must never collide (the
+    abstract signature already separates them — the explicit key field
+    makes the invariant survive any signature-repr change)."""
     return _digest({
         "kind": "aot-step",
         "abstract": abstract,
@@ -135,6 +141,7 @@ def step_cache_key(*, abstract: Any, mesh: Any, backend: str,
         "backend": backend,
         "solver": solver,
         "pcg_variant": str(pcg_variant),
+        "nrhs": int(nrhs),
         "trace_len": int(trace_len),
         "glob_n_dof_eff": int(glob_n_dof_eff),
         "donate": bool(donate),

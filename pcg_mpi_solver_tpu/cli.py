@@ -6,6 +6,7 @@ orchestrated by examples/run_basic_script.bash) as one typed CLI.
     pcg-tpu partition <scratch> <n_parts>              # element->part map
     pcg-tpu validate  <scratch> [--preflight=]         # preflight checks only
     pcg-tpu solve     <scratch> <run_id> [options]     # SPMD PCG solve
+    pcg-tpu solve-many <scratch> <run_id> [options]    # batched multi-RHS solve
     pcg-tpu dynamics  <scratch> <run_id> [options]     # explicit time history
     pcg-tpu newmark   <scratch> <run_id> [options]     # implicit time history
     pcg-tpu export    <scratch> <run_id> <vars> <mode> # frames -> .vtu
@@ -250,6 +251,82 @@ def cmd_solve(args):
               f"wall={r.wall_s:.2f}s")
     td = s.time_data()
     print(f">calculation time: {td['Mean_CalcTime']:.2f} sec")
+    _finish_telemetry(s, args)
+    print(">success!")
+
+
+def cmd_solve_many(args):
+    """Batched multi-RHS solve: a LIST of load cases against one shared
+    partitioned operator (Solver.solve_many — the multi-tenant solve
+    path).  The block comes from ``--rhs loads.npy`` ((n_dof, nrhs) or
+    (nrhs, n_dof)) or ``--scales "1.0,0.5,2.0"`` (columns = scale *
+    model reference load F); each column is validated per request
+    (validate.check_rhs_block names the offending column) on top of the
+    construction-time preflight.  One Krylov loop solves all columns
+    lockstep — converged columns freeze, per-iteration collective count
+    independent of the block width — and per-RHS flags/relres/iters are
+    printed and emitted as `rhs_solve` telemetry events."""
+    from pcg_mpi_solver_tpu.models.mdf import read_mdf
+    from pcg_mpi_solver_tpu.solver.driver import Solver, normalize_rhs_block
+
+    cfg = _load_settings(args.settings, args)
+    cfg.scratch_path = args.scratch
+    cfg.run_id = args.run_id
+    cfg.snapshot_every = int(args.snapshot_every or 0)
+    if args.max_recoveries is not None:
+        cfg.solver.max_recoveries = int(args.max_recoveries)
+        # the knob must not pretend to do something it doesn't (the
+        # breakdown ladder rides the scalar paths only; blocked columns
+        # fall back to their per-column min-residual iterate)
+        print(">note: --max-recoveries does not yet apply to blocked "
+              "solves — the recovery ladder is a scalar-path feature; "
+              "failed columns return their min-residual iterate")
+    model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    if args.rhs:
+        # the ONE shape heuristic lives in normalize_rhs_block (shared
+        # with Solver.solve_many) — the CLI only needs the width early
+        # for the config/telemetry stamp, so this is the shape-only pass
+        # (no dtype: the transpose is a view, no full-block copy;
+        # solve_many converts once to the solve dtype)
+        fb = normalize_rhs_block(np.load(args.rhs), model.n_dof)
+    elif args.scales:
+        try:
+            scales = [float(v) for v in args.scales.split(",")
+                      if v.strip()]
+        except ValueError:
+            raise SystemExit(f"solve-many: --scales {args.scales!r} is "
+                             "not a comma-separated list of numbers")
+        if not scales:
+            raise SystemExit("solve-many: --scales parsed to zero load "
+                             "cases; pass e.g. --scales \"1.0,0.5\"")
+        fb = np.stack([np.asarray(model.F) * sc for sc in scales], axis=-1)
+    else:
+        raise SystemExit("solve-many: pass --rhs FILE.npy (columns = load "
+                         "cases) or --scales \"1.0,0.5,...\"")
+    cfg.solver.nrhs = fb.shape[1]
+    n_parts, elem_part, n_dev, n_dev_used = _resolve_partition_mesh(
+        args.n_parts, args.scratch)
+
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+
+    print(f">solving {fb.shape[1]} load cases on {n_dev_used}/{n_dev} "
+          f"device(s), {n_parts} parts "
+          f"({cfg.solver.precision_mode} precision, "
+          f"{cfg.solver.pcg_variant} variant)..")
+    s = Solver(model, cfg, mesh=make_mesh(n_dev_used), n_parts=n_parts,
+               elem_part=elem_part, backend=args.backend)
+    print(f">backend: {s.backend}  setup: {s.setup_s:.2f}s "
+          f"({s.setup_cache} partition)")
+    res = s.solve_many(fb, resume=bool(args.resume))
+    for j in range(res.nrhs):
+        print(f">rhs {j}: flag={int(res.flags[j])} "
+              f"iters={int(res.iters[j])} relres={res.relres[j]:.3e}")
+    print(f">block wall: {res.wall_s:.2f}s ({res.nrhs} load cases, "
+          f"one operator)")
+    out = os.path.join(cfg.result_path, "u_many")
+    os.makedirs(cfg.result_path, exist_ok=True)
+    np.save(out, s.displacement_global_many(res.x))
+    print(f">solutions (n_dof, nrhs) -> {out}.npy")
     _finish_telemetry(s, args)
     print(">success!")
 
@@ -542,6 +619,35 @@ def main(argv=None):
     _add_cache_flag(p)
     _add_preflight_flag(p)
     p.set_defaults(fn=cmd_solve)
+
+    p = sub.add_parser("solve-many",
+                       help="batched multi-RHS solve: many load cases "
+                            "against one shared partitioned operator "
+                            "(per-RHS convergence masks; collective "
+                            "count independent of the block width)")
+    p.add_argument("scratch")
+    p.add_argument("run_id")
+    p.add_argument("--rhs", default=None, metavar="FILE.npy",
+                   help="load-case block: (n_dof, nrhs) array, one "
+                        "column per case ((nrhs, n_dof) is transposed)")
+    p.add_argument("--scales", default=None, metavar="S0,S1,...",
+                   help="alternative block: columns = scale * the "
+                        "model's reference load F")
+    p.add_argument("--settings", default=None)
+    p.add_argument("--n-parts", type=int, default=None)
+    p.add_argument("--tol", type=float, default=None)
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--precision", choices=["direct", "mixed"], default=None)
+    p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    _add_variant_flag(p)
+    p.add_argument("--backend",
+                   choices=["auto", "structured", "hybrid", "general"],
+                   default="auto")
+    _add_resilience_flags(p, "blocked-solve chunk boundaries")
+    _add_telemetry_flags(p)
+    _add_cache_flag(p)
+    _add_preflight_flag(p)
+    p.set_defaults(fn=cmd_solve_many)
 
     p = sub.add_parser("validate",
                        help="run the validate/ preflight checks against "

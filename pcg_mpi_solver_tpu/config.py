@@ -77,6 +77,22 @@ class SolverConfig:
     #               pcg_variant".  CLI: --pcg-variant; bench:
     #               BENCH_PCG_VARIANT.
     pcg_variant: str = "classic"
+    # Default RHS-block width for batched multi-RHS solves
+    # (Solver.solve_many / `pcg-tpu solve-many` / bench BENCH_NRHS): the
+    # number of load cases solved together against ONE shared partitioned
+    # operator, with a per-RHS convergence mask in the while-loop
+    # predicate (solver/pcg.pcg_many).  The per-type element matmul
+    # batches to (d x d) @ (d x N_elem x nrhs) — higher MXU utilization
+    # at near-constant memory traffic — and the per-iteration collective
+    # COUNT is independent of nrhs (payloads widen instead; statically
+    # proven by tools/check_collectives.py).  Memory cost: the Krylov
+    # carry holds ~5 blocked vectors, so HBM grows ~linearly in nrhs.
+    # 1 = the scalar paths are untouched.  Consumers: bench.py's timed
+    # leg (BENCH_NRHS sets it) solves an nrhs-wide block, and `pcg-tpu
+    # solve-many` stamps the request width here so AOT cache keys /
+    # snapshot fingerprints / telemetry record it; the block actually
+    # passed to Solver.solve_many always defines the executed width.
+    nrhs: int = 1
     # Preconditioner: "jacobi" (scalar diag(K)^-1 — the reference's only
     # choice, pcg_solver.py:346-352) or "block3" (assembled 3x3 node-block
     # Jacobi, inverted per node — stronger on vector-valued elasticity;

@@ -70,6 +70,12 @@ EVENT_KINDS: Dict[str, tuple] = {
     "preflight": ("policy", "failed", "checks"),
     # end-of-step ladder summary (emitted only when recoveries happened)
     "recovery_done": ("flag", "attempts", "actions"),
+    # one batched multi-RHS solve (Solver.solve_many): block width,
+    # wall, per-column flags
+    "solve_many": ("nrhs", "wall_s", "flags"),
+    # per-RHS outcome of a batched solve — one event per column/tenant,
+    # carrying the rhs (column) index
+    "rhs_solve": ("rhs", "flag", "relres", "iters"),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }
@@ -79,8 +85,15 @@ BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 # Optional ``detail`` fields with a typed contract WHEN present (absent in
 # pre-warm-path lines — committed BENCH_r0*.json stay valid).  Numeric-or-
 # null: ``time_to_first_iter_s`` is null when no device dispatch happened
-# (e.g. a solve that failed before its first jitted call).
-BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s")
+# (e.g. a solve that failed before its first jitted call).  ``nrhs`` /
+# ``dof_iter_rhs_per_s`` are the batched multi-RHS A/B fields
+# (BENCH_NRHS): the MEASURED block width of the line's numbers and the
+# dof*iter*rhs/s throughput.  Scalar-solve lines (warm insurance,
+# salvage) report nrhs=1 with the configured sweep width preserved under
+# ``nrhs_planned`` — a line must never fabricate batched throughput that
+# was not run.
+BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
+                        "nrhs_planned", "dof_iter_rhs_per_s")
 # ``setup_cache``: warm-path partition attribution (cache/ subsystem).
 BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
 

@@ -152,7 +152,22 @@ class Ops:
     # -- interface assembly --------------------------------------------
     def _assemble_shared(self, y, local, slot, n_glob):
         """Sum partial values of ids shared by several parts: scatter into a
-        global shared-id vector, ONE psum, gather back.  y: (P, n)."""
+        global shared-id vector, ONE psum, gather back.  y: (P, n) or, with
+        a trailing RHS-block axis, (P, n, R) — the psum payload widens to
+        (n_glob, R) but the collective COUNT stays one either way (the
+        batched-solve contract, tools/check_collectives.py)."""
+        if y.ndim == 3:
+            R = y.shape[-1]
+            vals = jnp.take_along_axis(y, local[:, :, None], axis=1,
+                                       mode="fill", fill_value=0)
+            glob = jnp.zeros((n_glob, R), y.dtype)
+            glob = glob.at[slot.reshape(-1)].add(
+                vals.reshape(-1, R), mode="drop")
+            glob = self._psum(glob)
+            new = glob.at[slot].get(mode="fill", fill_value=0)
+            return jax.vmap(
+                lambda yp, loc, nv: yp.at[loc].set(nv, mode="drop"))(
+                y, local, new)
         vals = jnp.take_along_axis(y, local, axis=1, mode="fill", fill_value=0)
         glob = jnp.zeros((n_glob,), y.dtype)
         glob = glob.at[slot.reshape(-1)].add(vals.reshape(-1), mode="drop")
@@ -186,80 +201,122 @@ class Ops:
     # pad row per part keeps all padded indices in bounds.
 
     def _gather_u3(self, x: jnp.ndarray, blk: dict) -> jnp.ndarray:
-        """x (P, n_loc) -> gathered node rows (P, nn, N, 3)."""
+        """x (P, n_loc[, R]) -> gathered node rows (P, nn, N, 3[, R]).
+        The RHS-block axis rides the gathered row as extra minor dims —
+        same single flat row gather, wider rows."""
         node = blk["node"]                                   # (P, nn, N)
         Pn, nn, N = node.shape
         nr = self.n_node_loc + 1
-        x3 = x.reshape(Pn, self.n_node_loc, 3)
-        x3p = jnp.concatenate([x3, jnp.zeros((Pn, 1, 3), x3.dtype)],
-                              axis=1).reshape(Pn * nr, 3)
+        tail = x.shape[2:]                                   # () or (R,)
+        x3 = x.reshape((Pn, self.n_node_loc, 3) + tail)
+        x3p = jnp.concatenate(
+            [x3, jnp.zeros((Pn, 1, 3) + tail, x3.dtype)],
+            axis=1).reshape((Pn * nr, 3) + tail)
         offs = (jnp.arange(Pn, dtype=jnp.int32) * nr)[:, None, None]
         u3 = jnp.take(x3p, (node + offs).reshape(-1), axis=0, mode="clip")
-        return u3.reshape(Pn, nn, N, 3)
+        return u3.reshape((Pn, nn, N, 3) + tail)
 
     def _gather_u(self, data: dict, x: jnp.ndarray, blk: dict) -> jnp.ndarray:
-        """x (P, n_loc) -> element dof values (P, d, N)."""
+        """x (P, n_loc[, R]) -> element dof values (P, d, N[, R])."""
         if self.use_node_ell:
             u3 = self._gather_u3(x, blk)
-            Pn, nn, N, _ = u3.shape
+            Pn, nn, N = u3.shape[:3]
             # row (a, n, c) -> dof row 3a+c of column n
+            if u3.ndim == 5:
+                return u3.transpose(0, 1, 3, 2, 4).reshape(
+                    Pn, 3 * nn, N, u3.shape[4])
             return u3.transpose(0, 1, 3, 2).reshape(Pn, 3 * nn, N)
+        if x.ndim == 3:
+            return jnp.take_along_axis(
+                x[:, None, :, :], blk["dof"][:, :, :, None], axis=2,
+                mode="fill", fill_value=0)
         return jnp.take_along_axis(x[:, None, :], blk["dof"], axis=2,
                                    mode="fill", fill_value=0)
 
     def _scatter_rows(self, data: dict, rows) -> jnp.ndarray:
-        """Per-block (P, nn*N, 3) value rows -> local dof sums (P, n_loc)
-        via the ELL map: one row gather + row-sum, no scatter-add."""
-        flat3 = jnp.concatenate(rows, axis=1)                # (P, NCn, 3)
-        Pn, ncn, _ = flat3.shape
+        """Per-block (P, nn*N, 3[, R]) value rows -> local dof sums
+        (P, n_loc[, R]) via the ELL map: one row gather + row-sum, no
+        scatter-add."""
+        flat3 = jnp.concatenate(rows, axis=1)                # (P, NCn, 3[, R])
+        Pn, ncn = flat3.shape[:2]
+        tail = flat3.shape[3:]
         flat3p = jnp.concatenate(
-            [flat3, jnp.zeros((Pn, 1, 3), flat3.dtype)],
-            axis=1).reshape(Pn * (ncn + 1), 3)
+            [flat3, jnp.zeros((Pn, 1, 3) + tail, flat3.dtype)],
+            axis=1).reshape((Pn * (ncn + 1), 3) + tail)
         ell = data["ell"]                                    # (P, n_node_loc, K)
         offs = (jnp.arange(Pn, dtype=jnp.int32) * (ncn + 1))[:, None, None]
         g = jnp.take(flat3p, (ell + offs).reshape(-1), axis=0, mode="clip")
-        y3 = g.reshape(Pn, self.n_node_loc, -1, 3).sum(axis=2)
-        return y3.reshape(Pn, self.n_loc)
+        y3 = g.reshape((Pn, self.n_node_loc, -1, 3) + tail).sum(axis=2)
+        return y3.reshape((Pn, self.n_loc) + tail)
 
     def _scatter_blocks(self, data: dict, per_block_v) -> jnp.ndarray:
-        """Per-block element values [(P, d, N)] -> local dof sums (P, n_loc)."""
+        """Per-block element values [(P, d, N[, R])] -> local dof sums
+        (P, n_loc[, R])."""
         if self.use_node_ell:
             rows = []
             for v in per_block_v:
-                Pn, d, N = v.shape
+                Pn, d, N = v.shape[:3]
                 nn = d // 3
                 # dof row 3a+c -> value row a*N+n, component c
-                rows.append(v.reshape(Pn, nn, 3, N).transpose(0, 1, 3, 2)
-                            .reshape(Pn, nn * N, 3))
+                if v.ndim == 4:
+                    rows.append(
+                        v.reshape(Pn, nn, 3, N, v.shape[3])
+                        .transpose(0, 1, 3, 2, 4)
+                        .reshape(Pn, nn * N, 3, v.shape[3]))
+                else:
+                    rows.append(v.reshape(Pn, nn, 3, N)
+                                .transpose(0, 1, 3, 2)
+                                .reshape(Pn, nn * N, 3))
             return self._scatter_rows(data, rows)
         flat = jnp.concatenate(
-            [v.reshape(v.shape[0], -1) for v in per_block_v], axis=1)
+            [v.reshape((v.shape[0], -1) + v.shape[3:]) for v in per_block_v],
+            axis=1)
         return self._scatter(data, flat)
 
     # -- the matvec -----------------------------------------------------
     def matvec_local(self, data: dict, x: jnp.ndarray) -> jnp.ndarray:
-        """Part-local K.x (no cross-part assembly).  x: (P, n_loc)."""
+        """Part-local K.x (no cross-part assembly).  x: (P, n_loc), or
+        (P, n_loc, nrhs) for a RHS block — then every per-type matmul
+        batches over the trailing axis ((d x d) @ (d x N x nrhs): higher
+        MXU utilization at near-constant gather/scatter traffic, the
+        ISSUE-6 batched-SpMV shape) and the result keeps the block axis."""
+        blocked = x.ndim == 3
         if self.use_node_ell:
             rows = []
             for blk in data["blocks"]:
-                u3 = self._gather_u3(x, blk)                 # (P, a, n, c)
-                u3 = jnp.where(blk["sign_nc"], -u3, u3)
-                v = jnp.einsum("bdac,panc->pbnd", blk["Ke4"],
-                               blk["ck"][:, None, :, None] * u3,
-                               precision=self.precision)     # (P, b, n, d)
-                v = jnp.where(blk["sign_nc"], -v, v)
-                Pn, nn, N, _ = v.shape
-                rows.append(v.reshape(Pn, nn * N, 3))
+                u3 = self._gather_u3(x, blk)             # (P, a, n, c[, r])
+                sgn = (blk["sign_nc"][..., None] if blocked
+                       else blk["sign_nc"])
+                u3 = jnp.where(sgn, -u3, u3)
+                ck = blk["ck"][:, None, :, None]
+                if blocked:
+                    v = jnp.einsum("bdac,pancr->pbndr", blk["Ke4"],
+                                   ck[..., None] * u3,
+                                   precision=self.precision)
+                else:
+                    v = jnp.einsum("bdac,panc->pbnd", blk["Ke4"],
+                                   ck * u3,
+                                   precision=self.precision)  # (P, b, n, d)
+                v = jnp.where(sgn, -v, v)
+                Pn, nn, N = v.shape[:3]
+                rows.append(v.reshape((Pn, nn * N, 3) + x.shape[2:]))
             y = self._scatter_rows(data, rows)
         else:
             per_block_v = []
             for blk in data["blocks"]:
-                u = self._gather_u(data, x, blk)             # (P, d, N)
-                u = jnp.where(blk["sign"], -u, u)
-                v = jnp.einsum("de,pen->pdn", blk["Ke"],
-                               blk["ck"][:, None, :] * u,
-                               precision=self.precision)
-                v = jnp.where(blk["sign"], -v, v)
+                u = self._gather_u(data, x, blk)             # (P, d, N[, r])
+                sgn = blk["sign"][..., None] if blocked else blk["sign"]
+                u = jnp.where(sgn, -u, u)
+                ck = blk["ck"][:, None, :]
+                if blocked:
+                    v = jnp.einsum("de,penr->pdnr", blk["Ke"],
+                                   ck[..., None] * u,
+                                   precision=self.precision)
+                else:
+                    v = jnp.einsum("de,pen->pdn", blk["Ke"],
+                                   ck * u,
+                                   precision=self.precision)
+                v = jnp.where(sgn, -v, v)
                 per_block_v.append(v)
             y = self._scatter_blocks(data, per_block_v)
         return self._apply_springs(data, x, y)
@@ -271,11 +328,19 @@ class Ops:
         out-of-bounds ids, so they gather 0 and drop on scatter."""
         if "spr_a" not in data:
             return y
-        xa = jnp.take_along_axis(x, data["spr_a"], axis=1,
-                                 mode="fill", fill_value=0)
-        xb = jnp.take_along_axis(x, data["spr_b"], axis=1,
-                                 mode="fill", fill_value=0)
-        f = data["spr_k"] * (xa - xb)
+        if x.ndim == 3:
+            ia, ib = data["spr_a"][:, :, None], data["spr_b"][:, :, None]
+            xa = jnp.take_along_axis(x, ia, axis=1, mode="fill",
+                                     fill_value=0)
+            xb = jnp.take_along_axis(x, ib, axis=1, mode="fill",
+                                     fill_value=0)
+            f = data["spr_k"][..., None] * (xa - xb)
+        else:
+            xa = jnp.take_along_axis(x, data["spr_a"], axis=1,
+                                     mode="fill", fill_value=0)
+            xb = jnp.take_along_axis(x, data["spr_b"], axis=1,
+                                     mode="fill", fill_value=0)
+            f = data["spr_k"] * (xa - xb)
         return jax.vmap(
             lambda yp, ia, ib, fp: yp.at[ia].add(fp, mode="drop")
                                      .at[ib].add(-fp, mode="drop")
@@ -368,14 +433,15 @@ class Ops:
         return y.reshape(y.shape[0], self.n_node_loc, 3, 3)
 
     def _as_node3(self, v: jnp.ndarray) -> jnp.ndarray:
-        """(P, n_loc) dof vector -> (P, n_node_loc, 3) node rows (the
-        node-contiguous layout; StructuredOps overrides for its
+        """(P, n_loc[, R]) dof vector -> (P, n_node_loc, 3[, R]) node rows
+        (the node-contiguous layout; StructuredOps overrides for its
         component-major grid layout)."""
-        return v.reshape(v.shape[0], self.n_node_loc, 3)
+        return v.reshape((v.shape[0], self.n_node_loc, 3) + v.shape[2:])
 
     def _from_node3(self, z3: jnp.ndarray) -> jnp.ndarray:
-        """Inverse of :meth:`_as_node3`: (P, n_node_loc, 3) -> (P, n_loc)."""
-        return z3.reshape(z3.shape[0], self.n_loc)
+        """Inverse of :meth:`_as_node3`: (P, n_node_loc, 3[, R]) ->
+        (P, n_loc[, R])."""
+        return z3.reshape((z3.shape[0], self.n_loc) + z3.shape[3:])
 
     def block_precond(self, data: dict) -> jnp.ndarray:
         """Inverted eff-masked node blocks, ready for ``apply_prec``."""
@@ -387,16 +453,25 @@ class Ops:
     def apply_prec(self, m: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
         """z = M^-1 r: elementwise for the scalar Jacobi inverse (ndim 2),
         batched 3x3 block multiply for the block-Jacobi inverse (ndim 4);
-        backend dof layouts differ only through _as_node3/_from_node3."""
+        backend dof layouts differ only through _as_node3/_from_node3.
+        ``r`` may carry a trailing RHS-block axis (P, n_loc, nrhs)."""
+        blocked = r.ndim == 3
         if m.ndim == 2:
-            return m * r
-        z3 = jnp.einsum("pnij,pnj->pni", m, self._as_node3(r),
-                        precision=self.precision)
+            return m[..., None] * r if blocked else m * r
+        if blocked:
+            z3 = jnp.einsum("pnij,pnjr->pnir", m, self._as_node3(r),
+                            precision=self.precision)
+        else:
+            z3 = jnp.einsum("pnij,pnj->pni", m, self._as_node3(r),
+                            precision=self.precision)
         return self._from_node3(z3)
 
     def _scatter(self, data: dict, flat: jnp.ndarray) -> jnp.ndarray:
-        """(P, NC) element-dof values -> (P, n_loc) via sorted segment_sum."""
-        svals = jnp.take_along_axis(flat, data["scat_perm"], axis=1)
+        """(P, NC[, R]) element-dof values -> (P, n_loc[, R]) via sorted
+        segment_sum (the RHS block rides as a trailing segment dim)."""
+        perm = (data["scat_perm"][:, :, None] if flat.ndim == 3
+                else data["scat_perm"])
+        svals = jnp.take_along_axis(flat, perm, axis=1)
         seg = jax.vmap(
             partial(jax.ops.segment_sum, num_segments=self.n_loc + 1,
                     indices_are_sorted=True)
@@ -404,7 +479,10 @@ class Ops:
         return seg[:, : self.n_loc]
 
     def matvec(self, data: dict, x: jnp.ndarray) -> jnp.ndarray:
-        """Full assembled K.x across all parts (reference calcMPFint)."""
+        """Full assembled K.x across all parts (reference calcMPFint).
+        ``x`` may carry a trailing RHS-block axis (P, n_loc, nrhs); the
+        result keeps it, and the interface-assembly psum count stays ONE
+        regardless of the block width."""
         return self.iface_assemble(data, self.matvec_local(data, x))
 
     def comm_estimate(self, storage_dtype=None,
@@ -506,6 +584,34 @@ class Ops:
         extra pre-reduced local scalars in the same collective
         (reference's fused 3-norm allreduce, pcg_solver.py:504-507)."""
         loc = jnp.stack([self._local_dot(w, a, b) for a, b in pairs]
+                        + [jnp.asarray(e, self.dot_dtype) for e in extra])
+        return self._psum(loc)
+
+    # -- per-RHS reductions (the batched-solve contract) ----------------
+    def _local_dot_many(self, w, a, b):
+        """Per-column local weighted dots of an RHS block: a, b
+        (P, n_loc, R) -> (R,).  vmapped over the trailing axis so each
+        column's reduction is the SAME reduce the single-RHS
+        :meth:`_local_dot` runs (bit-identical per column on CPU — the
+        classic-parity contract of tests/test_pcg_many.py)."""
+        return jax.vmap(lambda ac, bc: self._local_dot(w, ac, bc),
+                        in_axes=(-1, -1))(a, b)
+
+    def wdot_many(self, w: jnp.ndarray, a: jnp.ndarray,
+                  b: jnp.ndarray) -> jnp.ndarray:
+        """Per-RHS global weighted dots <a_j, b_j>_w: (P, n_loc, R) ->
+        (R,) in ONE psum — the collective count is independent of the
+        block width; only the payload widens."""
+        return self._psum(self._local_dot_many(w, a, b))
+
+    def wdots_many(self, w: jnp.ndarray, pairs, extra=()) -> jnp.ndarray:
+        """Fused per-RHS multi-dot: pairs of (P, n_loc, R) blocks (plus
+        optional pre-reduced (R,) local rows) -> (k + len(extra), R) in
+        ONE psum.  The batched twin of :meth:`wdots`: every per-RHS
+        scalar reduction of a PCG iteration folds into a single
+        collective whose payload scales with nrhs but whose COUNT does
+        not (tools/check_collectives.py proves this statically)."""
+        loc = jnp.stack([self._local_dot_many(w, a, b) for a, b in pairs]
                         + [jnp.asarray(e, self.dot_dtype) for e in extra])
         return self._psum(loc)
 

@@ -93,10 +93,13 @@ def put_sharded(x: np.ndarray, mesh: jax.sharding.Mesh,
 
 def gather_owned_global(pm, x, mesh: Optional[jax.sharding.Mesh] = None,
                         dtype=None) -> np.ndarray:
-    """(P, n_loc) part-padded dof vector -> (glob_n_dof,) global vector via
-    the owner mask (each dof written by exactly one part).  The one shared
-    mask-and-scatter idiom for every solver's global views."""
-    out = np.zeros(pm.glob_n_dof, dtype=dtype or np.float64)
+    """(P, n_loc[, R]) part-padded dof vector/block -> (glob_n_dof[, R])
+    global array via the owner mask (each dof written by exactly one
+    part).  The one shared mask-and-scatter idiom for every solver's
+    global views — a trailing RHS-block axis rides through unchanged
+    (one fetch, one masked scatter for the whole block)."""
+    tail = tuple(np.shape(x))[2:]
+    out = np.zeros((pm.glob_n_dof,) + tail, dtype=dtype or np.float64)
     m = (pm.weight > 0) & (pm.dof_gid >= 0)
     out[pm.dof_gid[m]] = fetch_global(x, mesh)[m]
     return out

@@ -630,6 +630,13 @@ class HybridOps(Ops):
 
     # -- operator protocol ---------------------------------------------
     def matvec_local(self, data, x):
+        if x.ndim == 3:
+            # RHS-block axis (Ops.matvec contract): the level-grid
+            # gather/stencil/scatter machinery runs on flat vectors, so
+            # the block batches with vmap (the inherited iface_assemble
+            # handles the 3-D case natively — still ONE psum).
+            return jax.vmap(lambda xc: self.matvec_local(data, xc),
+                            in_axes=-1, out_axes=-1)(x)
         Pn = x.shape[0]
         if data["blocks"]:
             y = Ops.matvec_local(self, data, x)

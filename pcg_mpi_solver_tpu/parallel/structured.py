@@ -410,6 +410,13 @@ class StructuredOps(Ops):
             gsplit_matvec_grid(blk["Ke"], ck, xg, self.precision))
 
     def matvec_local(self, data, x):
+        if x.ndim == 3:
+            # RHS-block axis (Ops.matvec contract): the stencil is built
+            # around grid reshapes of one flat vector, so the block is
+            # batched with vmap — XLA turns the slice/einsum/pad chain
+            # into its batched twin; no per-column Python loop.
+            return jax.vmap(lambda xc: self.matvec_local(data, xc),
+                            in_axes=-1, out_axes=-1)(x)
         blk = data["blocks"][0]
         xg = self._grid(x)                             # (P, 3, nxn, nny, nnz)
         chunk = self._chunk_planes(x.dtype)
@@ -444,6 +451,9 @@ class StructuredOps(Ops):
         return y.reshape(x.shape)
 
     def matvec(self, data, x):
+        if x.ndim == 3:
+            return jax.vmap(lambda xc: self.matvec(data, xc),
+                            in_axes=-1, out_axes=-1)(x)
         yg = self._grid(self.matvec_local(data, x))
         return self._halo(yg).reshape(x.shape)
 
@@ -474,13 +484,22 @@ class StructuredOps(Ops):
             .transpose(0, 2, 1).reshape(Pl, self.n_node_loc, 3, 3)
 
     def _as_node3(self, v):
-        # structured dof layout is component-major: (P, 3, nodes)
+        # structured dof layout is component-major: (P, 3, nodes[, R])
+        if v.ndim == 3:
+            return v.reshape(v.shape[0], 3, self.n_node_loc,
+                             v.shape[2]).transpose(0, 2, 1, 3)
         return v.reshape(v.shape[0], 3, self.n_node_loc).transpose(0, 2, 1)
 
     def _from_node3(self, z3):
+        if z3.ndim == 4:
+            return z3.transpose(0, 2, 1, 3).reshape(
+                z3.shape[0], self.n_loc, z3.shape[3])
         return z3.transpose(0, 2, 1).reshape(z3.shape[0], self.n_loc)
 
     def iface_assemble(self, data, y):
+        if y.ndim == 3:
+            return jax.vmap(lambda yc: self.iface_assemble(data, yc),
+                            in_axes=-1, out_axes=-1)(y)
         return self._halo(self._grid(y)).reshape(y.shape)
 
     # -- export path ----------------------------------------------------

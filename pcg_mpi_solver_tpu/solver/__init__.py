@@ -1,5 +1,7 @@
-from pcg_mpi_solver_tpu.solver.pcg import pcg, PCGResult
-from pcg_mpi_solver_tpu.solver.driver import Solver, StepResult
+from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_many, PCGResult
+from pcg_mpi_solver_tpu.solver.driver import (ManySolveResult, Solver,
+                                              StepResult)
 from pcg_mpi_solver_tpu.solver.newmark import NewmarkSolver
 
-__all__ = ["pcg", "PCGResult", "Solver", "StepResult", "NewmarkSolver"]
+__all__ = ["pcg", "pcg_many", "PCGResult", "Solver", "StepResult",
+           "ManySolveResult", "NewmarkSolver"]

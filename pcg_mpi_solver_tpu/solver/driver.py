@@ -112,6 +112,47 @@ class StepResult:
     wall_s: float
 
 
+def normalize_rhs_block(fexts, n_dof: int, dtype=None) -> np.ndarray:
+    """ONE authoritative normalization of a solve_many request to the
+    (n_dof, nrhs) column contract: a single (n_dof,) vector promotes to
+    one column, a stacked (nrhs, n_dof) list transposes when
+    unambiguous.  Shared by Solver.solve_many and the CLI front-end so
+    the shape heuristic cannot diverge between entry points.  With
+    ``dtype=None`` the input dtype is kept (a shape-only pass: no
+    full-block copy when the caller just needs the width)."""
+    fb = np.asarray(fexts) if dtype is None \
+        else np.asarray(fexts, dtype=dtype)
+    if fb.ndim == 1:
+        fb = fb[:, None]
+    elif fb.ndim == 2 and fb.shape[0] != n_dof and fb.shape[1] == n_dof:
+        fb = fb.T
+    return fb
+
+
+@dataclasses.dataclass
+class ManySolveResult:
+    """Per-RHS outcome of a batched :meth:`Solver.solve_many` block:
+    flags/relres/iters are (nrhs,) per-column vectors (MATLAB pcg flag
+    taxonomy per column), ``x`` the device-resident blocked solution
+    (n_parts, n_loc, nrhs) on effective dofs — fetch global per-column
+    vectors with :meth:`Solver.displacement_global_many`."""
+    flags: np.ndarray
+    relres: np.ndarray
+    iters: np.ndarray
+    wall_s: float
+    x: object = None
+
+    # wall of the Krylov work alone (staging — validation, the
+    # global->local map, the device upload — excluded): the honest
+    # per-iteration denominator for nrhs A/Bs, since the scalar step()
+    # baseline derives its rhs in-graph from device-resident data
+    solve_wall_s: float = 0.0
+
+    @property
+    def nrhs(self) -> int:
+        return int(len(self.flags))
+
+
 class Solver:
     """Owns the partitioned model on the device mesh and runs time steps."""
 
@@ -537,6 +578,8 @@ class Solver:
         self._resume_pending = False     # solve(resume=True) arms mid-step
         #                                  snapshot resume for its steps
         self._snap_store = None          # lazy: fingerprints the model once
+        self._many_progs = {}            # nrhs -> jitted blocked programs
+        self._many_snap = {}             # nrhs -> blocked snapshot store
         self._restart_post_fn = None     # lazy: ladder restart program
         self._fallback_prec_fn = None    # lazy: scalar-Jacobi fallback
         self._esc_engine = None          # lazy: f64 escalation engine
@@ -1084,6 +1127,361 @@ class Solver:
         self.un = put_sharded(
             np.zeros((self.pm.n_parts, self.pm.n_loc), self.dtype),
             self.mesh, self._part_spec)
+
+    # ------------------------------------------------------------------
+    # Batched multi-RHS solves (ISSUE 6): many load cases, ONE operator
+    # ------------------------------------------------------------------
+    def solve_many(self, fexts, resume: bool = False) -> ManySolveResult:
+        """Solve ``K.x_j = fext_j`` for a BLOCK of load cases against the
+        one shared partitioned operator — the multi-tenant solve path.
+
+        ``fexts``: global load vectors as an (n_dof, nrhs) array (one
+        column per load case; a list of (n_dof,) vectors or a single
+        vector also work).  Homogeneous Dirichlet: loads act on the
+        effective dofs, constrained dofs solve to 0 (lift prescribed
+        displacements into the load columns yourself if needed).
+
+        The block rides one lockstep Krylov loop (solver/pcg.pcg_many —
+        per-RHS convergence mask, frozen converged columns, per-column
+        flag taxonomy) with the per-type element matmul batched over the
+        block and a per-iteration collective count INDEPENDENT of nrhs.
+        Reuses every warm-path asset this solver already owns: the
+        cached partition, the preconditioner build, and (one-shot path
+        with ``cache_dir``) an AOT-exported blocked program keyed by
+        nrhs — repeated blocks of the same width do zero partition
+        builds and zero step re-traces.  Each request block is validated
+        per column first (validate.check_rhs_block — the offending
+        column index is named, the PR-4 preflight already vetted the
+        model at construction).
+
+        Direct-precision solves above the dispatch cap run as capped
+        resumable dispatches with optional mid-solve snapshots
+        (``config.snapshot_every`` chunk boundaries, ``many_*.npz``) and
+        ``resume=True`` continues a killed blocked solve bit-identically;
+        a resume against a different block width fails as a clear
+        fingerprint mismatch.  Mixed-precision blocks run as one
+        dispatch (the refinement loop is in-graph).
+
+        Returns :class:`ManySolveResult` (per-RHS flags/relres/iters +
+        the device-blocked solution)."""
+        from pcg_mpi_solver_tpu.validate import PreflightError, check_rhs_block
+
+        t0 = time.perf_counter()
+        rdt = np.dtype(np.float64 if self.mixed else self.dtype)
+        fb = normalize_rhs_block(fexts, self._model.n_dof, rdt)
+        checks = check_rhs_block(fb, self._model.n_dof)
+        bad = [c for c in checks if c.status == "fail"]
+        if bad:
+            raise PreflightError(
+                "solve_many rejected the rhs block: " + "; ".join(
+                    f"[{c.name}] {c.detail}" for c in bad))
+        R = fb.shape[1]
+        self._rec.gauge("many.nrhs", R)
+
+        # global columns -> part-local blocked (n_parts, n_loc, nrhs);
+        # shared interface dofs replicate their value on every part that
+        # carries them (the assembled-operator convention), padded local
+        # slots (dof_gid < 0) read 0
+        from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
+
+        gid = np.asarray(self.pm.dof_gid)
+        loc = fb[np.clip(gid, 0, None), :] * (gid >= 0)[..., None]
+        fb_dev = put_sharded(np.ascontiguousarray(loc, dtype=rdt),
+                             self.mesh, self._part_spec)
+
+        progs = self._ensure_many_programs(R)
+        t_solve0 = time.perf_counter()      # staging done: Krylov wall
+        if "solve" in progs:
+            if resume or int(getattr(self.config, "snapshot_every", 0)) > 0:
+                # the one-shot blocked path (mixed precision, or below
+                # the dispatch cap) has no chunk boundaries to snapshot
+                # at — say so instead of silently ignoring the request
+                self._rec.note(
+                    "solve_many: snapshot/resume requested but this "
+                    "blocked solve runs as ONE dispatch (mixed "
+                    "precision, or below the dispatch cap) — no "
+                    "mid-solve snapshots exist on this path")
+            with self._rec.dispatch("solve_many"):
+                x, flags, relres, iters = progs["solve"](self.data, fb_dev)
+                flags = np.asarray(flags)
+                relres = np.asarray(relres, dtype=np.float64)
+                iters = np.asarray(iters)
+        else:
+            rhs_hash = ""
+            if resume or int(getattr(self.config, "snapshot_every", 0)) > 0:
+                # the hash exists only to fingerprint snapshots — never
+                # scan the (potentially GB-scale) block when neither
+                # snapshots nor resume can use it
+                from pcg_mpi_solver_tpu.cache.keys import array_hash
+
+                rhs_hash = array_hash(fb)
+            x, flags, relres, iters = self._solve_many_chunked(
+                fb_dev, R, progs, resume, rhs_hash=rhs_hash)
+        wall = time.perf_counter() - t0
+        res = ManySolveResult(flags=flags, relres=relres, iters=iters,
+                              wall_s=wall, x=x,
+                              solve_wall_s=time.perf_counter() - t_solve0)
+        self._rec.event("solve_many", nrhs=R, wall_s=round(wall, 6),
+                        flags=[int(f) for f in flags],
+                        iters_max=int(iters.max()) if R else 0)
+        for j in range(R):
+            # per-RHS telemetry: one event per tenant/load case
+            self._rec.event("rhs_solve", rhs=j, flag=int(flags[j]),
+                            relres=float(relres[j]), iters=int(iters[j]))
+        return res
+
+    def displacement_global_many(self, x) -> np.ndarray:
+        """Blocked device solution (n_parts, n_loc, nrhs) -> global host
+        (n_dof, nrhs) array: ONE fetch of the whole block (one DCN
+        all-gather on multi-host) + one owner-masked scatter, via the
+        same :func:`gather_owned_global` every scalar global view uses
+        (it carries the trailing block axis natively)."""
+        from pcg_mpi_solver_tpu.parallel.distributed import gather_owned_global
+
+        return gather_owned_global(self.pm, x, self.mesh,
+                                   np.dtype(self.dtype))
+
+    def _ensure_many_programs(self, R: int) -> dict:
+        """Build (once per block width) the jitted blocked programs.
+        One-shot: a single ``solve`` program (AOT-cached under cache_dir
+        keyed by nrhs).  Chunked direct: start/cycle/final programs
+        mirroring the scalar chunked engine, with a donated resumable
+        blocked carry."""
+        if R in self._many_progs:
+            return self._many_progs[R]
+        from pcg_mpi_solver_tpu.solver.pcg import (
+            carry_part_specs, cold_carry_many, pcg_many, pcg_mixed_many,
+            select_best_many)
+
+        scfg = self.config.solver
+        mixed = self.mixed
+        variant = scfg.pcg_variant
+        fused_v = variant == "fused"
+        glob_n_eff = self.pm.glob_n_dof_eff
+        P, Rsp = self._part_spec, self._rep_spec
+        cap = self._dispatch_cap
+        chunked = cap > 0 and not mixed
+        progs = {}
+
+        def smap(f, in_specs, out_specs, donate=()):
+            return jax.jit(jax.shard_map(
+                f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False),
+                donate_argnums=donate if self._donate else ())
+
+        if not chunked:
+            def _solve_blk(data, fb):
+                # warm-path contract: increments only inside a live
+                # trace, like _step (tests assert zero on an AOT hit)
+                self._rec.inc("trace.step")
+                self._rec.inc("trace.solve_many")
+                d64 = data["f64"] if mixed else data
+                eff = d64["eff"]
+                fext = eff[..., None] * fb
+                x0 = jnp.zeros_like(fext)
+                if mixed:
+                    inv32 = self._make_prec(self.ops32, data["f32"])
+                    res = pcg_mixed_many(
+                        self.ops32, data["f32"], self.ops, d64, fext, x0,
+                        inv32, tol=scfg.tol, max_iter=scfg.max_iter,
+                        glob_n_dof_eff=glob_n_eff,
+                        max_stag_steps=scfg.max_stag_steps,
+                        inner_tol=scfg.inner_tol,
+                        plateau_window=scfg.mixed_plateau_window,
+                        progress_window=scfg.mixed_progress_window,
+                        progress_ratio=scfg.mixed_progress_ratio,
+                        progress_min_gain=scfg.mixed_progress_min_gain,
+                        variant=variant)
+                else:
+                    inv = self._make_prec(self.ops, d64)
+                    res = pcg_many(
+                        self.ops, d64, fext, x0, inv,
+                        tol=scfg.tol, max_iter=scfg.max_iter,
+                        glob_n_dof_eff=glob_n_eff,
+                        max_stag_steps=scfg.max_stag_steps,
+                        x0_zero=True, variant=variant)
+                return res.x, res.flag, res.relres, res.iters
+
+            shard = jax.shard_map(
+                _solve_blk, mesh=self.mesh, in_specs=(self._specs, P),
+                out_specs=(P, Rsp, Rsp, Rsp), check_vma=False)
+            fn = jax.jit(shard)
+            if self._cache_dir:
+                aot_fn = self._build_aot_many(shard, R)
+                if aot_fn is not None:
+                    fn = aot_fn
+            progs["solve"] = fn
+        else:
+            carry_specs = carry_part_specs(P, Rsp, fused=fused_v,
+                                           many=True)
+
+            def _start(data, fb):
+                self._rec.inc("trace.step")
+                self._rec.inc("trace.solve_many")
+                eff = data["eff"]
+                w = data["weight"] * eff
+                fext = eff[..., None] * fb
+                # x0 = 0: r0 = fext exactly, ||r0|| = ||b|| (one psum)
+                normr0 = jnp.sqrt(self.ops.wdot_many(w, fext, fext))
+                carry0 = cold_carry_many(
+                    jnp.zeros_like(fext), fext, normr0,
+                    self.ops.dot_dtype, fused=fused_v)
+                prec = self._make_prec(self.ops, data)
+                return fext, carry0, normr0, prec
+
+            progs["start"] = smap(_start, (self._specs, P),
+                                  (P, carry_specs, Rsp, P))
+
+            def _cycle(data, fext, prec, carry, budget):
+                res, carry2 = pcg_many(
+                    self.ops, data, fext, carry["x"], prec,
+                    tol=scfg.tol,
+                    max_iter=jnp.minimum(cap, budget),
+                    glob_n_dof_eff=glob_n_eff,
+                    max_stag_steps=scfg.max_stag_steps,
+                    max_iter_nominal=scfg.max_iter,
+                    carry_in=carry, return_carry=True, variant=variant)
+                return res.x, carry2
+
+            progs["cycle"] = smap(
+                _cycle, (self._specs, P, P, carry_specs, Rsp),
+                (P, carry_specs), donate=(3,))
+
+            def _final(data, fext, carry):
+                # the ONE terminal per-column selection lives in
+                # select_best_many(respect_flags=True): converged
+                # columns keep their accepted iterate, zero-rhs columns
+                # return zeros, failed columns take the MATLAB
+                # min-residual fallback
+                return select_best_many(self.ops, data, fext, carry,
+                                        always_min=fused_v,
+                                        respect_flags=True)
+
+            progs["final"] = smap(_final, (self._specs, P, carry_specs),
+                                  (P, Rsp))
+        self._many_progs[R] = progs
+        return progs
+
+    def _build_aot_many(self, shard, R: int):
+        """AOT-export path for the one-shot blocked program, mirroring
+        :meth:`_build_aot_step` with the block width as a structural key
+        component: a warm run of the same (model, config, nrhs) block
+        shape deserializes StableHLO — zero re-tracing."""
+        import dataclasses as _dc
+
+        from pcg_mpi_solver_tpu.cache import aot
+        from pcg_mpi_solver_tpu.cache.keys import step_cache_key
+        from pcg_mpi_solver_tpu.ops.pallas_matvec import pallas_planes
+
+        data_abs = aot.abstract_like(self.data)
+        psh = jax.sharding.NamedSharding(self.mesh, self._part_spec)
+        rdt = jnp.float64 if self.mixed else self.dtype
+        fb_abs = jax.ShapeDtypeStruct(
+            (self.pm.n_parts, self.pm.n_loc, R), rdt, sharding=psh)
+        abstract_args = (data_abs, fb_abs)
+        key = step_cache_key(
+            abstract=aot.signature_repr(abstract_args),
+            mesh=(sorted(self.mesh.shape.items()),
+                  self.mesh.devices.flat[0].platform),
+            backend=self.backend,
+            solver=_dc.asdict(self.config.solver),
+            pcg_variant=self.config.solver.pcg_variant,
+            nrhs=R,
+            trace_len=0,
+            glob_n_dof_eff=int(self.pm.glob_n_dof_eff),
+            donate=False,
+            jax_version=jax.__version__,
+            extra={"many": True,
+                   "pallas_variant": self.pallas_variant,
+                   "matvec_form": getattr(self.ops, "form", None),
+                   "pallas_planes": (pallas_planes()
+                                     if self.pallas_variant != "off"
+                                     else None),
+                   "x64": bool(jax.config.jax_enable_x64)})
+        exported = aot.cached_step(
+            self._cache_dir, key, jax.jit(shard), abstract_args,
+            recorder=self._rec)
+        if exported is None:
+            return None
+        return jax.jit(exported.call)
+
+    def _many_snap_store(self, R: int, rhs_hash: str):
+        """Blocked-solve snapshot store for one (width, rhs-content)
+        request shape (lazy; the fingerprint embeds both, so a resume
+        against a different width OR different load cases mismatches
+        loudly instead of continuing the wrong Krylov space)."""
+        key = (R, rhs_hash)
+        if key not in self._many_snap:
+            from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+            self._many_snap[key] = SnapshotStore.for_many_solver(
+                self, R, rhs_hash=rhs_hash)
+        return self._many_snap[key]
+
+    def _solve_many_chunked(self, fb_dev, R: int, progs, resume: bool,
+                            rhs_hash: str = ""):
+        """Host budget loop for a blocked direct solve: capped resumable
+        dispatches of the blocked carry (donated in place), per-column
+        flags deciding termination, optional mid-solve snapshots every
+        ``config.snapshot_every`` chunk boundaries.  The snapshot is
+        discarded only on successful completion — a crashed/killed solve
+        leaves it for ``solve_many(..., resume=True)``."""
+        scfg = self.config.solver
+        rec = self._rec
+        every = int(getattr(self.config, "snapshot_every", 0))
+        store = (self._many_snap_store(R, rhs_hash)
+                 if (every > 0 or resume) else None)
+        with rec.dispatch("many_start"):
+            fext, carry, normr0, prec = progs["start"](self.data, fb_dev)
+            jax.block_until_ready(normr0)
+        total = 0
+        iters_cols = np.zeros(R, dtype=np.int64)
+        flags = np.asarray(carry["flag"])
+        if resume and store is not None:
+            t = store.latest()
+            st = store.load(t) if t is not None else None
+            if st is not None and str(np.asarray(
+                    st.get("kind", ""))) == "many":
+                carry = self._put_state({"carry": st["carry"]})["carry"]
+                total = int(np.asarray(st["total"]))
+                iters_cols = np.asarray(st["iters_cols"],
+                                        dtype=np.int64).copy()
+                flags = np.asarray(carry["flag"])
+                rec.note(f"resumed blocked solve (nrhs={R}) at "
+                         f"{total} iterations")
+            else:
+                # the negative signal matters operationally: a pruned/
+                # corrupt/absent snapshot must leave a breadcrumb that
+                # this run started COLD, not a stream indistinguishable
+                # from a successful resume
+                rec.note(f"solve_many resume requested but no usable "
+                         f"blocked snapshot found (nrhs={R}); "
+                         "starting cold")
+        chunk_i = 0
+        x_fin = carry["x"]
+        while np.any(flags == 1) and total < scfg.max_iter:
+            budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
+            with rec.dispatch("many_cycle"):
+                x_fin, carry = progs["cycle"](self.data, fext, prec,
+                                              carry, budget)
+                execv = np.asarray(carry["exec"])
+                flags = np.asarray(carry["flag"])
+            iters_cols += execv.astype(np.int64)
+            total += int(execv.max()) if execv.size else 0
+            chunk_i += 1
+            if not np.any(flags == 1):
+                break
+            if store is not None and every > 0 and chunk_i % every == 0:
+                state = dict(kind="many", total=total,
+                             iters_cols=iters_cols,
+                             carry=self._fetch_state(carry))
+                store.save(1, state)
+        with rec.dispatch("many_final"):
+            x_fin, relres = progs["final"](self.data, fext, carry)
+            relres = np.asarray(relres, dtype=np.float64)
+        if store is not None:
+            store.discard(1)
+        return x_fin, flags, relres, iters_cols
 
     def step(self, delta: float) -> StepResult:
         t0 = time.perf_counter()

@@ -116,11 +116,15 @@ def cold_carry(x0, r0, normr0, dot_dtype, trace=None,
 
 
 def carry_part_specs(part_spec, rep_spec, trace: bool = False,
-                     fused: bool = False) -> dict:
+                     fused: bool = False, many: bool = False) -> dict:
     """shard_map PartitionSpecs for the carry dict (vectors on the parts
     axis, bookkeeping scalars replicated; the optional trace ring is
     replicated scalar streams; ``fused`` adds the Chronopoulos–Gear
-    leaves — the A.p vector and two replicated scalars)."""
+    leaves — the A.p vector and two replicated scalars).  ``many`` is
+    the RHS-blocked carry (:func:`pcg_many`): same keys with (R,)
+    bookkeeping vectors (still replicated) plus the per-RHS ``flag``
+    leaf — a blocked resume must keep already-terminated columns frozen
+    across dispatch boundaries, which the scalar carry never needed."""
     P, R = part_spec, rep_spec
     out = dict(x=P, r=P, p=P, rho=R, stag=R, moresteps=R,
                normrmin=R, xmin=P, imin=R, since_best=R, best_at_reset=R,
@@ -128,6 +132,8 @@ def carry_part_specs(part_spec, rep_spec, trace: bool = False,
                normr_act=R, exec=R)
     if fused:
         out.update(q=P, alpha=R, fresh=R)
+    if many:
+        out["flag"] = R
     if trace:
         out["trace"] = trace_specs(R)
     return out
@@ -950,3 +956,606 @@ def pcg_mixed(
     if traced:
         return result, c["trace"]
     return result
+
+
+# ---------------------------------------------------------------------------
+# Batched multi-RHS PCG (ISSUE 6): one Krylov loop over an RHS block.
+#
+# The block rides a TRAILING axis through every vector (x/r/p/q are
+# (P, n_loc, nrhs)) and every bookkeeping scalar becomes an (nrhs,)
+# vector.  The loop is LOCKSTEP: one blocked matvec per trip (the
+# per-type element matmul batches to (d x d) @ (d x N x nrhs) — the MXU
+# amortization the ISSUE targets), and every per-RHS scalar reduction of
+# a trip folds into the same psums the single-RHS body runs — the psum
+# COUNT is independent of nrhs (classic 5 / fused 3 body psums, proven
+# statically by tools/check_collectives.py); only payloads widen.
+#
+# Per-column semantics mirror solver/pcg.pcg exactly: each column runs
+# its own mode-0/mode-1 (deferred true-residual check) sequence, its own
+# stagnation/MoreSteps/min-residual bookkeeping and flag taxonomy, and a
+# CONVERGED (or broken-down) column FREEZES — every state update is
+# gated by a per-column mask, so the remaining columns iterate while
+# finished ones hold their accepted iterate.  On CPU a blocked classic
+# solve reproduces each column of the equivalent single-RHS solves
+# bit-identically (tests/test_pcg_many.py): the blocked gathers/matmuls/
+# reductions keep per-column operation order (verified for the general
+# element path), and the lockstep merge only reorders WHICH trip a
+# column's arithmetic runs on, never the arithmetic itself.
+#
+# Not supported on the blocked path (by design, documented in
+# docs/RUNBOOK.md "Many right-hand sides"): the in-graph trace ring
+# (per-solve, not per-column) — telemetry instead carries per-RHS
+# `rhs_solve` events from the driver.
+# ---------------------------------------------------------------------------
+
+
+def _colsel(mask, a, b):
+    """Per-column select: ``mask`` (R,) over blocked vectors (P, n, R)."""
+    return jnp.where(mask[None, None, :], a, b)
+
+
+def cold_carry_many(x0, r0, normr0, dot_dtype, fused: bool = False) -> dict:
+    """Blocked twin of :func:`cold_carry`: x0/r0 are (P, n_loc, R), the
+    bookkeeping rides as (R,) vectors, and the per-RHS ``flag`` leaf
+    (all-1 = running) joins the carry so a resumed dispatch keeps
+    already-terminated columns frozen.  Same donation contract."""
+    dd = dot_dtype
+    R = x0.shape[-1]
+    zi = jnp.zeros((R,), jnp.int32)
+    n0 = jnp.asarray(normr0, dd)
+    out = dict(
+        x=x0, r=r0, p=jnp.zeros_like(x0),
+        rho=jnp.ones((R,), dd),
+        stag=zi, moresteps=zi,
+        normrmin=n0, xmin=x0, imin=zi,
+        since_best=zi, best_at_reset=n0,
+        win_start=n0, win_count=zi,
+        normr_act=n0, exec=zi,
+        flag=jnp.ones((R,), jnp.int32))
+    if fused:
+        out["q"] = jnp.zeros_like(x0)
+        out["alpha"] = jnp.full((R,), np.inf, dd)
+        out["fresh"] = jnp.ones((R,), jnp.int32)
+    return out
+
+
+def select_best_many(ops: Ops, data: dict, fext: jnp.ndarray, carry: dict,
+                     always_min: bool = False,
+                     respect_flags: bool = False):
+    """Per-column min-residual fallback for a terminally-failed blocked
+    resumable solve (the blocked twin of :func:`select_best`): one
+    blocked matvec and two R-wide dot psums for the WHOLE block — once
+    per solve, never per iteration.
+
+    ``respect_flags`` makes this the ONE terminal per-column selection
+    (the chunked driver's finalize): converged columns (carry flag 0)
+    keep their accepted iterate and true residual, zero-rhs columns
+    return exact zeros, and only failed columns take the min-residual
+    fallback — MATLAB pcg's taxonomy, in one place."""
+    eff = data["eff"]
+    w = data["weight"] * eff
+    n2b = jnp.sqrt(ops.wdot_many(w, fext, fext))
+    r_min = fext - eff[..., None] * ops.matvec(data, carry["xmin"])
+    normr_min = jnp.sqrt(ops.wdot_many(w, r_min, r_min))
+    den = jnp.maximum(n2b, jnp.asarray(np.finfo(np.float32).tiny, n2b.dtype))
+    if always_min:
+        x, relres = carry["xmin"], normr_min / den
+    else:
+        use_min = normr_min < carry["normr_act"]
+        x = _colsel(use_min, carry["xmin"], carry["x"])
+        relres = jnp.where(use_min, normr_min, carry["normr_act"]) / den
+    if respect_flags:
+        ok = carry["flag"] == 0
+        x = _colsel(ok, carry["x"], x)
+        relres = jnp.where(ok, carry["normr_act"] / den, relres)
+        zero = n2b == 0
+        x = jnp.where(zero[None, None, :], jnp.zeros_like(x), x)
+        relres = jnp.where(zero, 0.0, relres)
+    return x, relres
+
+
+def pcg_many(
+    ops: Ops,
+    data: dict,
+    fext: jnp.ndarray,        # (P, n_loc, R) rhs block on eff dofs
+    x0: jnp.ndarray,          # (P, n_loc, R) initial guess block
+    inv_diag: jnp.ndarray,    # preconditioner inverse (shared by columns)
+    tol,                      # scalar or (R,) per-column tolerance
+    max_iter,                 # static int or traced scalar budget
+    glob_n_dof_eff: int,
+    max_stag_steps: int = 3,
+    max_iter_nominal: Optional[int] = None,
+    carry_in: Optional[dict] = None,
+    return_carry: bool = False,
+    plateau_window: int = 0,
+    x0_zero: bool = False,
+    progress_window: int = 0,
+    progress_ratio: float = 0.7,
+    progress_min_gain: float = 30.0,
+    variant: str = "classic",
+):
+    """Blocked multi-RHS ``pcg``: solves K.x_j = fext_j for every column
+    j of the RHS block in ONE lockstep while-loop with a per-RHS
+    convergence mask in the predicate.  Returns a :class:`PCGResult`
+    whose ``x`` is (P, n_loc, R) and whose flag/relres/iters are (R,)
+    per-column vectors, or ``(result, carry)`` with ``return_carry``
+    (the resumable-dispatch contract of :func:`pcg`, per column).
+
+    See the module-level "Batched multi-RHS PCG" note for the exact
+    per-column semantics and the collective-count contract."""
+    if variant not in VALID_PCG_VARIANTS:
+        raise ValueError(f"pcg variant must be one of "
+                         f"{VALID_PCG_VARIANTS}, got {variant!r}")
+    fused = variant == "fused"
+    warm = carry_in is not None
+    eff = data["eff"]
+    w = data["weight"] * eff
+    dt = fext.dtype
+    dd = ops.dot_dtype
+    R = fext.shape[-1]
+    eps = jnp.asarray(np.finfo(np.dtype(dt)).eps, dd)
+
+    nominal = max_iter_nominal if max_iter_nominal is not None else max_iter
+    maxmsteps = min(glob_n_dof_eff // 50, 5, glob_n_dof_eff - nominal)
+
+    n2b = jnp.sqrt(ops.wdot_many(w, fext, fext))       # (R,)
+    tolb = jnp.asarray(tol, dd) * n2b                  # (R,)
+
+    def amul(v):
+        return eff[..., None] * ops.matvec(data, v)
+
+    if warm:
+        x0 = carry_in["x"]
+        r0 = carry_in["r"]
+        normr0 = carry_in["normr_act"].astype(dd)
+        frozen0 = carry_in["flag"] != 1
+    else:
+        frozen0 = jnp.zeros((R,), bool)
+        if x0_zero:
+            r0 = fext
+            normr0 = n2b
+        else:
+            r0 = fext - amul(x0)
+            normr0 = jnp.sqrt(ops.wdot_many(w, r0, r0))
+
+    zero_rhs = n2b == 0
+    if fused and warm:
+        # warm fused normr0 is the predecessor iterate's norm (pipelined
+        # lag) — never flag-0 the unevaluated resumed column off it
+        initial_ok = jnp.zeros((R,), bool)
+    else:
+        initial_ok = normr0 <= tolb
+
+    zi = jnp.zeros((R,), jnp.int32)
+    flag0 = carry_in["flag"] if warm else jnp.ones((R,), jnp.int32)
+    carry0 = dict(
+        x=x0,
+        r=r0,
+        p=carry_in["p"] if warm else jnp.zeros_like(x0),
+        rho=carry_in["rho"] if warm else jnp.ones((R,), dd),
+        i=zi,
+        flag=jnp.where(zero_rhs | initial_ok,
+                       0, flag0).astype(jnp.int32),
+        stag=carry_in["stag"] if warm else zi,
+        moresteps=carry_in["moresteps"] if warm else zi,
+        iter_out=zi,
+        normr_act=normr0.astype(dd),
+        normrmin=carry_in["normrmin"] if warm else normr0.astype(dd),
+        xmin=carry_in["xmin"] if warm else x0,
+        imin=carry_in["imin"] if warm else zi,
+        since_best=carry_in["since_best"] if warm else zi,
+        best_at_reset=(carry_in["best_at_reset"] if warm
+                       else normr0.astype(dd)),
+        win_start=(carry_in["win_start"] if warm
+                   else normr0.astype(dd)),
+        win_count=carry_in["win_count"] if warm else zi,
+        mode=zi,
+    )
+    if fused:
+        carry0["q"] = carry_in["q"] if warm else jnp.zeros_like(x0)
+        carry0["alpha"] = (carry_in["alpha"] if warm
+                           else jnp.full((R,), np.inf, dd))
+        carry0["fresh"] = (carry_in["fresh"] if warm
+                           else jnp.ones((R,), jnp.int32))
+
+    def cond(c):
+        return jnp.any((c["flag"] == 1) & (c["i"] < max_iter))
+
+    def _resolve_many(c, x, r, p, rho, stag, normr_act, candidate, i,
+                      extra=None):
+        """Elementwise (per-column) twin of ``pcg``'s ``_resolve``: the
+        shared iteration epilogue, with every scalar decision an (R,)
+        vector.  ``extra`` overrides output entries AFTER the
+        bookkeeping (the fused body commits fresh vectors while the
+        epilogue resolves the lagged iterate)."""
+        converged = candidate & (normr_act <= tolb)
+        stag = jnp.where(candidate & ~converged
+                         & (stag >= max_stag_steps) & (c["moresteps"] == 0),
+                         0, stag).astype(jnp.int32)
+        moresteps = jnp.where(candidate & ~converged,
+                              c["moresteps"] + 1,
+                              c["moresteps"]).astype(jnp.int32)
+        toosmall = candidate & ~converged & (moresteps >= maxmsteps)
+
+        better = normr_act < c["normrmin"]
+        normrmin = jnp.where(better, normr_act, c["normrmin"])
+        xmin = _colsel(better, x, c["xmin"])
+        imin = jnp.where(better, i, c["imin"])
+        improved = normr_act < c["best_at_reset"] * (1 - 1e-3)
+        since_best = jnp.where(improved, 0,
+                               c["since_best"] + 1).astype(jnp.int32)
+        best_at_reset = jnp.where(improved, normr_act, c["best_at_reset"])
+
+        stagnated = (stag >= max_stag_steps) & ~converged & ~toosmall
+        plateaued = ((since_best > plateau_window) & ~converged
+                     & ~toosmall if plateau_window
+                     else jnp.zeros((R,), bool))
+
+        if progress_window:
+            win_count = c["win_count"] + 1
+            at_window = win_count >= progress_window
+            weak_window = normrmin > jnp.asarray(
+                progress_ratio, normrmin.dtype) * c["win_start"]
+            deep_enough = normrmin * jnp.asarray(
+                progress_min_gain, normrmin.dtype) < n2b
+            no_progress = (at_window & weak_window & deep_enough
+                           & ~converged & ~toosmall)
+            win_start = jnp.where(at_window, normrmin, c["win_start"])
+            win_count = jnp.where(at_window, 0, win_count).astype(jnp.int32)
+        else:
+            no_progress = jnp.zeros((R,), bool)
+            win_start, win_count = c["win_start"], c["win_count"]
+
+        flag = jnp.where(converged, 0,
+                jnp.where(toosmall | stagnated | plateaued | no_progress, 3,
+                          1)).astype(jnp.int32)
+        stop = flag != 1
+        out = dict(
+            x=x, r=r, p=p, rho=rho,
+            i=jnp.where(stop, i, i + 1).astype(jnp.int32),
+            flag=flag, stag=stag, moresteps=moresteps,
+            iter_out=i,
+            normr_act=normr_act, normrmin=normrmin, xmin=xmin, imin=imin,
+            since_best=since_best, best_at_reset=best_at_reset,
+            win_start=win_start, win_count=win_count,
+            mode=jnp.zeros_like(i),
+        )
+        if extra:
+            out.update(extra)
+        return out
+
+    def _merge_cases(c, cases):
+        """Per-column merge of branch outcomes: ``cases`` is a list of
+        (mask (R,), state dict) with DISJOINT masks; columns matching no
+        mask keep their old state ``c`` (frozen/inactive columns)."""
+        out = {}
+        for k in c:
+            v = c[k]
+            for m, d in cases:
+                nv = d[k]
+                mv = m[None, None, :] if nv.ndim == 3 else m
+                v = jnp.where(mv, nv, v)
+            out[k] = v
+        return out
+
+    def body(c):
+        """Classic blocked body: the per-column merge of ``pcg``'s
+        mode-0 iterate / mode-1 deferred-check / breakdown branches.
+        Psums: rho+inf (1) + interface assembly inside the one blocked
+        matvec (1) + p.q (1) + fused 3-norm (1) + the check's
+        true-residual norm (1) = 5, independent of nrhs."""
+        i = c["i"]
+        active = (c["flag"] == 1) & (i < max_iter)
+        is_check = (c["mode"] == 1) & active
+        it_m = active & ~is_check
+
+        # -- pre (mode 0): z, rho, beta, direction recurrence ----------
+        z = ops.apply_prec(inv_diag, c["r"])
+        inf_col = jnp.isinf(z).any(axis=(0, 1)).astype(dd)
+        red = ops.wdots_many(w, [(z, c["r"])], extra=[inf_col])
+        rho_new, flag2 = red[0], red[1] > 0
+        bad_rho = (rho_new == 0) | jnp.isinf(rho_new)
+        beta = (rho_new / c["rho"]).astype(dt)
+        if warm:
+            bad_beta = (beta == 0) | jnp.isinf(beta)
+            p_new = z + beta[None, None, :] * c["p"]
+        else:
+            bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
+            p_new = jnp.where((i == 0)[None, None, :], z,
+                              z + beta[None, None, :] * c["p"])
+
+        # the ONE blocked stencil application: check columns ride their
+        # committed iterate through the same matvec (q_j = A.x_j there)
+        operand = _colsel(is_check, c["x"], p_new)
+        q = amul(operand)
+
+        # -- iterate path ----------------------------------------------
+        pq = ops.wdot_many(w, p_new, q)
+        bad_pq = (pq <= 0) | jnp.isinf(pq)
+        alpha = (rho_new / pq).astype(dt)
+        bad_alpha = jnp.isinf(alpha)
+        breakdown = bad_rho | bad_beta | bad_pq | bad_alpha
+        new_flag = jnp.where(flag2, 2,
+                             jnp.where(breakdown, 4, 1)).astype(jnp.int32)
+
+        r_upd = c["r"] - alpha[None, None, :] * q
+        sq = ops.wdots_many(w, [(p_new, p_new), (c["x"], c["x"]),
+                                (r_upd, r_upd)])
+        normp, normx = jnp.sqrt(sq[0]), jnp.sqrt(sq[1])
+        normr = jnp.sqrt(sq[2])
+        stag_upd = jnp.where(
+            normp * jnp.abs(alpha).astype(dd) < eps * normx,
+            c["stag"] + 1, 0).astype(jnp.int32)
+        x_upd = c["x"] + alpha[None, None, :] * p_new
+        cand_new = ((normr <= tolb) | (stag_upd >= max_stag_steps)
+                    | (c["moresteps"] > 0))
+
+        # -- check path: true residual of the committed iterate --------
+        r_true = fext - q
+        normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
+
+        chk = _resolve_many(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
+                            stag=c["stag"], normr_act=normr_chk,
+                            candidate=jnp.ones((R,), bool), i=i)
+        brk = dict(c, flag=new_flag, iter_out=i, rho=rho_new)
+        pend = dict(c, x=x_upd, r=r_upd, p=p_new, rho=rho_new,
+                    stag=stag_upd, iter_out=i,
+                    mode=jnp.ones((R,), jnp.int32))
+        res = _resolve_many(c, x=x_upd, r=r_upd, p=p_new, rho=rho_new,
+                            stag=stag_upd,
+                            normr_act=normr.astype(dd),
+                            candidate=jnp.zeros((R,), bool), i=i)
+
+        m_brk = it_m & (flag2 | breakdown)
+        m_pend = it_m & ~(flag2 | breakdown) & cand_new
+        m_res = it_m & ~(flag2 | breakdown) & ~cand_new
+        return _merge_cases(c, [(is_check, chk), (m_brk, brk),
+                                (m_pend, pend), (m_res, res)])
+
+    def body_fused(c):
+        """Fused (Chronopoulos–Gear) blocked body: ONE fused psum
+        carries every per-RHS reduction (rho, mu, ||r||, ||p||, ||x||,
+        inf flag — a (6, R) payload) + the interface psum + the check's
+        true-residual norm = 3 body psums, independent of nrhs.  Same
+        pipelined-lag semantics per column as ``pcg``'s fused body."""
+        i = c["i"]
+        active = (c["flag"] == 1) & (i < max_iter)
+        is_check = (c["mode"] == 1) & active
+        it_m = active & ~is_check
+
+        z = ops.apply_prec(inv_diag, c["r"])
+        operand = _colsel(is_check, c["x"], z)
+        kop = amul(operand)          # A.z (iterate cols) / A.x (check cols)
+
+        inf_col = jnp.isinf(z).any(axis=(0, 1)).astype(dd)
+        red = ops.wdots_many(w, [(c["r"], z), (z, kop),
+                                 (c["r"], c["r"]), (c["p"], c["p"]),
+                                 (c["x"], c["x"])], extra=[inf_col])
+        rho, mu = red[0], red[1]
+        normr = jnp.sqrt(red[2])
+        normp, normx = jnp.sqrt(red[3]), jnp.sqrt(red[4])
+        flag2 = red[5] > 0
+
+        already = c["fresh"] == 0
+        small = normp * jnp.abs(c["alpha"]) < eps * normx
+        stag = jnp.where(already, c["stag"],
+                         jnp.where(small, c["stag"] + 1,
+                                   0)).astype(jnp.int32)
+        candidate = (((normr <= tolb) | (stag >= max_stag_steps)
+                      | (c["moresteps"] > 0)) & ~already)
+
+        bad_rho = (rho == 0) | jnp.isinf(rho)
+        beta = rho / c["rho"]
+        bad_beta = (beta == 0) | jnp.isinf(beta)
+        pq = mu - beta * rho / c["alpha"]
+        bad_pq = (pq <= 0) | jnp.isinf(pq)
+        alpha = rho / pq
+        bad_alpha = jnp.isinf(alpha)
+        breakdown = bad_rho | bad_beta | bad_pq | bad_alpha
+        new_flag = jnp.where(flag2, 2,
+                             jnp.where(breakdown, 4, 1)).astype(jnp.int32)
+
+        beta_dt = beta.astype(dt)[None, None, :]
+        alpha_dt = alpha.astype(dt)[None, None, :]
+        p2 = z + beta_dt * c["p"]
+        q2 = kop + beta_dt * c["q"]
+        x2 = c["x"] + alpha_dt * p2
+        r2 = c["r"] - alpha_dt * q2
+
+        res = _resolve_many(
+            c, x=c["x"], r=c["r"], p=c["p"], rho=rho, stag=stag,
+            normr_act=normr.astype(dd),
+            candidate=jnp.zeros((R,), bool), i=i,
+            extra=dict(x=x2, r=r2, p=p2, q=q2,
+                       alpha=alpha.astype(dd),
+                       fresh=jnp.ones((R,), jnp.int32)))
+        pend = dict(c, stag=stag, iter_out=i,
+                    mode=jnp.ones((R,), jnp.int32))
+        brk = dict(c, flag=new_flag, iter_out=i, rho=rho)
+
+        r_true = fext - kop
+        normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
+        chk = _resolve_many(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
+                            stag=c["stag"], normr_act=normr_chk,
+                            candidate=jnp.ones((R,), bool), i=i,
+                            extra=dict(q=c["q"], alpha=c["alpha"],
+                                       fresh=jnp.zeros((R,), jnp.int32),
+                                       i=i))
+
+        m_brk = it_m & (flag2 | breakdown) & ~candidate
+        m_pend = it_m & candidate
+        m_res = it_m & ~candidate & ~(flag2 | breakdown)
+        return _merge_cases(c, [(is_check, chk), (m_brk, brk),
+                                (m_pend, pend), (m_res, res)])
+
+    c = jax.lax.while_loop(cond, body_fused if fused else body, carry0)
+
+    skip_mask = zero_rhs | initial_ok | frozen0
+
+    def finalize():
+        ok = c["flag"] == 0
+        relres_ok = c["normr_act"] / n2b
+        # per-column min-residual fallback (MATLAB pcg semantics); ONE
+        # blocked matvec for the whole block
+        r_min = fext - amul(c["xmin"])
+        normr_min = jnp.sqrt(ops.wdot_many(w, r_min, r_min))
+        if fused:
+            x_bad, relres_bad = c["xmin"], normr_min / n2b
+            iters_bad = c["imin"]
+        else:
+            use_min = normr_min < c["normr_act"]
+            x_bad = _colsel(use_min, c["xmin"], c["x"])
+            relres_bad = jnp.where(use_min, normr_min,
+                                   c["normr_act"]) / n2b
+            iters_bad = jnp.where(use_min, c["imin"], c["iter_out"])
+        x = _colsel(ok, c["x"], x_bad)
+        relres = jnp.where(ok, relres_ok, relres_bad)
+        iters = jnp.where(ok, c["iter_out"], iters_bad)
+        return x, relres, iters
+
+    if return_carry:
+        x, relres, iters = c["x"], c["normr_act"] / n2b, c["iter_out"]
+    else:
+        x, relres, iters = finalize()
+
+    x = jnp.where(zero_rhs[None, None, :], jnp.zeros_like(x), x)
+    relres = jnp.where(zero_rhs, 0.0, relres)
+    iters = jnp.where(skip_mask, 0, iters + 1)
+    flag = jnp.where(zero_rhs, 0, c["flag"]).astype(jnp.int32)
+
+    result = PCGResult(x=x, flag=flag, relres=relres.astype(jnp.float32),
+                       iters=iters)
+    if return_carry:
+        keys = ["x", "r", "p", "rho", "stag", "moresteps",
+                "normrmin", "xmin", "imin", "since_best",
+                "best_at_reset", "win_start", "win_count", "normr_act"]
+        if fused:
+            keys += ["q", "alpha", "fresh"]
+        carry = {k: c[k] for k in keys}
+        carry["flag"] = flag
+        # executed body-iteration count per column; columns that never
+        # ran this dispatch (frozen at entry / converged at entry /
+        # zero rhs) report 0
+        carry["exec"] = jnp.where(skip_mask, 0,
+                                  c["iter_out"] + 1).astype(jnp.int32)
+        return result, carry
+    return result
+
+
+def pcg_mixed_many(
+    ops32: Ops,
+    data32: dict,
+    ops64: Ops,
+    data64: dict,
+    fext: jnp.ndarray,        # (P, n_loc, R) f64 rhs block on eff dofs
+    x0: jnp.ndarray,          # (P, n_loc, R) f64 initial guess block
+    inv_diag32: jnp.ndarray,  # f32 preconditioner inverse (shared)
+    tol: float,
+    max_iter: int,
+    glob_n_dof_eff: int,
+    max_stag_steps: int = 3,
+    inner_tol: float = 1e-5,
+    max_outer: int = 12,
+    plateau_window: int = 0,
+    progress_window: int = 0,
+    progress_ratio: float = 0.7,
+    progress_min_gain: float = 30.0,
+    variant: str = "classic",
+) -> PCGResult:
+    """Blocked mixed-precision PCG by iterative refinement: the blocked
+    twin of :func:`pcg_mixed`.  The f32 inner Krylov cycles run
+    :func:`pcg_many` on the per-column normalized residuals (a finished
+    column's inner rhs is zeroed, so its inner solve early-exits and
+    costs nothing but a masked lane), and the f64 refresh is one blocked
+    matvec per cycle.  Per-column flags follow pcg_mixed's taxonomy."""
+    eff64 = data64["eff"]
+    w64 = data64["weight"] * eff64
+    R = fext.shape[-1]
+    dd = ops64.dot_dtype
+
+    def amul64(v):
+        return eff64[..., None] * ops64.matvec(data64, v)
+
+    n2b = jnp.sqrt(ops64.wdot_many(w64, fext, fext))   # (R,)
+    tolb = tol * n2b
+
+    carry0 = dict(
+        x=x0,
+        normr=jnp.full((R,), np.inf, dd),
+        outer=jnp.zeros((R,), jnp.int32),
+        total=jnp.zeros((R,), jnp.int32),
+        flag=jnp.where(n2b == 0, 0, -1).astype(jnp.int32),
+        fatal2=jnp.zeros((R,), bool),
+    )
+
+    def cond(c):
+        return jnp.any(c["flag"] == -1)
+
+    def body(c):
+        r = fext - amul64(c["x"])
+        normr = jnp.sqrt(ops64.wdot_many(w64, r, r))
+        live = c["flag"] == -1
+        converged = normr <= tolb
+        stalled = normr > 0.5 * c["normr"]
+        exhausted = (c["outer"] >= max_outer) | (c["total"] >= max_iter)
+        run_inner = live & ~(converged | stalled | c["fatal2"] | exhausted)
+
+        # normalized inner rhs per column; columns NOT running this
+        # cycle get a zero rhs, which pcg_many's per-column zero-rhs
+        # early exit freezes at flag 0 / 0 iterations immediately
+        denom = jnp.where(normr > 0, normr, jnp.ones_like(normr))
+        rhat32 = jnp.where(run_inner[None, None, :],
+                           r / denom[None, None, :], 0.0
+                           ).astype(jnp.float32)
+        # PER-COLUMN inner budget, exactly the scalar path's
+        # max_iter - total per solve: a lightly-spent column must not be
+        # clamped by the most-spent column's remaining budget (pcg_many
+        # takes an (R,) max_iter — its budget test is elementwise)
+        remaining = jnp.maximum(max_iter - c["total"], 1)
+        tol_cycle = refine_tol(tolb, normr, inner_tol)
+        inner, icarry = pcg_many(
+            ops32, data32,
+            fext=rhat32,
+            x0=jnp.zeros_like(rhat32),
+            inv_diag=inv_diag32,
+            tol=tol_cycle,
+            max_iter=remaining,
+            glob_n_dof_eff=glob_n_dof_eff,
+            max_stag_steps=max_stag_steps,
+            max_iter_nominal=max_iter,
+            plateau_window=plateau_window,
+            return_carry=True,
+            x0_zero=True,
+            progress_window=progress_window,
+            progress_ratio=progress_ratio,
+            progress_min_gain=progress_min_gain,
+            variant=variant,
+        )
+        use_min = (inner.flag != 0) & (icarry["normrmin"]
+                                       < icarry["normr_act"])
+        xbest = _colsel(use_min, icarry["xmin"], inner.x)
+        xinc = xbest.astype(fext.dtype) * normr[None, None, :]
+        xinc = jnp.where(run_inner[None, None, :], xinc,
+                         jnp.zeros_like(xinc))
+        exec_n = jnp.where(run_inner, jnp.maximum(icarry["exec"], 1), 0)
+        inner_flag = jnp.where(run_inner, inner.flag, 1)
+
+        flag = jnp.where(
+            ~live, c["flag"],
+            jnp.where(converged, 0,
+             jnp.where(stalled, 3,
+              jnp.where(c["fatal2"], 2,
+               jnp.where(exhausted, 1, -1))))).astype(jnp.int32)
+        return dict(x=c["x"] + xinc,
+                    normr=jnp.where(live, normr, c["normr"]),
+                    outer=c["outer"] + run_inner.astype(jnp.int32),
+                    total=c["total"] + exec_n,
+                    flag=flag,
+                    fatal2=inner_flag == 2)
+
+    c = jax.lax.while_loop(cond, body, carry0)
+    zero_rhs = n2b == 0
+    relres = jnp.where(zero_rhs, 0.0, c["normr"] / n2b)
+    x = jnp.where(zero_rhs[None, None, :], jnp.zeros_like(c["x"]), c["x"])
+    return PCGResult(x=x, flag=c["flag"], relres=relres.astype(jnp.float32),
+                     iters=c["total"])

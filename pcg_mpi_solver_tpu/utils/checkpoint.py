@@ -92,6 +92,13 @@ def _fingerprint(solver) -> dict:
         # resume must fail HERE, as a clear fingerprint mismatch, not as
         # a pytree/in_specs error deep in the shard_map dispatch
         "pcg_variant": getattr(cfg.solver, "pcg_variant", "classic"),
+        # RHS-block width: the quasi-static/dynamics solve paths are
+        # always width 1; solve_many snapshots override this with the
+        # actual block width (SnapshotStore.for_many_solver), so a
+        # blocked resume against a different-width block fails HERE as a
+        # clear fingerprint mismatch instead of a pytree shape error
+        # deep in the shard_map dispatch
+        "nrhs": 1,
         "tol": float(cfg.solver.tol),
         "max_iter": int(cfg.solver.max_iter),
         "deltas": [float(d) for d in th.time_step_delta],
@@ -286,6 +293,9 @@ class CheckpointManager:
             # Checkpoints written before the pcg_variant field existed
             # can only have come from the classic loop.
             saved.setdefault("pcg_variant", "classic")
+            # Checkpoints written before the nrhs field existed can only
+            # have come from the single-RHS paths.
+            saved.setdefault("nrhs", 1)
             want = _fingerprint(solver)
             # Checkpoints that predate the stencil-form/level-dims fields
             # did not record which formulation/layout produced them (the
@@ -373,6 +383,23 @@ class SnapshotStore:
     @classmethod
     def for_solver(cls, solver) -> "SnapshotStore":
         return cls(solver.config.checkpoint_path, _fingerprint(solver))
+
+    @classmethod
+    def for_many_solver(cls, solver, nrhs: int,
+                        rhs_hash: str = "") -> "SnapshotStore":
+        """Blocked-solve store (``Solver.solve_many``): same fingerprint
+        guard with the ACTUAL block width AND a content hash of the rhs
+        block, distinct ``many_*.npz`` namespace.  Resuming a width-R
+        blocked carry under a width-R' request — or under a same-width
+        block of DIFFERENT load cases (the scalar paths derive their rhs
+        from the fingerprinted model/schedule; solve_many's rhs is a
+        per-request input, so it must be fingerprinted itself) — fails
+        as a clear mismatch naming the field, never as a silently-wrong
+        Krylov continuation or a shape error deep in the dispatch."""
+        fp = dict(_fingerprint(solver))
+        fp["nrhs"] = int(nrhs)
+        fp["rhs_hash"] = str(rhs_hash)
+        return cls(solver.config.checkpoint_path, fp, prefix="many")
 
     @classmethod
     def for_time_solver(cls, solver) -> "SnapshotStore":
@@ -477,6 +504,14 @@ class SnapshotStore:
                           "step from its start state")
             return None
         flat.pop("__t", None)
+        # snapshots written before the nrhs field existed can only have
+        # come from the width-1 scalar paths (same back-compat shim as
+        # CheckpointManager.restore — without it every pre-existing
+        # snap_*/step_* resume point would mismatch on upgrade).  Only
+        # when THIS store's fingerprint carries the field: a custom
+        # fingerprint without it must keep comparing equal to itself.
+        if self.fingerprint is not None and "nrhs" in self.fingerprint:
+            saved.setdefault("nrhs", 1)
         if self.fingerprint is not None and saved != self.fingerprint:
             diffs = {k: (saved.get(k), self.fingerprint[k])
                      for k in self.fingerprint

@@ -203,6 +203,8 @@ def _check_solver_params(scfg) -> CheckResult:
         probs.append(f"tol={scfg.tol} must be a finite positive number")
     if scfg.max_iter < 1:
         probs.append(f"max_iter={scfg.max_iter} must be >= 1")
+    if int(getattr(scfg, "nrhs", 1)) < 1:
+        probs.append(f"nrhs={scfg.nrhs} must be >= 1")
     if probs:
         return CheckResult("solver_params", "fail", "; ".join(probs))
     return CheckResult("solver_params", "ok")
@@ -283,6 +285,55 @@ def _check_explicit_dt(model, context) -> CheckResult:
             f"{bound:.3e} (the estimate is conservative for hexes but "
             "not exact)")
     return CheckResult("explicit_dt", "ok")
+
+
+def check_rhs_block(fexts: Any, n_dof: int) -> List[CheckResult]:
+    """Per-column validation of a blocked right-hand side (the
+    ``Solver.solve_many`` request gate): shape contract per RHS and a
+    NaN/Inf scan that names the OFFENDING COLUMN INDEX — a multi-tenant
+    block must reject the one bad load case comprehensibly, not report
+    a whole-array failure.  Also applied by ``cli.py solve-many``.
+
+    ``fexts``: (n_dof, nrhs) array (one column per load case)."""
+    a = np.asarray(fexts)
+    if a.ndim != 2:
+        return [CheckResult(
+            "rhs_block_shape", "fail",
+            f"fext block must be 2-D (n_dof, nrhs), got shape {a.shape}")]
+    if a.shape[0] != n_dof:
+        return [CheckResult(
+            "rhs_block_shape", "fail",
+            f"fext block rows {a.shape[0]} != n_dof {n_dof} "
+            f"(columns are load cases)")]
+    if a.shape[1] < 1:
+        return [CheckResult("rhs_block_shape", "fail",
+                            "fext block has zero columns")]
+    if a.dtype.kind != "f":
+        return [CheckResult(
+            "rhs_block_shape", "fail",
+            f"fext block dtype {a.dtype} is not floating")]
+    results = [CheckResult("rhs_block_shape", "ok")]
+    finite_cols = np.isfinite(a).all(axis=0)
+    if not finite_cols.all():
+        bad = np.flatnonzero(~finite_cols)
+        per_col = ", ".join(
+            f"rhs {int(j)} ({int(np.count_nonzero(~np.isfinite(a[:, j])))} "
+            "non-finite)" for j in bad[:8])
+        more = f" (+{bad.size - 8} more)" if bad.size > 8 else ""
+        results.append(CheckResult(
+            "rhs_block_finite", "fail",
+            f"NaN/Inf in column(s): {per_col}{more}"))
+    else:
+        results.append(CheckResult("rhs_block_finite", "ok"))
+    zero_cols = ~np.any(a, axis=0) if a.size else np.zeros(0, bool)
+    if zero_cols.any():
+        results.append(CheckResult(
+            "rhs_block_zero", "warn",
+            f"all-zero column(s) {np.flatnonzero(zero_cols).tolist()}: "
+            "they solve to x = 0 but still ride every blocked matvec"))
+    else:
+        results.append(CheckResult("rhs_block_zero", "ok"))
+    return results
 
 
 # ----------------------------------------------------------------------

@@ -23,6 +23,15 @@ def test_fused_saves_exactly_two_psums():
     assert fused == classic - 2
 
 
+def test_batched_body_psum_count_independent_of_nrhs():
+    """The ISSUE-6 headline claim, lint-enforced: the blocked multi-RHS
+    body (pcg_many) runs EXACTLY the single-RHS psum count — widening
+    the block widens payloads, never the collective count."""
+    for variant, want in EXPECTED_BODY_PSUMS.items():
+        assert iteration_psum_count(variant, nrhs=8) == want
+        assert iteration_psum_count(variant, nrhs=2) == want
+
+
 def test_comm_estimate_gauges_match_the_claim():
     """Ops.comm_estimate (the telemetry gauge source) must advertise the
     same per-iteration psum counts the traced bodies prove: classic

@@ -20,6 +20,13 @@ mode-1 true-residual check, are part of the traced body jaxpr):
 Per healthy iteration (mode-0 trip) that is 3+1 collectives classic vs
 1+1 fused — the claim ``Ops.comm_estimate`` gauges advertise.
 
+The same proof extends to the batched multi-RHS body (solver/pcg.py
+``pcg_many``): its psum count must be INDEPENDENT of the RHS-block
+width — widening the block widens psum payloads, never the collective
+count (the ISSUE-6 headline claim).  ``iteration_psum_count(variant,
+nrhs=8)`` traces the blocked body and must equal the nrhs=1 count for
+both variants.
+
 Usage: python tools/check_collectives.py     (exit 0 = counts hold)
 Tier-1: tests/test_collectives.py runs the same checks in-process.
 """
@@ -76,9 +83,12 @@ def _while_bodies(jaxpr, out):
     return out
 
 
-def iteration_psum_count(variant: str) -> int:
+def iteration_psum_count(variant: str, nrhs: int = 1) -> int:
     """Psum count of the traced PCG while-loop body for ``variant`` on a
-    2-part partition (so the interface-assembly psum exists)."""
+    2-part partition (so the interface-assembly psum exists).  With
+    ``nrhs`` > 1 the BATCHED body (``pcg_many``) is traced instead —
+    the documented counts must hold unchanged (payloads widen with the
+    block, the collective count must not)."""
     import jax
     import jax.numpy as jnp
 
@@ -87,7 +97,7 @@ def iteration_psum_count(variant: str) -> int:
     from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
     from pcg_mpi_solver_tpu.parallel.partition import partition_model
     from pcg_mpi_solver_tpu.solver.driver import _data_specs
-    from pcg_mpi_solver_tpu.solver.pcg import pcg
+    from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_many
 
     model = make_cube_model(3, 3, 3)
     pm = partition_model(model, 2)
@@ -100,27 +110,34 @@ def iteration_psum_count(variant: str) -> int:
     P = jax.sharding.PartitionSpec(PARTS_AXIS)
 
     def step(data, fext, x0, inv_diag):
-        res = pcg(ops, data, fext, x0, inv_diag, tol=1e-8, max_iter=50,
-                  glob_n_dof_eff=pm.glob_n_dof_eff, variant=variant)
+        solve = pcg_many if nrhs > 1 else pcg
+        res = solve(ops, data, fext, x0, inv_diag, tol=1e-8, max_iter=50,
+                    glob_n_dof_eff=pm.glob_n_dof_eff, variant=variant)
         return res.x
 
     fn = jax.shard_map(step, mesh=mesh,
                        in_specs=(_data_specs(data), P, P, P),
                        out_specs=P, check_vma=False)
-    vec = jnp.zeros((pm.n_parts, pm.n_loc), jnp.float64)
-    jaxpr = jax.make_jaxpr(fn)(data, vec, vec, vec)
+    shape = ((pm.n_parts, pm.n_loc, nrhs) if nrhs > 1
+             else (pm.n_parts, pm.n_loc))
+    vec = jnp.zeros(shape, jnp.float64)
+    inv = jnp.zeros((pm.n_parts, pm.n_loc), jnp.float64)
+    jaxpr = jax.make_jaxpr(fn)(data, vec, vec, inv)
     bodies = _while_bodies(jaxpr.jaxpr, [])
     counts = [count_psums(b) for b in bodies]
     hits = [c for c in counts if c > 0]
     if len(hits) != 1:
         raise RuntimeError(
             f"expected exactly one psum-bearing while body for "
-            f"variant={variant!r}, found counts {counts}")
+            f"variant={variant!r} nrhs={nrhs}, found counts {counts}")
     return hits[0]
 
 
-def run_checks() -> list:
-    """Returns a list of error strings (empty = counts hold)."""
+def run_checks(nrhs_batched: int = 8) -> list:
+    """Returns a list of error strings (empty = counts hold).  Checks
+    both the single-RHS bodies and the batched bodies at
+    ``nrhs_batched`` columns: the counts must be equal — psum count
+    independent of the RHS-block width."""
     errs = []
     counts = {}
     for variant, want in EXPECTED_BODY_PSUMS.items():
@@ -128,6 +145,11 @@ def run_checks() -> list:
         if got != want:
             errs.append(f"{variant}: {got} psums in the loop body, "
                         f"documented count is {want}")
+        got_b = iteration_psum_count(variant, nrhs=nrhs_batched)
+        if got_b != want:
+            errs.append(f"{variant} batched (nrhs={nrhs_batched}): "
+                        f"{got_b} psums in the loop body, must equal the "
+                        f"nrhs=1 count {want}")
     if not errs and counts["fused"] != counts["classic"] - 2:
         errs.append(f"fused must save exactly the two serialized scalar "
                     f"reductions: classic={counts['classic']} "
@@ -139,12 +161,13 @@ def main() -> int:
     errs = run_checks()
     for variant, want in EXPECTED_BODY_PSUMS.items():
         print(f"{variant}: {want} psum(s) in the while-loop body "
-              f"{'OK' if not errs else ''}")
+              f"(single-RHS and batched) {'OK' if not errs else ''}")
     if errs:
         for e in errs:
             print(f"FAIL: {e}")
         return 1
-    print("collective counts hold (fused saves 2 psums/iteration)")
+    print("collective counts hold (fused saves 2 psums/iteration; "
+          "batched bodies match nrhs=1)")
     return 0
 
 

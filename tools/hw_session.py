@@ -9,8 +9,11 @@ measurements without an operator in the loop.
 Usage:
     python tools/hw_session.py [--deadline-min 360] [--log docs/HW_SESSION.log]
         [--quick]            # small sizes (smoke/CPU test of the harness)
+        [--preset full|priority]
 
-Steps (each independent; a failure is logged and the session continues):
+Presets:
+
+* ``full`` (default) — the historical RUNBOOK checklist:
   1. bench_matvec         — XLA gse vs corner vs Pallas v3 at flagship scale
   2. bench_gather         — hybrid row-traffic isolation
   3. bench.py             — cube flagship (mixed)
@@ -18,6 +21,19 @@ Steps (each independent; a failure is logged and the session continues):
   5. bench.py octree      — graded-octree flagship on the blocked hybrid
   6. bench_iter_breakdown — structured per-iteration split
   7. bench_hybrid_breakdown — per-level gather/stencil/scatter split
+
+* ``priority`` — the highest-value unanswered questions FIRST (every
+  prior window died before the full queue finished; ROADMAP #3):
+  1. flagship classic     — the 10.33M-dof ms/iter anchor (mixed)
+  2. flagship fused       — PR-5's single-reduction loop, FIRST hardware
+                            measurement (BENCH_PCG_VARIANT=fused)
+  3. nrhs sweep 4, 16     — batched multi-RHS throughput A/B
+                            (BENCH_NRHS; detail.dof_iter_rhs_per_s)
+  4. Pallas v9 A/B        — first-ever hardware execution of the kernel
+                            family (the hw_v9_ab.py step)
+  Steps 2-4 reuse step 1's warm caches (shared BENCH_CACHE_DIR), so a
+  window that dies mid-queue still leaves each completed step's salvage
+  line.
 """
 
 from __future__ import annotations
@@ -134,6 +150,31 @@ def start_queue(name, deadline_min, log):
     return path
 
 
+def run_priority_queue(path, quick: bool):
+    """The prioritized measurement queue (module docstring ``priority``
+    preset): classic-vs-fused ms/iter at the flagship first, then the
+    batched-RHS sweep, then the Pallas v9 A/B — ordered so the minutes a
+    dying window DOES deliver answer the most valuable open questions.
+    A shared warm-path cache dir makes steps 2+ near-zero-setup."""
+    # BENCH_NX exported unconditionally so the flagship size is pinned
+    # HERE, not silently inherited from bench.py's default
+    cache = {"BENCH_CACHE_DIR": os.path.join(REPO, ".pcg_cache")}
+    size = {"BENCH_NX": "24" if quick else "150"}
+    run_step(path, "flagship classic", ["bench.py"],
+             env_extra=dict(cache, **size), timeout=3600)
+    run_step(path, "flagship fused", ["bench.py"],
+             env_extra=dict(cache, BENCH_PCG_VARIANT="fused", **size),
+             timeout=3600)
+    for nrhs in ("4", "16"):
+        run_step(path, f"nrhs sweep ({nrhs})", ["bench.py"],
+                 env_extra=dict(cache, BENCH_NRHS=nrhs, **size),
+                 timeout=3600)
+    run_step(path, "matvec A/B v9",
+             ["examples/bench_matvec.py", "48" if quick else "150"],
+             env_extra={"BENCH_MATVEC_VARIANTS": "v9"}, timeout=2400)
+    log_line(path, "priority queue complete")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--deadline-min", type=float, default=360,
@@ -141,9 +182,19 @@ def main():
     ap.add_argument("--log", default=os.path.join("docs", "HW_SESSION.log"))
     ap.add_argument("--quick", action="store_true",
                     help="small sizes (harness smoke; also used on CPU)")
+    ap.add_argument("--preset", choices=["full", "priority"],
+                    default="full",
+                    help="full = historical RUNBOOK checklist; priority "
+                         "= classic-vs-fused ms/iter, then the BENCH_NRHS "
+                         "sweep, then Pallas v9 (highest-value open "
+                         "questions first — see module docstring)")
     args = ap.parse_args()
-    path = start_queue(f"hw_session (quick={args.quick})",
+    path = start_queue(f"hw_session (quick={args.quick}, "
+                       f"preset={args.preset})",
                        args.deadline_min, args.log)
+    if args.preset == "priority":
+        run_priority_queue(path, args.quick)
+        return
 
     nx = "48" if args.quick else "150"
     ot = ({"BENCH_OT_N": "6", "BENCH_OT_LEVEL": "2"} if args.quick else {})
