@@ -1,0 +1,41 @@
+"""analysis/ — jaxpr-level contract lint for the solver's structural
+claims (ISSUE 7).
+
+The framework's headline claims are *structural* facts about traced
+programs — "one fused psum per iteration" (PR 5), "collective count
+independent of nrhs" (PR 6), "zero retraces on warm runs" (PR 2) — and
+its resilience posture depends on completeness facts about config
+surfaces (cache keys, snapshot fingerprints).  This package proves them
+statically, in seconds on CPU, instead of burning a hardware window:
+
+* ``engine``          — rule registry, findings, baseline, reports
+* ``rules_jaxpr``     — collective-budget, hot-loop-purity,
+                        dtype-discipline, donation-integrity
+* ``rules_config``    — fingerprint-completeness (perturb-and-retrace)
+* ``rules_ast``       — recovery-paths (broad-except lint)
+* ``rules_artifacts`` — telemetry-schema (committed artifact lint)
+* ``programs``        — the canonical traced-program matrix
+* ``collectives``     — back-compat tools/check_collectives.py API
+
+Entry points: ``pcg-tpu lint`` and ``python -m
+pcg_mpi_solver_tpu.analysis`` (``--fast``/``--json``/``--baseline``).
+
+Import contract: importing this package (like the repo root package)
+must NOT import jax — bench.py and the CLI configure the accelerator
+environment after importing library modules, and the lint itself must be
+constructible before deciding to pin the CPU backend.  jax loads lazily,
+only when a jaxpr-level rule actually executes.
+"""
+
+from pcg_mpi_solver_tpu.analysis.engine import (
+    DEFAULT_BASELINE, Finding, Report, Rule, RULES, list_rules, run_lint)
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "Finding",
+    "Report",
+    "Rule",
+    "RULES",
+    "list_rules",
+    "run_lint",
+]

@@ -1,0 +1,130 @@
+"""``python -m pcg_mpi_solver_tpu.analysis`` — the contract-lint CLI.
+
+Exit codes: 0 = clean (baselined findings allowed), 1 = findings,
+2 = a rule or the engine crashed.  ``pcg-tpu lint`` is the same runner
+behind the package CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def setup_cpu_env() -> None:
+    """Pin the lint to the CPU backend BEFORE jax initializes: static
+    analysis must never touch (or wait on) an accelerator grant, and the
+    traced matrix needs a multi-device host platform.  No-ops when the
+    operator already configured the env (or jax is loaded — pytest's
+    conftest rig).  Also drops any inherited persistent-compile-cache
+    dir: jax 0.4.x CPU executables crash on cache round-trips
+    (cache/aot.py documents the same gate)."""
+    from pcg_mpi_solver_tpu.utils.backend_probe import (
+        backend_live, pin_cpu_backend_if_requested)
+
+    if backend_live():
+        return   # too late to (and no need to) reconfigure: test rig
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
+    if "cpu" in os.environ["JAX_PLATFORMS"]:
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    pin_cpu_backend_if_requested()
+    if "jax" in sys.modules:
+        # jax may already be imported (the package pin does so under
+        # JAX_PLATFORMS=cpu); x64 and the compile-cache dir are config
+        # flags, not import-frozen — jax binds the env vars at import,
+        # so clearing the environment alone would not stick (the same
+        # authoritative-config move bench.py makes, in reverse)
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        if "cpu" in os.environ["JAX_PLATFORMS"]:
+            jax.config.update("jax_compilation_cache_dir", None)
+
+
+def add_lint_args(ap) -> None:
+    """The ONE definition of the lint option surface, shared by this
+    module's parser and the ``pcg-tpu lint`` subcommand (cli.py) so the
+    two documented-as-identical entry points cannot drift."""
+    ap.add_argument("--fast", action="store_true",
+                    help="pre-hardware-window gate: source/artifact rules "
+                         "plus the collective/purity proofs on the "
+                         "reduced program matrix (distributed backend; "
+                         "skips donation + fingerprint sweeps)")
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="write the machine-readable report here "
+                         "('-' = stdout)")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="suppression file (default: the checked-in "
+                         "analysis/baseline.json, which ships EMPTY); "
+                         "entries need a documented reason")
+    ap.add_argument("--rules", default=None, metavar="ID[,ID...]",
+                    help="run only these rule ids")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+
+
+def build_parser(prog: str = "pcg_mpi_solver_tpu.analysis"):
+    ap = argparse.ArgumentParser(
+        prog=prog,
+        description="statically prove the solver's performance/resilience "
+                    "invariants (collective budgets, hot-loop purity, "
+                    "dtype discipline, donation aliasing, cache-key/"
+                    "fingerprint completeness, source/artifact lints) — "
+                    "see docs/ANALYSIS.md for the rule catalog")
+    add_lint_args(ap)
+    return ap
+
+
+def run(args) -> int:
+    from pcg_mpi_solver_tpu.analysis import engine
+
+    if args.list_rules:
+        for r in engine.list_rules():
+            tag = "fast" if r.fast else "full"
+            print(f"{r.id:26s} [{r.kind}/{tag}] {r.doc}")
+        return 0
+    baseline = args.baseline if args.baseline is not None \
+        else engine.DEFAULT_BASELINE
+    rule_ids = ([s for s in args.rules.split(",") if s]
+                if args.rules else None)
+    try:
+        report = engine.run_lint(fast=args.fast, rule_ids=rule_ids,
+                                 baseline_path=baseline)
+    except ValueError as e:           # unknown rule id / bad baseline
+        print(f"pcg-tpu lint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        blob = json.dumps(report.to_dict(), indent=2, sort_keys=True)
+        if args.json == "-":
+            print(blob)
+        else:
+            try:
+                with open(args.json, "w", encoding="utf-8") as f:
+                    f.write(blob + "\n")
+            except OSError as e:
+                # an unwritable report path is an ENGINE failure (exit
+                # 2), not a lint verdict — exit 1 must keep meaning
+                # "findings" for CI/hw_session wrappers
+                print(report.render())
+                print(f"pcg-tpu lint: cannot write --json {args.json}: "
+                      f"{e}", file=sys.stderr)
+                return 2
+    if args.json != "-":
+        print(report.render())
+    return report.exit_code
+
+
+def main(argv=None) -> int:
+    setup_cpu_env()
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

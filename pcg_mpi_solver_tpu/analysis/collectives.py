@@ -1,0 +1,121 @@
+"""Back-compat collective-count proof API (tools/check_collectives.py).
+
+The full per-program proof now lives in the analysis/ collective-budget
+rule (rules_jaxpr.py) over the canonical program matrix; this module
+keeps the historical standalone API — ``EXPECTED_BODY_PSUMS``,
+``iteration_psum_count``, ``run_checks`` — that tools/ and
+tests/test_collectives.py consume, tracing the bare ``pcg``/``pcg_many``
+loop directly on a 2-part mesh.  The documented counts are now DERIVED
+from the declarations next to ``Ops.comm_estimate``
+(ops/matvec.py PCG_SCALAR_PSUMS / PCG_DEFERRED_CHECK_PSUMS), so the
+gauges, this check and the rule engine all read one table.
+
+This module imports jax at load; callers own the backend env (the
+tools/ shim pins CPU + an 8-device host platform before importing).
+"""
+
+from __future__ import annotations
+
+from pcg_mpi_solver_tpu.analysis.jaxpr_utils import count_primitive
+from pcg_mpi_solver_tpu.ops.matvec import (
+    PCG_DEFERRED_CHECK_PSUMS, PCG_SCALAR_PSUMS)
+
+# Documented while-body psum counts on a 2-part GENERAL partition (the
+# interface-assembly psum is present; both conditional branches of the
+# body, including the deferred mode-1 true-residual check, are part of
+# the traced body jaxpr): classic 3+1+1 = 5, fused 1+1+1 = 3.
+EXPECTED_BODY_PSUMS = {
+    variant: scalar + 1 + PCG_DEFERRED_CHECK_PSUMS
+    for variant, scalar in PCG_SCALAR_PSUMS.items()
+}
+
+
+def count_psums(jaxpr) -> int:
+    """Recursive ``psum`` primitive count of a jaxpr (into conds etc.)."""
+    return count_primitive(jaxpr, "psum")
+
+
+def _while_bodies(jaxpr, out):
+    from pcg_mpi_solver_tpu.analysis.jaxpr_utils import (
+        sub_jaxprs, while_body)
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "while":
+            out.append(while_body(eqn))
+        for j in sub_jaxprs(eqn):
+            _while_bodies(j, out)
+    return out
+
+
+def iteration_psum_count(variant: str, nrhs: int = 1) -> int:
+    """Psum count of the traced PCG while-loop body for ``variant`` on a
+    2-part partition (so the interface-assembly psum exists).  With
+    ``nrhs`` > 1 the BATCHED body (``pcg_many``) is traced instead —
+    the documented counts must hold unchanged (payloads widen with the
+    block, the collective count must not)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+    from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+    from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
+    from pcg_mpi_solver_tpu.parallel.partition import partition_model
+    from pcg_mpi_solver_tpu.solver.driver import _data_specs
+    from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_many
+
+    model = make_cube_model(3, 3, 3)
+    pm = partition_model(model, 2)
+    if pm.n_iface == 0:
+        raise RuntimeError("2-part partition produced no interface dofs; "
+                           "the documented counts assume the iface psum")
+    ops = Ops.from_model(pm, dot_dtype=jnp.float64, axis_name=PARTS_AXIS)
+    data = device_data(pm, jnp.float64)
+    mesh = make_mesh(2)
+    P = jax.sharding.PartitionSpec(PARTS_AXIS)
+
+    def step(data, fext, x0, inv_diag):
+        solve = pcg_many if nrhs > 1 else pcg
+        res = solve(ops, data, fext, x0, inv_diag, tol=1e-8, max_iter=50,
+                    glob_n_dof_eff=pm.glob_n_dof_eff, variant=variant)
+        return res.x
+
+    fn = jax.shard_map(step, mesh=mesh,
+                       in_specs=(_data_specs(data), P, P, P),
+                       out_specs=P, check_vma=False)
+    shape = ((pm.n_parts, pm.n_loc, nrhs) if nrhs > 1
+             else (pm.n_parts, pm.n_loc))
+    vec = jnp.zeros(shape, jnp.float64)
+    inv = jnp.zeros((pm.n_parts, pm.n_loc), jnp.float64)
+    jaxpr = jax.make_jaxpr(fn)(data, vec, vec, inv)
+    bodies = _while_bodies(jaxpr.jaxpr, [])
+    counts = [count_psums(b) for b in bodies]
+    hits = [c for c in counts if c > 0]
+    if len(hits) != 1:
+        raise RuntimeError(
+            f"expected exactly one psum-bearing while body for "
+            f"variant={variant!r} nrhs={nrhs}, found counts {counts}")
+    return hits[0]
+
+
+def run_checks(nrhs_batched: int = 8) -> list:
+    """Returns a list of error strings (empty = counts hold).  Checks
+    both the single-RHS bodies and the batched bodies at
+    ``nrhs_batched`` columns: the counts must be equal — psum count
+    independent of the RHS-block width."""
+    errs = []
+    counts = {}
+    for variant, want in EXPECTED_BODY_PSUMS.items():
+        got = counts[variant] = iteration_psum_count(variant)
+        if got != want:
+            errs.append(f"{variant}: {got} psums in the loop body, "
+                        f"documented count is {want}")
+        got_b = iteration_psum_count(variant, nrhs=nrhs_batched)
+        if got_b != want:
+            errs.append(f"{variant} batched (nrhs={nrhs_batched}): "
+                        f"{got_b} psums in the loop body, must equal the "
+                        f"nrhs=1 count {want}")
+    if not errs and counts["fused"] != counts["classic"] - 2:
+        errs.append(f"fused must save exactly the two serialized scalar "
+                    f"reductions: classic={counts['classic']} "
+                    f"fused={counts['fused']}")
+    return errs

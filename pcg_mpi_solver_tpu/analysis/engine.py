@@ -1,0 +1,235 @@
+"""Contract-lint rule engine: registry, findings, baseline, reports.
+
+The analysis/ subsystem statically proves the framework's structural
+claims — collective counts, hot-loop purity, dtype discipline, donation
+aliasing, cache-key/fingerprint completeness — plus the source/artifact
+lints that used to live as disconnected scripts under tools/.  This
+module is the jax-free core: rules declare themselves into ``RULES`` via
+the :func:`rule` decorator; jaxpr-level rules import jax lazily inside
+their run function, so ``import pcg_mpi_solver_tpu.analysis`` configures
+nothing and touches no accelerator (the same contract as the package
+``__init__``).
+
+Severity model: every violated invariant is an ``error`` (exit 1);
+``warn`` findings are reported but do not fail the lint.  A checked-in
+baseline file (``analysis/baseline.json``) suppresses known, documented
+findings by exact (rule, loc) match — the shipped baseline is EMPTY and
+should stay so; suppressions are for incident triage, not steady state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: shipped (empty) baseline — the --baseline default.
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+BASELINE_SCHEMA = "pcg-tpu-lint-baseline/1"
+REPORT_SCHEMA = "pcg-tpu-lint-report/1"
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation.  ``loc`` is the stable anchor used for
+    baseline matching: ``path:line`` for source rules, ``program:<name>``
+    / ``surface:<name>`` / ``field:<name>`` for traced-program rules."""
+
+    rule: str
+    loc: str
+    message: str
+    severity: str = "error"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"[{self.rule}] {self.loc}: {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    kind: str          # "ast" | "artifact" | "jaxpr" | "config"
+    fast: bool         # included in --fast (pre-hardware-window gate)
+    doc: str
+    fn: Callable[["Context"], List[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, *, kind: str, fast: bool, doc: str):
+    """Register a rule.  ``fn(ctx) -> [Finding]``; raise nothing — an
+    exception is converted into an engine-error finding by the runner."""
+    def deco(fn):
+        RULES[rule_id] = Rule(rule_id, kind, fast, doc, fn)
+        return fn
+    return deco
+
+
+class Context:
+    """Per-run context handed to every rule: mode flags plus the lazily
+    built (and cached) canonical program matrix."""
+
+    def __init__(self, fast: bool = False):
+        self.fast = bool(fast)
+        self.repo = REPO
+
+    def programs(self):
+        from pcg_mpi_solver_tpu.analysis import programs as _p
+
+        return _p.build_programs(fast=self.fast)
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    rules_run: List[str]
+    errors: List[str]
+    fast: bool
+    wall_s: float
+
+    @property
+    def clean(self) -> bool:
+        return not self.errors and not any(
+            f.severity == "error" for f in self.findings)
+
+    @property
+    def exit_code(self) -> int:
+        if self.errors:
+            return 2
+        return 0 if self.clean else 1
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": REPORT_SCHEMA,
+            "fast": self.fast,
+            "clean": self.clean,
+            "rules_run": list(self.rules_run),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "errors": list(self.errors),
+            "wall_s": round(self.wall_s, 3),
+        }
+
+    def render(self) -> str:
+        lines = []
+        for f in self.findings:
+            lines.append(str(f))
+        for f in self.suppressed:
+            lines.append(f"(baselined) {f}")
+        for e in self.errors:
+            lines.append(f"[engine-error] {e}")
+        n_err = sum(1 for f in self.findings if f.severity == "error")
+        mode = "fast" if self.fast else "full"
+        lines.append(
+            f"pcg-tpu lint ({mode}): {len(self.rules_run)} rule(s), "
+            f"{n_err} error(s), {len(self.suppressed)} baselined, "
+            f"{len(self.errors)} engine error(s) "
+            f"[{self.wall_s:.1f}s]")
+        return "\n".join(lines)
+
+
+def load_baseline(path: Optional[str]) -> List[dict]:
+    """Suppression entries from a baseline file; missing file => empty.
+    Entry shape: {"rule": id, "loc": anchor, "reason": why} — reason is
+    mandatory, an undocumented suppression is itself a finding."""
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(f"{path}: expected baseline schema "
+                         f"{BASELINE_SCHEMA!r}, got {doc.get('schema')!r}")
+    return list(doc.get("suppressions", []))
+
+
+def apply_baseline(findings: List[Finding], entries: List[dict],
+                   ) -> tuple:
+    """(active, suppressed): exact (rule, loc) match suppresses; entries
+    without a reason are converted into findings so the baseline cannot
+    silently grow undocumented, and entries matching NO current finding
+    surface as warn findings — a stale suppression would otherwise mask
+    the same defect if it ever regressed at that anchor."""
+    keys = {(e.get("rule"), e.get("loc")) for e in entries
+            if e.get("reason")}
+    active, suppressed, hit = [], [], set()
+    for f in findings:
+        if (f.rule, f.loc) in keys:
+            suppressed.append(f)
+            hit.add((f.rule, f.loc))
+        else:
+            active.append(f)
+    for e in entries:
+        if not e.get("reason"):
+            active.append(Finding(
+                rule="baseline", loc=str(e.get("loc")),
+                message=f"baseline suppression for rule "
+                        f"{e.get('rule')!r} carries no reason — document "
+                        "it or delete it"))
+        elif (e.get("rule"), e.get("loc")) not in hit:
+            active.append(Finding(
+                rule="baseline", loc=str(e.get("loc")), severity="warn",
+                message=f"stale suppression: rule {e.get('rule')!r} no "
+                        "longer reports here — delete the entry so a "
+                        "future regression at this anchor is not "
+                        "silently masked"))
+    return active, suppressed
+
+
+def _ensure_rules_registered() -> None:
+    # import for the registration side effect; all four modules are
+    # import-light (jax only inside rule bodies)
+    from pcg_mpi_solver_tpu.analysis import (  # noqa: F401
+        rules_artifacts, rules_ast, rules_config, rules_jaxpr)
+
+
+def run_lint(fast: bool = False, rule_ids: Optional[List[str]] = None,
+             baseline_path: Optional[str] = DEFAULT_BASELINE) -> Report:
+    """Run the registered rules and return a :class:`Report`.
+
+    ``fast`` runs the pre-hardware-window subset (source/artifact rules
+    plus the collective/purity proofs on the reduced program matrix);
+    ``rule_ids`` restricts to specific rules (unknown id => ValueError).
+    """
+    _ensure_rules_registered()
+    t0 = time.monotonic()
+    if rule_ids:
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            raise ValueError(f"unknown rule id(s) {unknown}; have "
+                             f"{sorted(RULES)}")
+        selected = [RULES[r] for r in rule_ids]
+    else:
+        selected = [r for r in RULES.values() if r.fast or not fast]
+    ctx = Context(fast=fast)
+    findings: List[Finding] = []
+    errors: List[str] = []
+    rules_run: List[str] = []
+    for r in sorted(selected, key=lambda r: (r.kind, r.id)):
+        try:
+            findings.extend(r.fn(ctx))
+            rules_run.append(r.id)
+        except Exception:  # noqa: BLE001 - reported as an engine error
+            errors.append(f"rule {r.id} crashed:\n"
+                          f"{traceback.format_exc()}")
+    entries = load_baseline(baseline_path)
+    active, suppressed = apply_baseline(findings, entries)
+    return Report(findings=active, suppressed=suppressed,
+                  rules_run=rules_run, errors=errors, fast=fast,
+                  wall_s=time.monotonic() - t0)
+
+
+def list_rules() -> List[Rule]:
+    _ensure_rules_registered()
+    return sorted(RULES.values(), key=lambda r: (r.kind, r.id))

@@ -1,0 +1,179 @@
+"""Jaxpr traversal helpers for the contract-lint rules (analysis/).
+
+Pure structural walkers over already-traced jaxpr objects — duck-typed
+(``eqn.primitive.name`` / ``eqn.params`` / ``aval.dtype``) so this module
+imports neither jax nor the solver stack; the tracing itself lives in
+:mod:`pcg_mpi_solver_tpu.analysis.programs`.  The one convention baked in
+here: higher-order primitives carry their sub-programs as (Closed)Jaxpr
+values inside ``eqn.params`` (``while`` -> cond/body, ``cond`` ->
+branches, ``pjit``/``shard_map``/``custom_*`` -> the inner program), and
+a ClosedJaxpr unwraps via its ``.jaxpr`` attribute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Tuple
+
+import numpy as np
+
+
+def sub_jaxprs(eqn) -> List[Any]:
+    """Nested (unwrapped) jaxprs of one equation's params."""
+    out = []
+    for v in eqn.params.values():
+        for item in (v if isinstance(v, (list, tuple)) else [v]):
+            j = getattr(item, "jaxpr", item)
+            if hasattr(j, "eqns"):
+                out.append(j)
+    return out
+
+
+def iter_eqns(jaxpr) -> Iterator[Any]:
+    """All equations of ``jaxpr``, recursing into every nested program."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for j in sub_jaxprs(eqn):
+            yield from iter_eqns(j)
+
+
+def count_primitive(jaxpr, name: str) -> int:
+    return sum(1 for eqn in iter_eqns(jaxpr) if eqn.primitive.name == name)
+
+
+def collective_histogram(jaxpr, names=("psum", "ppermute", "all_gather",
+                                       "all_to_all", "pmax", "pmin")) -> dict:
+    """{primitive name: count} over ``jaxpr`` for the collective
+    primitives in ``names`` (zero counts omitted)."""
+    hist: Dict[str, int] = {}
+    for eqn in iter_eqns(jaxpr):
+        n = eqn.primitive.name
+        if n in names:
+            hist[n] = hist.get(n, 0) + 1
+    return hist
+
+
+def while_eqns(jaxpr) -> List[Any]:
+    return [e for e in iter_eqns(jaxpr) if e.primitive.name == "while"]
+
+
+def while_body(eqn):
+    """The (unwrapped) body jaxpr of one ``while`` equation."""
+    return eqn.params["body_jaxpr"].jaxpr
+
+
+def body_collective_histograms(closed_jaxpr) -> List[dict]:
+    """Collective histogram of every while-loop body in a traced program
+    (the hot-loop contract surface), outermost-first."""
+    return [collective_histogram(while_body(e))
+            for e in while_eqns(closed_jaxpr.jaxpr)]
+
+
+# ---------------------------------------------------------------------------
+# Constant tracking: jax hoists trace-time (host-folded) array constants
+# out of loop bodies — a big np array captured by a while body shows up as
+# a constvar of some enclosing program, threaded positionally through
+# pjit/shard_map call boundaries into the while equation's invars.  To
+# prove "no folded constant above N elements feeds the hot loop", walk
+# with an env mapping vars -> known constant values and resolve each
+# while eqn's invars against it.
+# ---------------------------------------------------------------------------
+
+def _const_size(c) -> int:
+    try:
+        return int(np.asarray(c).size)
+    except Exception:  # noqa: BLE001 - unsizeable const: treat as scalar
+        return 1
+
+
+def while_captured_consts(closed_jaxpr) -> List[Tuple[Any, Any]]:
+    """(while_eqn, const_value) pairs for every while-equation operand
+    that resolves to a trace-time constant, across all nesting levels."""
+    found: List[Tuple[Any, Any]] = []
+
+    def walk(jaxpr, env: Dict[int, Any]):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "while":
+                for v in eqn.invars:
+                    if id(v) in env:
+                        found.append((eqn, env[id(v)]))
+            for item in eqn.params.values():
+                for sub in (item if isinstance(item, (list, tuple))
+                            else [item]):
+                    inner = getattr(sub, "jaxpr", sub)
+                    if not hasattr(inner, "eqns"):
+                        continue
+                    sub_env = dict(env)
+                    # a ClosedJaxpr contributes its own consts
+                    consts = getattr(sub, "consts", None)
+                    if consts is not None:
+                        for cv, c in zip(inner.constvars, consts):
+                            sub_env[id(cv)] = c
+                    # positional remap across the call boundary
+                    # (pjit/shard_map-style: eqn invars <-> inner invars)
+                    if len(inner.invars) == len(eqn.invars):
+                        for outer, innerv in zip(eqn.invars, inner.invars):
+                            if id(outer) in env:
+                                sub_env[id(innerv)] = env[id(outer)]
+                    walk(inner, sub_env)
+
+    env0: Dict[int, Any] = {}
+    for cv, c in zip(closed_jaxpr.jaxpr.constvars, closed_jaxpr.consts):
+        env0[id(cv)] = c
+    walk(closed_jaxpr.jaxpr, env0)
+    return found
+
+
+def oversized_loop_consts(closed_jaxpr, threshold_elems: int) -> List[dict]:
+    """Folded constants above ``threshold_elems`` elements feeding a
+    while loop: each entry carries the element count and dtype/shape
+    labels for the finding message."""
+    out = []
+    for _eqn, c in while_captured_consts(closed_jaxpr):
+        n = _const_size(c)
+        if n > threshold_elems:
+            arr = np.asarray(c)
+            out.append({"size": n, "shape": tuple(arr.shape),
+                        "dtype": str(arr.dtype)})
+    return out
+
+
+def dtype_violations(closed_jaxpr, forbidden: str = "float64") -> List[dict]:
+    """Equations whose operands/results carry ``forbidden``-dtype avals.
+
+    Weak-typed SCALARS are exempt: under x64, python float literals enter
+    the trace as ``float64 weak_type=True`` and immediately convert to
+    the storage dtype — a lowering artifact, not a precision leak."""
+    seen = []
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name == "pjit":
+            continue  # the inner jaxpr is walked on its own
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is None or str(dt) != forbidden:
+                continue
+            if getattr(aval, "weak_type", False) and \
+                    not getattr(aval, "shape", ()):
+                continue
+            seen.append({"primitive": eqn.primitive.name,
+                         "aval": str(aval)})
+    return seen
+
+
+def find_primitives(closed_jaxpr, names) -> List[str]:
+    """Names from ``names`` that occur anywhere in the program."""
+    names = set(names)
+    return sorted({e.primitive.name for e in iter_eqns(closed_jaxpr.jaxpr)
+                   if e.primitive.name in names})
+
+
+def loop_body_primitives(closed_jaxpr, names) -> List[str]:
+    """Names from ``names`` that occur inside any while-loop body."""
+    names = set(names)
+    hits = set()
+    for eqn in while_eqns(closed_jaxpr.jaxpr):
+        body = while_body(eqn)
+        for e in iter_eqns(body):
+            if e.primitive.name in names:
+                hits.add(e.primitive.name)
+    return sorted(hits)

@@ -1,0 +1,77 @@
+"""Artifact rules: committed JSON artifacts validate against the
+versioned contracts in ``obs/schema.py``.
+
+``telemetry-schema`` covers the files ``tools/check_telemetry_schema.py``
+(now a thin shim over this module) historically linted:
+
+* ``*.jsonl``          — telemetry event streams (``--telemetry-out``)
+* ``BENCH_*.json``     — bench round artifacts (raw line or round
+                         wrapper; failed-round wrappers with
+                         ``parsed: null`` pass)
+* ``bench_*.json``     — provisional/salvage side files from bench.py
+
+Import-light on purpose (obs/schema.py is jax/numpy-free): this runs in
+the --fast gate.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List
+
+from pcg_mpi_solver_tpu.analysis.engine import REPO, Finding, rule
+
+
+def default_paths() -> list:
+    """The committed artifacts the tier-1 check covers."""
+    return sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+
+
+def check_file(path: str) -> list:
+    """Validate one artifact; returns error strings prefixed with path."""
+    from pcg_mpi_solver_tpu.obs.schema import (
+        validate_bench_text, validate_jsonl_text)
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    name = os.path.basename(path)
+    if name.endswith(".jsonl"):
+        errs = validate_jsonl_text(text)
+    elif name.endswith(".json"):
+        if name.startswith("bench_salvage"):
+            # salvage wrapper: {"lines": [{"line": <bench json str>}]}
+            errs = []
+            try:
+                doc = json.loads(text)
+            except ValueError as e:
+                errs = [f"not JSON ({e})"]
+            else:
+                for i, entry in enumerate(doc.get("lines", [])):
+                    errs.extend(
+                        f"lines[{i}]: {e}"
+                        for e in validate_bench_text(entry.get("line", "")))
+        else:
+            errs = validate_bench_text(text)
+    else:
+        errs = ["unrecognized artifact type (expected .json/.jsonl)"]
+    return [f"{path}: {e}" for e in errs]
+
+
+@rule("telemetry-schema", kind="artifact", fast=True,
+      doc="committed BENCH_*.json artifacts (and any telemetry JSONL) "
+          "validate against the versioned obs/schema.py contracts")
+def telemetry_schema_rule(ctx) -> List[Finding]:
+    findings = []
+    for p in default_paths():
+        for err in check_file(p):
+            loc, _, msg = err.partition(": ")
+            findings.append(Finding(
+                rule="telemetry-schema",
+                loc=os.path.relpath(loc, REPO),
+                message=msg or err))
+    return findings

@@ -1,0 +1,137 @@
+"""AST-level source rules.
+
+``recovery-paths``: no silently-swallowed broad exception handlers in
+the solve/cache/recovery/ops/parallel/obs layers.  The resilience
+posture only works if every broad ``except`` either **re-raises**
+(possibly after cleanup), **records** what happened (a metrics call —
+``.event``/``.inc``/``.note``/``.gauge`` — a ``warnings.warn``, or the
+bench's ``_log``), or carries an explicit ``# noqa: BLE001``
+justification on the handler line (the repo convention for best-effort
+cache/IO paths where a failure legitimately degrades to a miss).
+
+This is the engine-native home of the logic ``tools/
+check_recovery_paths.py`` exposes as a standalone CLI (that script is
+now a thin shim over this module).  Scope extension (ISSUE 7): ``ops/``,
+``parallel/`` and ``obs/`` joined the historical
+solver/cache/resilience/validate set — a swallowed matvec/mesh/telemetry
+failure hides a wrong answer exactly as effectively as a swallowed
+recovery failure.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from pcg_mpi_solver_tpu.analysis.engine import REPO, Finding, rule
+
+PKG = os.path.join(REPO, "pcg_mpi_solver_tpu")
+
+#: scanned packages: the historical recovery scope + the ISSUE-7
+#: extension (ops/parallel/obs).
+DEFAULT_SCOPE = (
+    os.path.join(PKG, "solver"),
+    os.path.join(PKG, "cache"),
+    os.path.join(PKG, "resilience"),
+    os.path.join(PKG, "validate"),
+    os.path.join(PKG, "ops"),
+    os.path.join(PKG, "parallel"),
+    os.path.join(PKG, "obs"),
+)
+
+# Exception names considered "broad" when caught: anything narrower
+# (OSError, ValueError, ...) expresses an expectation and is exempt.
+_BROAD = {"Exception", "BaseException"}
+
+# A call to any of these names (bare or attribute) inside the handler
+# counts as recording the failure.
+_LOG_CALLS = {"event", "inc", "note", "gauge", "warn", "warning",
+              "exception", "_log"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:                            # bare `except:`
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return any(n in _BROAD for n in names)
+
+
+def _handler_ok(handler: ast.ExceptHandler, lines: List[str]) -> bool:
+    # explicit justification on the `except` line (repo convention)
+    line = lines[handler.lineno - 1]
+    if "noqa" in line and "BLE001" in line:
+        return True
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else getattr(f, "id", ""))
+            if name in _LOG_CALLS:
+                return True
+    return False
+
+
+def check_source(source: str, path: str = "<source>") -> List[str]:
+    """Violations in one python source blob (path used for labels)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [f"{path}: unparseable ({e})"]
+    lines = source.splitlines()
+    errs = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                and not _handler_ok(node, lines):
+            errs.append(
+                f"{path}:{node.lineno}: broad `except` neither re-raises, "
+                "logs a metrics/warning event, nor carries a "
+                "`# noqa: BLE001` justification")
+    return errs
+
+
+def check_file(path: str) -> List[str]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    return check_source(source, path)
+
+
+def iter_py_files(paths) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                out.extend(os.path.join(root, fn) for fn in sorted(files)
+                           if fn.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+@rule("recovery-paths", kind="ast", fast=True,
+      doc="every broad `except` in solver/cache/resilience/validate/ops/"
+          "parallel/obs re-raises, records, or carries # noqa: BLE001")
+def recovery_paths_rule(ctx) -> List[Finding]:
+    findings = []
+    for f in iter_py_files(DEFAULT_SCOPE):
+        for err in check_file(f):
+            # err is "path:line: message" — split the anchor off for the
+            # baseline-stable loc
+            loc, _, msg = err.partition(": ")
+            findings.append(Finding(
+                rule="recovery-paths",
+                loc=os.path.relpath(loc, REPO),
+                message=msg))
+    return findings

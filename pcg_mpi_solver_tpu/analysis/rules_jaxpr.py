@@ -1,0 +1,162 @@
+"""Jaxpr-level rules over the canonical traced-program matrix.
+
+Each rule's checker is exposed as a ``check_*`` function taking one
+:class:`~pcg_mpi_solver_tpu.analysis.programs.Program` (or donation
+surface), so the seeded-violation tests can feed deliberately-bad
+synthetic programs through EXACTLY the code the registered rule runs.
+
+This module stays import-light: jax (via analysis.programs) loads only
+when a rule executes.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from pcg_mpi_solver_tpu.analysis.engine import Finding, rule
+
+
+# ---------------------------------------------------------------------------
+# collective-budget: the loop body runs EXACTLY the declared collectives
+# ---------------------------------------------------------------------------
+
+def check_collective_budget(prog) -> List[Finding]:
+    """The traced while-body collective histogram must EQUAL the budget
+    the ops declared (Ops.body_collective_budget — the same table the
+    comm.* telemetry gauges advertise).  Exactly one collective-bearing
+    loop body per canonical program; extra primitives, extra counts AND
+    under-counts all fail (an under-count means the declaration is stale
+    — the gauges would be advertising collectives that do not exist)."""
+    from pcg_mpi_solver_tpu.analysis import jaxpr_utils as ju
+
+    hists = [h for h in ju.body_collective_histograms(prog.jaxpr) if h]
+    loc = f"program:{prog.name}"
+    if len(hists) != 1:
+        return [Finding(
+            rule="collective-budget", loc=loc,
+            message=f"expected exactly one collective-bearing while body,"
+                    f" found {len(hists)} (histograms: {hists}) — the "
+                    "canonical program shape changed; re-derive the "
+                    "budget declarations")]
+    got = hists[0]
+    want = {k: v for k, v in prog.collective_budget.items() if v}
+    if got != want:
+        return [Finding(
+            rule="collective-budget", loc=loc,
+            message=f"loop-body collectives {got} != declared budget "
+                    f"{want} (Ops.body_collective_budget / comm.* "
+                    "gauges): a re-serialized reduction or an undeclared "
+                    "collective is in the hot body")]
+    return []
+
+
+@rule("collective-budget", kind="jaxpr", fast=True,
+      doc="traced PCG loop-body psum/ppermute counts equal the budgets "
+          "declared next to Ops.comm_estimate, for every variant x nrhs "
+          "x backend program")
+def collective_budget_rule(ctx) -> List[Finding]:
+    out = []
+    for prog in ctx.programs():
+        out.extend(check_collective_budget(prog))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hot-loop-purity: no host callbacks, no oversized folded constants
+# ---------------------------------------------------------------------------
+
+def check_hot_loop_purity(prog, threshold_elems=None) -> List[Finding]:
+    from pcg_mpi_solver_tpu.analysis import jaxpr_utils as ju
+    from pcg_mpi_solver_tpu.analysis.programs import (
+        CALLBACK_PRIMITIVES, LOOP_CONST_THRESHOLD_ELEMS)
+
+    if threshold_elems is None:
+        threshold_elems = LOOP_CONST_THRESHOLD_ELEMS
+    loc = f"program:{prog.name}"
+    out = []
+    hits = ju.loop_body_primitives(prog.jaxpr, CALLBACK_PRIMITIVES)
+    if hits:
+        out.append(Finding(
+            rule="hot-loop-purity", loc=loc,
+            message=f"callback primitive(s) {hits} inside a while-loop "
+                    "body: every Krylov iteration would round-trip to "
+                    "the host"))
+    for c in ju.oversized_loop_consts(prog.jaxpr, threshold_elems):
+        out.append(Finding(
+            rule="hot-loop-purity", loc=loc,
+            message=f"folded constant {c['dtype']}{list(c['shape'])} "
+                    f"({c['size']} elems > {threshold_elems}) feeds the "
+                    "while loop: a trace-time-captured operand array "
+                    "bloats every AOT export (pass it as a program "
+                    "argument instead)"))
+    return out
+
+
+@rule("hot-loop-purity", kind="jaxpr", fast=True,
+      doc="no pure_callback/io_callback/debug_callback primitives and no "
+          "folded constants above the size threshold inside any traced "
+          "while-loop body")
+def hot_loop_purity_rule(ctx) -> List[Finding]:
+    out = []
+    for prog in ctx.programs():
+        out.extend(check_hot_loop_purity(prog))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dtype-discipline: f32 programs stay f32
+# ---------------------------------------------------------------------------
+
+def check_dtype_discipline(prog) -> List[Finding]:
+    """No f64 avals anywhere in an f32-role program (weak-typed scalar
+    literals exempt — see jaxpr_utils.dtype_violations).  The mixed
+    escalation engine's explicitly-f64 refinement programs are role
+    'f64' and out of scope by construction."""
+    from pcg_mpi_solver_tpu.analysis import jaxpr_utils as ju
+
+    if prog.role != "f32":
+        return []
+    leaks = ju.dtype_violations(prog.jaxpr, "float64")
+    if not leaks:
+        return []
+    prims = sorted({d["primitive"] for d in leaks})
+    sample = leaks[0]
+    return [Finding(
+        rule="dtype-discipline", loc=f"program:{prog.name}",
+        message=f"{len(leaks)} float64 operand(s)/result(s) in an f32 "
+                f"step program (primitives {prims}; e.g. "
+                f"{sample['primitive']} on {sample['aval']}): an f64 "
+                "leak silently halves MXU throughput and doubles psum "
+                "payloads")]
+
+
+@rule("dtype-discipline", kind="jaxpr", fast=True,
+      doc="no f64 avals leak into the all-f32 step programs (weak scalar "
+          "literals exempt; the escalation engine's f64 programs are out "
+          "of scope)")
+def dtype_discipline_rule(ctx) -> List[Finding]:
+    out = []
+    for prog in ctx.programs():
+        out.extend(check_dtype_discipline(prog))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# donation-integrity: donate_carry surfaces really alias
+# ---------------------------------------------------------------------------
+
+@rule("donation-integrity", kind="jaxpr", fast=False,
+      doc="every donate_carry dispatch surface produces input/output "
+          "buffer aliasing in the lowered+compiled executable (jax drops "
+          "unusable donations SILENTLY — the copy shows up only as HBM "
+          "and latency)")
+def donation_integrity_rule(ctx) -> List[Finding]:
+    from pcg_mpi_solver_tpu.analysis import programs as ap
+
+    out = []
+    for surface in ap.donation_surfaces():
+        for err in ap.check_donation(surface):
+            out.append(Finding(rule="donation-integrity",
+                               loc=f"surface:{surface.name}",
+                               message=err))
+    return out

@@ -14,6 +14,7 @@ orchestrated by examples/run_basic_script.bash) as one typed CLI.
     pcg-tpu bench                                      # benchmark harness
     pcg-tpu warmup    <scratch> [options]              # pre-bake caches
     pcg-tpu cache-stats [--cache-dir D]                # warm-path cache table
+    pcg-tpu lint      [--fast] [--json F]              # contract lint (analysis/)
 
 Settings come from ``--settings settings.json`` (same shape as the
 reference's GlobSettings: TimeHistoryParam/SolverParam,
@@ -570,6 +571,21 @@ def cmd_bench(args):
     bench_main()
 
 
+def cmd_lint(args):
+    """Contract lint (analysis/): statically prove the solver's
+    structural claims — loop-body collective budgets, hot-loop purity,
+    f32 dtype discipline, donated-carry aliasing, cache-key/snapshot-
+    fingerprint completeness, plus the recovery-path and telemetry-
+    schema source/artifact lints.  Runs on CPU (the env is pinned before
+    jax initializes); exit 0 = every invariant holds."""
+    from pcg_mpi_solver_tpu.analysis.__main__ import run, setup_cpu_env
+
+    setup_cpu_env()
+    rc = run(args)
+    if rc:
+        raise SystemExit(rc)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="pcg-tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -780,6 +796,20 @@ def main(argv=None):
 
     p = sub.add_parser("bench", help="benchmark harness (prints one JSON line)")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser("lint",
+                       help="contract lint (analysis/): statically prove "
+                            "collective budgets, hot-loop purity, dtype "
+                            "discipline, donation aliasing and cache-key/"
+                            "fingerprint completeness on CPU (see "
+                            "docs/ANALYSIS.md)")
+    # ONE option surface shared with `python -m pcg_mpi_solver_tpu.analysis`
+    # (the same runner) — defined once so the two entry points cannot
+    # drift.  analysis/ imports are jax-free, so this is safe here.
+    from pcg_mpi_solver_tpu.analysis.__main__ import add_lint_args
+
+    add_lint_args(p)
+    p.set_defaults(fn=cmd_lint)
 
     args = ap.parse_args(argv)
     args.fn(args)

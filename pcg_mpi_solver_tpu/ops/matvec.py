@@ -40,6 +40,30 @@ from pcg_mpi_solver_tpu.utils.compat import ensure_shard_map
 # jax-free for bench.py's env-ordering contract).
 ensure_shard_map()
 
+# ---------------------------------------------------------------------------
+# Declared per-iteration collective contract of the PCG loop formulations
+# (SolverConfig.pcg_variant).  ONE source of truth consumed by BOTH the
+# telemetry gauges (Ops.comm_estimate below) and the static proof
+# (analysis/ collective-budget rule + tools/check_collectives.py), so the
+# advertised counts and the jaxpr-level verification can never diverge.
+#
+# * classic — MATLAB-compatible loop: three serialized scalar/fused psums
+#   per iteration (rho+inf-prec, p.q, the fused 3-norm).
+# * fused   — Chronopoulos–Gear recurrence: ONE fused psum carries all six
+#   reduced scalars plus the inf-prec flag.
+#
+# Changing a loop body (e.g. adding pcg_variant="pipelined") REQUIRES a
+# row here: an unknown variant is a KeyError in both the gauges and the
+# budget — the lint fails loudly instead of silently re-serializing.
+PCG_SCALAR_PSUMS = {"classic": 3, "fused": 1}
+
+# The deferred mode-1 true-residual check lives INSIDE the traced while
+# body (both branches of the conditional are part of the body jaxpr), and
+# its recomputed residual norm costs one more psum on the trace — a
+# healthy mode-0 trip never executes it, so it is budgeted separately
+# from the per-iteration gauges.
+PCG_DEFERRED_CHECK_PSUMS = 1
+
 
 def device_data(pm: PartitionedModel, dtype=jnp.float64,
                 flat: Optional[bool] = None, blocks: bool = True) -> dict:
@@ -497,12 +521,18 @@ class Ops:
         one collective whose payload is the shared-dof vector.
         ``bytes_per_iter_est`` is the per-device psum payload, not link
         traffic (the actual wire cost depends on the all-reduce
-        algorithm and topology)."""
+        algorithm and topology).
+
+        The per-iteration scalar-psum count comes from
+        ``PCG_SCALAR_PSUMS`` (declared above) — the SAME table the
+        collective-budget lint rule (analysis/) proves against the
+        traced loop-body jaxpr, so these gauges can never advertise a
+        count the static proof does not hold."""
         itemsize = jnp.dtype(storage_dtype if storage_dtype is not None
                              else self.dot_dtype).itemsize
         dot_bytes = jnp.dtype(self.dot_dtype).itemsize
         n_iface = int(self.n_iface)
-        scalar_psums = 1 if variant == "fused" else 3
+        scalar_psums = PCG_SCALAR_PSUMS[variant]
         return {
             "pcg_variant": variant,
             "psums_per_iter": scalar_psums + (1 if n_iface else 0),
@@ -510,6 +540,21 @@ class Ops:
             "reduce_scalars_per_iter": 6,
             "bytes_per_iter_est": n_iface * itemsize + 6 * dot_bytes,
         }
+
+    def body_collective_budget(self, variant: str = "classic") -> dict:
+        """Per-primitive collective budget of the TRACED PCG while-loop
+        body, the contract the analysis/ collective-budget rule proves
+        against every canonical program's jaxpr (and the single source
+        ``tools/check_collectives.py`` documents).  Differs from the
+        healthy-iteration gauge above because the traced body carries
+        BOTH conditional branches: the deferred mode-1 true-residual
+        check contributes ``PCG_DEFERRED_CHECK_PSUMS`` extra norm
+        psum(s) that a healthy (mode-0) trip never executes.  Keyed per
+        primitive so a re-serialized reduction OR a new collective kind
+        sneaking into the hot body both fail the lint."""
+        return {"psum": (PCG_SCALAR_PSUMS[variant]
+                         + (1 if int(self.n_iface) else 0)
+                         + PCG_DEFERRED_CHECK_PSUMS)}
 
     def diag(self, data: dict) -> jnp.ndarray:
         return self.iface_assemble(data, self.diag_local(data))

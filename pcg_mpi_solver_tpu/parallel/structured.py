@@ -180,6 +180,12 @@ _CORNERS = HEX_CORNERS.astype(np.int64)  # (8, 3)
 
 VALID_FORMS = ("gse", "gsplit", "corner")
 
+# Declared collective cost of one _halo exchange on a sharded multi-part
+# slab: one bidirectional plane swap = 2 ppermutes per matvec.  Part of
+# StructuredOps.body_collective_budget — the contract the analysis/
+# collective-budget rule proves against the traced PCG body jaxpr.
+STENCIL_HALO_PPERMUTES = 2
+
 
 def matvec_form() -> str:
     """The PCG_TPU_MATVEC_FORM knob, validated — the ONE place its
@@ -323,6 +329,19 @@ class StructuredOps(Ops):
         for t in terms[1:]:
             y = y + t
         return y
+
+    def body_collective_budget(self, variant: str = "classic") -> dict:
+        """Structured-slab collective contract of the PCG loop body: the
+        scalar psums + deferred-check psum from the base table (no iface
+        psum — n_iface is 0 by construction; boundary planes combine via
+        _halo instead), plus the halo exchange's ``STENCIL_HALO_PPERMUTES``
+        ppermutes per matvec.  Proven against the traced body jaxpr by the
+        analysis/ collective-budget rule — a stencil change that adds
+        shifts must update the declaration consciously."""
+        budget = dict(super().body_collective_budget(variant))
+        if self.n_parts > 1 and self.axis_name is not None:
+            budget["ppermute"] = STENCIL_HALO_PPERMUTES
+        return budget
 
     def _halo(self, yg):
         """Combine partial sums on shared slab-boundary planes: one

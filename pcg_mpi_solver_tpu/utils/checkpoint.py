@@ -101,6 +101,21 @@ def _fingerprint(solver) -> dict:
         "nrhs": 1,
         "tol": float(cfg.solver.tol),
         "max_iter": int(cfg.solver.max_iter),
+        # every remaining trace-affecting numerics knob (found
+        # mechanically by the analysis/ fingerprint-completeness rule —
+        # the PR-5/PR-6 bug class, closed wholesale): the reduction
+        # accumulation dtype, the MATLAB stagnation window, the mixed
+        # engine's cycle tolerance + exit knobs, and the in-graph trace
+        # ring length (the ring rides the resumable carry pytree, so a
+        # different length is a different carry shape).
+        "dot_dtype": str(np.dtype(cfg.solver.dot_dtype)),
+        "max_stag_steps": int(cfg.solver.max_stag_steps),
+        "inner_tol": float(cfg.solver.inner_tol),
+        "mixed_knobs": [int(cfg.solver.mixed_plateau_window),
+                        int(cfg.solver.mixed_progress_window),
+                        float(cfg.solver.mixed_progress_ratio),
+                        float(cfg.solver.mixed_progress_min_gain)],
+        "trace_len": int(getattr(solver, "trace_len", 0)),
         "deltas": [float(d) for d in th.time_step_delta],
         "export": [bool(th.export_flag), int(th.export_frame_rate),
                    [int(f) for f in th.export_frames], th.export_vars],
@@ -314,6 +329,15 @@ class CheckpointManager:
             # pre-f64_refresh checkpoints can only have come from the
             # stencil formulation (the general form did not exist)
             saved.setdefault("f64_refresh", "stencil")
+            # Checkpoints written before the fingerprint-completeness
+            # sweep (analysis/) did not record the remaining numerics
+            # knobs although the knobs themselves already existed —
+            # their historical values are unknowable, so skip the new
+            # checks for legacy checkpoints rather than guess (the
+            # matvec_form precedent above).
+            for k in ("dot_dtype", "max_stag_steps", "inner_tol",
+                      "mixed_knobs", "trace_len"):
+                saved.setdefault(k, want[k])
             if saved != want:
                 diffs = {k: (saved.get(k), want[k]) for k in want
                          if saved.get(k) != want[k]}
@@ -512,6 +536,16 @@ class SnapshotStore:
         # fingerprint without it must keep comparing equal to itself.
         if self.fingerprint is not None and "nrhs" in self.fingerprint:
             saved.setdefault("nrhs", 1)
+        if self.fingerprint is not None:
+            # snapshots written before the fingerprint-completeness
+            # sweep (analysis/) did not record these numerics knobs;
+            # their historical values are unknowable — skip the new
+            # checks for legacy snapshots rather than guess (same
+            # rationale and guard as the nrhs shim above)
+            for k in ("dot_dtype", "max_stag_steps", "inner_tol",
+                      "mixed_knobs", "trace_len"):
+                if k in self.fingerprint:
+                    saved.setdefault(k, self.fingerprint[k])
         if self.fingerprint is not None and saved != self.fingerprint:
             diffs = {k: (saved.get(k), self.fingerprint[k])
                      for k in self.fingerprint

@@ -1,0 +1,449 @@
+"""Contract-lint subsystem (analysis/): engine semantics, and one
+deliberately-violating fixture per rule proving each rule actually FIRES
+— an extra psum over budget, a callback / oversized folded constant in a
+loop body, an f64 leak in an f32 program, a dropped donation, and a
+config field omitted from the cache key / snapshot fingerprint (the
+PR-5/PR-6 review-hardening bug class, now a mechanical failure).
+
+The current tree must be CLEAN: tier-1 runs the fast lint in-process;
+the full pass (donation + fingerprint sweeps, ~30 s) is `slow`-marked
+and exercised by `pcg-tpu lint` / hw_session step 0.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pcg_mpi_solver_tpu.analysis import engine
+from pcg_mpi_solver_tpu.analysis.engine import Finding, apply_baseline
+from pcg_mpi_solver_tpu.analysis.programs import DonationSurface, Program
+from pcg_mpi_solver_tpu.analysis.rules_jaxpr import (
+    check_collective_budget, check_dtype_discipline, check_hot_loop_purity)
+from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
+
+
+# ---------------------------------------------------------------------------
+# synthetic Program fixtures
+# ---------------------------------------------------------------------------
+
+def _toy_program(body_fn, budget, role="f64", dtype=jnp.float64,
+                 n_trips=3):
+    """A 2-part shard_map'd while-loop program, traced like the real
+    canonical matrix entries."""
+    mesh = make_mesh(2)
+    P = jax.sharding.PartitionSpec(PARTS_AXIS)
+
+    def prog(x):
+        def cond(c):
+            return c[0] < n_trips
+
+        return jax.lax.while_loop(cond, body_fn, (0, x))[1]
+
+    fn = jax.jit(jax.shard_map(prog, mesh=mesh, in_specs=(P,),
+                               out_specs=P, check_vma=False))
+    jx = jax.make_jaxpr(fn)(jnp.zeros((2, 8), dtype))
+    return Program(name="toy", backend="general", variant="classic",
+                   nrhs=1, role=role, jaxpr=jx,
+                   collective_budget=budget, n_iface=1)
+
+
+def _body_psums(n):
+    def body(c):
+        i, v = c
+        for _ in range(n):
+            v = v + jax.lax.psum(v, PARTS_AXIS)
+        return i + 1, v
+
+    return body
+
+
+# ---------------------------------------------------------------------------
+# rule: collective-budget
+# ---------------------------------------------------------------------------
+
+def test_collective_budget_clean_within_budget():
+    prog = _toy_program(_body_psums(2), {"psum": 2})
+    assert check_collective_budget(prog) == []
+
+
+def test_collective_budget_fires_on_extra_psum():
+    """The seeded violation: one psum beyond the declared budget — the
+    exact 'silently re-serialized reduction' regression."""
+    prog = _toy_program(_body_psums(3), {"psum": 2})
+    findings = check_collective_budget(prog)
+    assert len(findings) == 1
+    assert findings[0].rule == "collective-budget"
+    assert "'psum': 3" in findings[0].message
+
+
+def test_collective_budget_fires_on_undercounted_budget():
+    """An UNDER-count fails too: the declaration (and the comm.* gauges
+    reading the same table) would advertise collectives that no longer
+    exist."""
+    prog = _toy_program(_body_psums(1), {"psum": 2})
+    assert check_collective_budget(prog) != []
+
+
+def test_collective_budget_fires_on_undeclared_collective_kind():
+    def body(c):
+        i, v = c
+        v = v + jax.lax.psum(v, PARTS_AXIS)
+        v = jax.lax.ppermute(v, PARTS_AXIS, [(0, 1), (1, 0)])
+        return i + 1, v
+
+    prog = _toy_program(body, {"psum": 1})
+    findings = check_collective_budget(prog)
+    assert findings and "ppermute" in findings[0].message
+
+
+def test_budget_table_matches_comm_estimate_gauges():
+    """The gauges and the proof read ONE table (ops/matvec.py): body
+    budget = advertised healthy-iteration psums + the deferred check."""
+    from pcg_mpi_solver_tpu.ops.matvec import (
+        Ops, PCG_DEFERRED_CHECK_PSUMS)
+
+    ops = Ops(n_loc=8, n_iface=4)
+    for variant in ("classic", "fused"):
+        gauge = ops.comm_estimate(variant=variant)["psums_per_iter"]
+        budget = ops.body_collective_budget(variant)["psum"]
+        assert budget == gauge + PCG_DEFERRED_CHECK_PSUMS
+    with pytest.raises(KeyError):
+        ops.body_collective_budget("pipelined")   # unknown variant: loud
+
+
+# ---------------------------------------------------------------------------
+# rule: hot-loop-purity
+# ---------------------------------------------------------------------------
+
+def test_hot_loop_purity_clean():
+    prog = _toy_program(_body_psums(1), {"psum": 1})
+    assert check_hot_loop_purity(prog) == []
+
+
+def test_hot_loop_purity_fires_on_callback_in_body():
+    def body(c):
+        i, v = c
+        jax.debug.callback(lambda a: None, v.sum())
+        return i + 1, v + 1.0
+
+    prog = _toy_program(body, {})
+    findings = check_hot_loop_purity(prog)
+    assert len(findings) == 1
+    assert "debug_callback" in findings[0].message
+
+
+def test_hot_loop_purity_fires_on_oversized_folded_const():
+    """A trace-time-captured operand array feeding the loop (the AOT
+    export bloat class)."""
+    big = np.arange(100_000, dtype=np.float64)
+
+    def body(c):
+        i, v = c
+        return i + 1, v + jnp.asarray(big)[:8]
+
+    prog = _toy_program(body, {})
+    findings = check_hot_loop_purity(prog)
+    assert len(findings) == 1
+    assert "folded constant" in findings[0].message
+    assert "100000" in findings[0].message
+
+
+def test_hot_loop_purity_small_consts_pass():
+    small = np.arange(8, dtype=np.float64)
+
+    def body(c):
+        i, v = c
+        return i + 1, v + jnp.asarray(small)
+
+    assert check_hot_loop_purity(_toy_program(body, {})) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: dtype-discipline
+# ---------------------------------------------------------------------------
+
+def test_dtype_discipline_fires_on_f64_leak():
+    def body(c):
+        i, v = c
+        y = v.astype(jnp.float64) * 2.0        # the leak
+        return i + 1, y.astype(jnp.float32)
+
+    prog = _toy_program(body, {}, role="f32", dtype=jnp.float32)
+    findings = check_dtype_discipline(prog)
+    assert len(findings) == 1
+    assert "float64" in findings[0].message
+
+
+def test_dtype_discipline_weak_scalars_and_f64_role_exempt():
+    def body(c):
+        i, v = c
+        return i + 1, v * 2.0 + 1.5       # weak python-float literals
+
+    assert check_dtype_discipline(
+        _toy_program(body, {}, role="f32", dtype=jnp.float32)) == []
+    # f64-role programs are out of scope by construction
+    leaky = _toy_program(_body_psums(1), {}, role="f64")
+    assert check_dtype_discipline(leaky) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: donation-integrity
+# ---------------------------------------------------------------------------
+
+def test_donation_check_passes_on_real_aliasing():
+    from pcg_mpi_solver_tpu.analysis.programs import check_donation
+
+    def step(c, y):
+        return {"a": c["a"] + y, "b": c["b"] * 2.0}
+
+    c = {"a": jnp.zeros((4, 4)), "b": jnp.ones((4, 4))}
+    fn = jax.jit(step, donate_argnums=(0,))
+    assert check_donation(DonationSurface(
+        "good", fn, (c, jnp.ones((4, 4))), c)) == []
+
+
+def test_donation_check_fires_on_dropped_donation():
+    """The seeded violation: the donated carry has no matching output,
+    so jax SILENTLY drops the aliasing — the dispatch copies."""
+    from pcg_mpi_solver_tpu.analysis.programs import check_donation
+
+    def step(c, y):
+        return y.sum()
+
+    c = {"a": jnp.zeros((4, 4)), "b": jnp.ones((4, 4))}
+    fn = jax.jit(step, donate_argnums=(0,))
+    errs = check_donation(DonationSurface(
+        "bad", fn, (c, jnp.ones((4, 4))), c))
+    assert len(errs) == 1
+    assert "dropped" in errs[0]
+
+
+# ---------------------------------------------------------------------------
+# rule: fingerprint-completeness
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_rule_clean_on_real_surfaces():
+    """tol is trace-affecting and covered by BOTH real surfaces."""
+    from pcg_mpi_solver_tpu.analysis.rules_config import (
+        check_fingerprint_completeness)
+
+    assert check_fingerprint_completeness(fields=["tol"]) == []
+
+
+def test_fingerprint_rule_catches_field_omitted_from_cache_key():
+    """The acceptance fixture: a config field deliberately dropped from
+    step_cache_key's payload turns into a mechanical finding."""
+    from pcg_mpi_solver_tpu.analysis.rules_config import (
+        check_fingerprint_completeness)
+    from pcg_mpi_solver_tpu.cache.keys import step_cache_key
+
+    def leaky_key(**kw):
+        solver = dict(kw.get("solver") or {})
+        solver.pop("tol", None)               # the deliberate omission
+        kw["solver"] = solver
+        return step_cache_key(**kw)
+
+    findings = check_fingerprint_completeness(fields=["tol"],
+                                              key_fn=leaky_key)
+    assert len(findings) == 1
+    assert "step_cache_key" in findings[0].message
+    assert findings[0].loc == "field:SolverConfig.tol"
+
+
+def test_fingerprint_rule_catches_field_omitted_from_snapshot_fp():
+    from pcg_mpi_solver_tpu.analysis.rules_config import (
+        check_fingerprint_completeness)
+
+    findings = check_fingerprint_completeness(
+        fields=["tol"], fp_fn=lambda solver: {"model": "const"})
+    assert len(findings) == 1
+    assert "_fingerprint" in findings[0].message
+
+
+def test_structural_key_components_bite():
+    from pcg_mpi_solver_tpu.analysis.rules_config import (
+        check_structural_key_components)
+    from pcg_mpi_solver_tpu.cache.keys import step_cache_key
+
+    assert check_structural_key_components() == []
+
+    def nrhs_blind(**kw):
+        kw["nrhs"] = 1
+        return step_cache_key(**kw)
+
+    findings = check_structural_key_components(key_fn=nrhs_blind)
+    assert len(findings) == 1
+    assert "nrhs" in findings[0].message
+
+
+def test_runconfig_fields_all_classified(monkeypatch):
+    from pcg_mpi_solver_tpu.analysis import rules_config as rc
+
+    assert rc.check_runconfig_classified() == []
+    # an unclassified (e.g. freshly added) RunConfig field is a finding
+    monkeypatch.setattr(
+        rc, "TRACE_NEUTRAL_RUNCONFIG",
+        rc.TRACE_NEUTRAL_RUNCONFIG - {"cache_dir"})
+    findings = rc.check_runconfig_classified()
+    assert len(findings) == 1
+    assert "cache_dir" in findings[0].loc
+
+
+def test_pre_existing_snapshots_resume_across_the_fp_extension(tmp_path):
+    """Back-compat: snapshots written BEFORE the fingerprint gained the
+    new numerics keys (dot_dtype, max_stag_steps, inner_tol,
+    mixed_knobs, trace_len) must still load — the knobs existed but were
+    unrecorded, so legacy entries skip the new checks instead of
+    mismatching on upgrade (the PR-6 nrhs shim precedent)."""
+    from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+    fp = {"model": "m", "nrhs": 1, "dot_dtype": "float64",
+          "max_stag_steps": 3, "inner_tol": 1e-5,
+          "mixed_knobs": [0, 0, 0.7, 30.0], "trace_len": 0}
+    store = SnapshotStore(str(tmp_path), fp)
+    store.save(1, {"x": np.zeros(4)})
+    # doctor the stored fingerprint back to its pre-extension shape
+    path = store._file(1)
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    saved = json.loads(bytes(flat["__fingerprint"]).decode())
+    for k in ("dot_dtype", "max_stag_steps", "inner_tol", "mixed_knobs",
+              "trace_len"):
+        saved.pop(k)
+    flat["__fingerprint"] = np.frombuffer(
+        json.dumps(saved, sort_keys=True).encode(), dtype=np.uint8).copy()
+    np.savez_compressed(path, **flat)
+    state = store.load(1)          # legacy entry: must NOT mismatch
+    assert state is not None and np.all(state["x"] == 0)
+    # a snapshot that DID record the field still fails loudly on drift
+    store.save(2, {"x": np.zeros(4)})
+    store2 = SnapshotStore(str(tmp_path), dict(fp, max_stag_steps=9))
+    with pytest.raises(ValueError, match="max_stag_steps"):
+        store2.load(2)
+
+
+def test_snapshot_fingerprint_carries_the_new_numerics_fields():
+    """The gaps this PR's sweep found (dot_dtype, max_stag_steps,
+    inner_tol, mixed knobs, trace ring length) are fingerprinted."""
+    from pcg_mpi_solver_tpu.analysis.programs import build_solver
+    from pcg_mpi_solver_tpu.utils.checkpoint import _fingerprint
+
+    fp = _fingerprint(build_solver("general"))
+    for key in ("dot_dtype", "max_stag_steps", "inner_tol",
+                "mixed_knobs", "trace_len", "pcg_variant", "nrhs"):
+        assert key in fp, key
+
+
+# ---------------------------------------------------------------------------
+# engine: registry, baseline, reports, end-to-end
+# ---------------------------------------------------------------------------
+
+def test_rule_catalog_complete():
+    rules = {r.id: r for r in engine.list_rules()}
+    expected = {"collective-budget", "hot-loop-purity", "dtype-discipline",
+                "donation-integrity", "fingerprint-completeness",
+                "recovery-paths", "telemetry-schema"}
+    assert expected <= set(rules)
+    assert len(expected) >= 5
+    # the pre-hardware-window gate covers the structural claims
+    assert rules["collective-budget"].fast
+    assert rules["recovery-paths"].fast
+    assert not rules["fingerprint-completeness"].fast
+
+
+def test_baseline_suppression_and_undocumented_entry():
+    f1 = Finding(rule="r", loc="a", message="m")
+    f2 = Finding(rule="r", loc="b", message="m")
+    active, suppressed = apply_baseline(
+        [f1, f2], [{"rule": "r", "loc": "a", "reason": "known"}])
+    assert active == [f2] and suppressed == [f1]
+    # an entry without a reason becomes a finding itself
+    active, _ = apply_baseline([], [{"rule": "r", "loc": "x"}])
+    assert len(active) == 1 and active[0].rule == "baseline"
+    # a documented entry matching NO current finding is a stale-
+    # suppression WARNING (reported, but does not fail the lint)
+    active, _ = apply_baseline(
+        [], [{"rule": "r", "loc": "gone", "reason": "fixed long ago"}])
+    assert len(active) == 1 and active[0].severity == "warn"
+    assert "stale" in active[0].message
+
+
+def test_shipped_baseline_is_empty():
+    entries = engine.load_baseline(engine.DEFAULT_BASELINE)
+    assert entries == []
+
+
+def test_unknown_rule_id_raises():
+    with pytest.raises(ValueError, match="unknown rule"):
+        engine.run_lint(rule_ids=["no-such-rule"])
+
+
+def test_fast_lint_clean_on_current_tree():
+    """Tier-1 gate: the fast rules (source + artifact lints and the
+    collective/purity/dtype proofs on the reduced matrix) hold on the
+    tree as committed."""
+    report = engine.run_lint(fast=True)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(map(str, report.findings))
+    assert report.clean and report.exit_code == 0
+    assert "collective-budget" in report.rules_run
+
+
+@pytest.mark.slow
+def test_full_lint_clean_on_current_tree():
+    report = engine.run_lint(fast=False)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(map(str, report.findings))
+
+
+def test_report_json_schema_roundtrip(tmp_path):
+    report = engine.run_lint(rule_ids=["telemetry-schema"])
+    doc = report.to_dict()
+    assert doc["schema"] == "pcg-tpu-lint-report/1"
+    json.loads(json.dumps(doc))   # json-serializable end to end
+
+
+def test_cli_exit_codes(tmp_path):
+    """`python -m pcg_mpi_solver_tpu.analysis` (jax-light rule subset
+    to keep the subprocess cheap): 0 on clean, 2 on unknown rule."""
+    out = tmp_path / "report.json"
+    ok = subprocess.run(
+        [sys.executable, "-m", "pcg_mpi_solver_tpu.analysis",
+         "--rules", "telemetry-schema,recovery-paths",
+         "--json", str(out)],
+        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    doc = json.loads(out.read_text())
+    assert doc["clean"] is True
+    bad = subprocess.run(
+        [sys.executable, "-m", "pcg_mpi_solver_tpu.analysis",
+         "--rules", "no-such-rule"],
+        capture_output=True, text=True)
+    assert bad.returncode == 2
+
+
+def test_analysis_package_import_is_jax_free():
+    """The import contract the package __init__ documents (same as the
+    repo root package): importing analysis/ must not pull in jax."""
+    code = ("import sys; sys.modules.pop('jax', None); "
+            "assert 'jax' not in sys.modules; "
+            "import pcg_mpi_solver_tpu.analysis; "
+            "import pcg_mpi_solver_tpu.analysis.engine; "
+            "import pcg_mpi_solver_tpu.analysis.rules_ast; "
+            "import pcg_mpi_solver_tpu.analysis.rules_artifacts; "
+            "import pcg_mpi_solver_tpu.analysis.rules_config; "
+            "import pcg_mpi_solver_tpu.analysis.rules_jaxpr; "
+            "assert 'jax' not in sys.modules, 'analysis imported jax'")
+    import os
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)   # keep the package pin from importing jax
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, cwd="/root/repo")
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,31 +1,15 @@
-"""Static per-iteration collective-count check for the PCG loop body.
+"""Static per-iteration collective-count check for the PCG loop body —
+thin shim over the analysis/ subsystem (same CLI, same exit codes).
 
-The fused variant's entire value claim (ISSUE 5) is "ONE scalar-reduction
-psum per iteration"; this check traces both variants' ``lax.while_loop``
-bodies to a jaxpr on a 2-part CPU mesh and counts the ``psum``
-primitives, so a collective regression — an accidentally serialized
-extra reduction sneaking back into the hot body — fails CI instead of a
-scarce hardware window.
-
-Documented counts (2 parts => the matvec's interface-assembly psum is
-present; both conditional branches of the body, including the deferred
-mode-1 true-residual check, are part of the traced body jaxpr):
-
-* classic: 5 — interface assembly + the rho/inf-prec fused psum + p.q
-  + the fused 3-norm + the deferred check's true-residual norm
-* fused:   3 — interface assembly + THE single fused reduction (rho,
-  mu, ||r||, ||p||, ||x||, inf flag in one psum) + the deferred check's
-  true-residual norm
-
-Per healthy iteration (mode-0 trip) that is 3+1 collectives classic vs
-1+1 fused — the claim ``Ops.comm_estimate`` gauges advertise.
-
-The same proof extends to the batched multi-RHS body (solver/pcg.py
-``pcg_many``): its psum count must be INDEPENDENT of the RHS-block
-width — widening the block widens psum payloads, never the collective
-count (the ISSUE-6 headline claim).  ``iteration_psum_count(variant,
-nrhs=8)`` traces the blocked body and must equal the nrhs=1 count for
-both variants.
+The fused variant's entire value claim (ISSUE 5) is "ONE scalar-
+reduction psum per iteration", and the batched multi-RHS claim (ISSUE 6)
+is "psum count independent of the block width"; the proof traces the
+loop bodies to jaxprs on a 2-part CPU mesh and counts the ``psum``
+primitives.  The implementation (and the documented counts, now DERIVED
+from the budget table next to ``Ops.comm_estimate``) lives in
+``pcg_mpi_solver_tpu/analysis/collectives.py``; the wider per-program
+proof — every variant x nrhs x backend, plus ppermute budgets — is the
+analysis/ ``collective-budget`` rule (``pcg-tpu lint``).
 
 Usage: python tools/check_collectives.py     (exit 0 = counts hold)
 Tier-1: tests/test_collectives.py runs the same checks in-process.
@@ -48,113 +32,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
-EXPECTED_BODY_PSUMS = {"classic": 5, "fused": 3}
-
-
-def _sub_jaxprs(eqn):
-    """Nested jaxprs of one equation (while/cond/pjit/custom_* params),
-    unwrapping ClosedJaxpr."""
-    out = []
-    for v in eqn.params.values():
-        for item in (v if isinstance(v, (list, tuple)) else [v]):
-            j = getattr(item, "jaxpr", item)
-            if hasattr(j, "eqns"):
-                out.append(j)
-    return out
-
-
-def count_psums(jaxpr) -> int:
-    """Recursive ``psum`` primitive count of a jaxpr (into conds etc.)."""
-    n = 0
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "psum":
-            n += 1
-        for j in _sub_jaxprs(eqn):
-            n += count_psums(j)
-    return n
-
-
-def _while_bodies(jaxpr, out):
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "while":
-            out.append(eqn.params["body_jaxpr"].jaxpr)
-        for j in _sub_jaxprs(eqn):
-            _while_bodies(j, out)
-    return out
-
-
-def iteration_psum_count(variant: str, nrhs: int = 1) -> int:
-    """Psum count of the traced PCG while-loop body for ``variant`` on a
-    2-part partition (so the interface-assembly psum exists).  With
-    ``nrhs`` > 1 the BATCHED body (``pcg_many``) is traced instead —
-    the documented counts must hold unchanged (payloads widen with the
-    block, the collective count must not)."""
-    import jax
-    import jax.numpy as jnp
-
-    from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
-    from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
-    from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
-    from pcg_mpi_solver_tpu.parallel.partition import partition_model
-    from pcg_mpi_solver_tpu.solver.driver import _data_specs
-    from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_many
-
-    model = make_cube_model(3, 3, 3)
-    pm = partition_model(model, 2)
-    if pm.n_iface == 0:
-        raise RuntimeError("2-part partition produced no interface dofs; "
-                           "the documented counts assume the iface psum")
-    ops = Ops.from_model(pm, dot_dtype=jnp.float64, axis_name=PARTS_AXIS)
-    data = device_data(pm, jnp.float64)
-    mesh = make_mesh(2)
-    P = jax.sharding.PartitionSpec(PARTS_AXIS)
-
-    def step(data, fext, x0, inv_diag):
-        solve = pcg_many if nrhs > 1 else pcg
-        res = solve(ops, data, fext, x0, inv_diag, tol=1e-8, max_iter=50,
-                    glob_n_dof_eff=pm.glob_n_dof_eff, variant=variant)
-        return res.x
-
-    fn = jax.shard_map(step, mesh=mesh,
-                       in_specs=(_data_specs(data), P, P, P),
-                       out_specs=P, check_vma=False)
-    shape = ((pm.n_parts, pm.n_loc, nrhs) if nrhs > 1
-             else (pm.n_parts, pm.n_loc))
-    vec = jnp.zeros(shape, jnp.float64)
-    inv = jnp.zeros((pm.n_parts, pm.n_loc), jnp.float64)
-    jaxpr = jax.make_jaxpr(fn)(data, vec, vec, inv)
-    bodies = _while_bodies(jaxpr.jaxpr, [])
-    counts = [count_psums(b) for b in bodies]
-    hits = [c for c in counts if c > 0]
-    if len(hits) != 1:
-        raise RuntimeError(
-            f"expected exactly one psum-bearing while body for "
-            f"variant={variant!r} nrhs={nrhs}, found counts {counts}")
-    return hits[0]
-
-
-def run_checks(nrhs_batched: int = 8) -> list:
-    """Returns a list of error strings (empty = counts hold).  Checks
-    both the single-RHS bodies and the batched bodies at
-    ``nrhs_batched`` columns: the counts must be equal — psum count
-    independent of the RHS-block width."""
-    errs = []
-    counts = {}
-    for variant, want in EXPECTED_BODY_PSUMS.items():
-        got = counts[variant] = iteration_psum_count(variant)
-        if got != want:
-            errs.append(f"{variant}: {got} psums in the loop body, "
-                        f"documented count is {want}")
-        got_b = iteration_psum_count(variant, nrhs=nrhs_batched)
-        if got_b != want:
-            errs.append(f"{variant} batched (nrhs={nrhs_batched}): "
-                        f"{got_b} psums in the loop body, must equal the "
-                        f"nrhs=1 count {want}")
-    if not errs and counts["fused"] != counts["classic"] - 2:
-        errs.append(f"fused must save exactly the two serialized scalar "
-                    f"reductions: classic={counts['classic']} "
-                    f"fused={counts['fused']}")
-    return errs
+from pcg_mpi_solver_tpu.analysis.collectives import (  # noqa: E402,F401
+    EXPECTED_BODY_PSUMS, count_psums, iteration_psum_count, run_checks)
 
 
 def main() -> int:

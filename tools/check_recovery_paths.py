@@ -1,126 +1,37 @@
 #!/usr/bin/env python
-"""Recovery-path lint: no silently-swallowed broad exception handlers.
+"""Recovery-path lint: no silently-swallowed broad exception handlers —
+thin shim over the analysis/ ``recovery-paths`` rule (same CLI, same
+exit codes).
 
-The resilience posture only works if every broad ``except`` in the
-solve/cache/recovery layers does one of three things:
-
-* **re-raises** (possibly after cleanup — the one-shot dispatch path's
-  donated-carry restore is the canonical example), or
-* **records** what happened — a metrics call (``.event``/``.inc``/
-  ``.note``/``.gauge``), a ``warnings.warn``, or the bench's ``_log`` —
-  so the JSONL stream / stderr breadcrumbs show the swallow, or
-* carries an explicit ``# noqa: BLE001`` justification on the handler
-  line (the repo convention for best-effort cache/IO paths where a
-  failure legitimately degrades to a miss).
-
-A bare ``except:``/``except Exception:`` that silently ``pass``es in
-``solver/``, ``cache/``, ``resilience/`` or ``validate/`` is exactly
-how a breakdown or device loss turns into a wrong answer with no trail
-— this lint makes that unrepresentable.
+Every broad ``except`` in the scanned packages must **re-raise**
+(possibly after cleanup), **record** what happened (a metrics
+``.event``/``.inc``/``.note``/``.gauge`` call, ``warnings.warn``, or the
+bench's ``_log``), or carry an explicit ``# noqa: BLE001`` justification
+on the handler line.  The default scope now covers ``solver/``,
+``cache/``, ``resilience/``, ``validate/`` AND (ISSUE 7) ``ops/``,
+``parallel/``, ``obs/`` — see
+``pcg_mpi_solver_tpu/analysis/rules_ast.py`` for the implementation and
+rationale.
 
 Usage::
 
     python tools/check_recovery_paths.py [PATH ...]
 
-With no PATH arguments, scans the default scope (the four packages
-above).  Exits non-zero listing each violation; wired into tier-1 via
-``tests/test_recovery_paths.py`` like the telemetry-schema lint.
+With no PATH arguments, scans the default scope.  Exits non-zero listing
+each violation; wired into tier-1 via ``tests/test_recovery_paths.py``
+and into ``pcg-tpu lint`` as the ``recovery-paths`` rule.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "pcg_mpi_solver_tpu")
-DEFAULT_SCOPE = (
-    os.path.join(PKG, "solver"),
-    os.path.join(PKG, "cache"),
-    os.path.join(PKG, "resilience"),
-    os.path.join(PKG, "validate"),
-)
+sys.path.insert(0, REPO)
 
-# Exception names considered "broad" when caught: anything narrower
-# (OSError, ValueError, ...) expresses an expectation and is exempt.
-_BROAD = {"Exception", "BaseException"}
-
-# A call to any of these names (bare or attribute) inside the handler
-# counts as recording the failure.
-_LOG_CALLS = {"event", "inc", "note", "gauge", "warn", "warning",
-              "exception", "_log"}
-
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    if t is None:                            # bare `except:`
-        return True
-    names = []
-    if isinstance(t, ast.Tuple):
-        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
-    elif isinstance(t, ast.Name):
-        names = [t.id]
-    return any(n in _BROAD for n in names)
-
-
-def _handler_ok(handler: ast.ExceptHandler, lines: List[str]) -> bool:
-    # explicit justification on the `except` line (repo convention)
-    line = lines[handler.lineno - 1]
-    if "noqa" in line and "BLE001" in line:
-        return True
-    for node in ast.walk(handler):
-        if isinstance(node, ast.Raise):
-            return True
-        if isinstance(node, ast.Call):
-            f = node.func
-            name = (f.attr if isinstance(f, ast.Attribute)
-                    else getattr(f, "id", ""))
-            if name in _LOG_CALLS:
-                return True
-    return False
-
-
-def check_source(source: str, path: str = "<source>") -> List[str]:
-    """Violations in one python source blob (path used for labels)."""
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        return [f"{path}: unparseable ({e})"]
-    lines = source.splitlines()
-    errs = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
-                and not _handler_ok(node, lines):
-            errs.append(
-                f"{path}:{node.lineno}: broad `except` neither re-raises, "
-                "logs a metrics/warning event, nor carries a "
-                "`# noqa: BLE001` justification")
-    return errs
-
-
-def check_file(path: str) -> List[str]:
-    try:
-        with open(path, encoding="utf-8") as f:
-            source = f.read()
-    except OSError as e:
-        return [f"{path}: unreadable ({e})"]
-    return check_source(source, path)
-
-
-def iter_py_files(paths) -> List[str]:
-    out = []
-    for p in paths:
-        if os.path.isdir(p):
-            for root, _dirs, files in os.walk(p):
-                if "__pycache__" in root:
-                    continue
-                out.extend(os.path.join(root, fn) for fn in sorted(files)
-                           if fn.endswith(".py"))
-        elif p.endswith(".py"):
-            out.append(p)
-    return out
+from pcg_mpi_solver_tpu.analysis.rules_ast import (  # noqa: E402,F401
+    DEFAULT_SCOPE, check_file, check_source, iter_py_files)
 
 
 def main(argv=None) -> int:

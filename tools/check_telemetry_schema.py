@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Telemetry/bench artifact schema lint.
+"""Telemetry/bench artifact schema lint — thin shim over the analysis/
+``telemetry-schema`` rule (same CLI, same exit codes).
 
 Validates JSON artifacts against the versioned contracts in
 ``pcg_mpi_solver_tpu/obs/schema.py``:
@@ -9,10 +10,7 @@ Validates JSON artifacts against the versioned contracts in
                          failed-round wrappers with ``parsed: null`` pass)
 * ``bench_*.json``     — provisional/salvage side files written by bench.py
 
-Bench-line ``detail`` carries the warm-path attribution fields
-(``setup_s`` / ``time_to_first_iter_s`` numeric-or-null, ``setup_cache``
-off/cold/warm — obs/schema.py BENCH_DETAIL_NUMERIC): typed when present,
-optional so pre-warm-path committed artifacts stay valid.
+Implementation: ``pcg_mpi_solver_tpu/analysis/rules_artifacts.py``.
 
 Usage::
 
@@ -27,51 +25,14 @@ runs as a fast lint.
 
 from __future__ import annotations
 
-import glob
-import json
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from pcg_mpi_solver_tpu.obs.schema import (          # noqa: E402
-    validate_bench_text, validate_jsonl_text)
-
-
-def default_paths() -> list:
-    """The committed artifacts the tier-1 check covers."""
-    return sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
-
-
-def check_file(path: str) -> list:
-    """Validate one artifact; returns error strings prefixed with path."""
-    try:
-        with open(path, encoding="utf-8") as f:
-            text = f.read()
-    except OSError as e:
-        return [f"{path}: unreadable ({e})"]
-    name = os.path.basename(path)
-    if name.endswith(".jsonl"):
-        errs = validate_jsonl_text(text)
-    elif name.endswith(".json"):
-        if name.startswith("bench_salvage"):
-            # salvage wrapper: {"lines": [{"line": <bench json str>}]}
-            errs = []
-            try:
-                doc = json.loads(text)
-            except ValueError as e:
-                errs = [f"not JSON ({e})"]
-            else:
-                for i, entry in enumerate(doc.get("lines", [])):
-                    errs.extend(
-                        f"lines[{i}]: {e}"
-                        for e in validate_bench_text(entry.get("line", "")))
-        else:
-            errs = validate_bench_text(text)
-    else:
-        errs = [f"unrecognized artifact type (expected .json/.jsonl)"]
-    return [f"{path}: {e}" for e in errs]
+from pcg_mpi_solver_tpu.analysis.rules_artifacts import (  # noqa: E402,F401
+    check_file, default_paths)
 
 
 def main(argv=None) -> int:

@@ -128,6 +128,7 @@ def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
     # those must not trip the next step's wedged-grant gate
     _last_step_ok = status in tuple(f"rc={rc}" for rc in ok_rcs)
     log_line(path, f"=== {name} done: {status} ({wall:.0f}s)")
+    return status
 
 
 def start_queue(name, deadline_min, log):
@@ -152,10 +153,31 @@ def start_queue(name, deadline_min, log):
 
 def run_priority_queue(path, quick: bool):
     """The prioritized measurement queue (module docstring ``priority``
-    preset): classic-vs-fused ms/iter at the flagship first, then the
-    batched-RHS sweep, then the Pallas v9 A/B — ordered so the minutes a
-    dying window DOES deliver answer the most valuable open questions.
-    A shared warm-path cache dir makes steps 2+ near-zero-setup."""
+    preset): contract lint FIRST (step 0, on CPU — a broken structural
+    claim means the measurements would benchmark a lie), then
+    classic-vs-fused ms/iter at the flagship, then the batched-RHS
+    sweep, then the Pallas v9 A/B — ordered so the minutes a dying
+    window DOES deliver answer the most valuable open questions.
+    A shared warm-path cache dir makes the bench steps near-zero-setup."""
+    # Step 0: `pcg-tpu lint --fast` (analysis/) — statically prove the
+    # collective budgets / hot-loop purity the queue is about to measure.
+    # Runs on the CPU backend (JAX_PLATFORMS=cpu: never touches, or
+    # waits on, the accelerator grant; the lint entry point also drops
+    # JAX_COMPILATION_CACHE_DIR — jax 0.4.x CPU + persistent compile
+    # cache segfaults).  A FAIL aborts BEFORE the hardware queue starts:
+    # measuring a claim the lint just disproved burns the window on
+    # garbage.
+    status = run_step(path, "contract lint (step 0)",
+                      ["-m", "pcg_mpi_solver_tpu.analysis", "--fast"],
+                      env_extra={"JAX_PLATFORMS": "cpu"}, timeout=900,
+                      gate_s=0)
+    verdict = "PASS" if status == "rc=0" else f"FAIL ({status})"
+    log_line(path, f"lint verdict: {verdict}")
+    if status != "rc=0":
+        log_line(path, "structural contract lint FAILED — aborting the "
+                       "priority queue before any hardware step (fix the "
+                       "invariant or baseline it, then relaunch)")
+        return
     # BENCH_NX exported unconditionally so the flagship size is pinned
     # HERE, not silently inherited from bench.py's default
     cache = {"BENCH_CACHE_DIR": os.path.join(REPO, ".pcg_cache")}
