@@ -135,3 +135,167 @@ def recovery_paths_rule(ctx) -> List[Finding]:
                 loc=os.path.relpath(loc, REPO),
                 message=msg))
     return findings
+
+
+# ----------------------------------------------------------------------
+# recovery-coverage: every Krylov dispatch surface of the drivers is
+# wrapped by the resilience harness or carries a documented exemption
+# (ISSUE 9).
+# ----------------------------------------------------------------------
+
+#: Files whose top-level functions/methods are swept for dispatch
+#: surfaces.  ``solver/chunked.py`` (ChunkedEngine) and
+#: ``resilience/engine.py`` are harness-INTERNAL — their dispatches are
+#: only ever reached through a wrapped caller below.
+COVERAGE_FILES = ("pcg_mpi_solver_tpu/solver/driver.py",
+                  "pcg_mpi_solver_tpu/solver/newmark.py")
+
+#: Krylov-TERMINAL dispatch-span names: a swept function whose subtree
+#: opens ``<recorder>.dispatch("<one of these>")`` — or calls the
+#: one-shot ``_step_fn`` program — runs a solve to (partial)
+#: termination and is therefore a dispatch surface.  Setup/finalize
+#: spans (start, restart, many_start, many_final, fallback_prec, ...)
+#: are not surfaces: they hold no Krylov iterations to lose.
+SOLVE_DISPATCH_NAMES = frozenset(
+    {"step", "solve_many", "cycle", "inner_cycle", "many_cycle"})
+
+#: (file, function) -> coverage requirement.  ``calls:<name>`` — the
+#: function must invoke that recovery-harness entry (the positive proof
+#: that the surface is wrapped); ``exempt`` — the function must carry a
+#: ``recovery-exempt:`` comment documenting WHY no harness applies
+#: (e.g. a donated one-shot operand that must never be re-dispatched).
+#: A swept surface missing from this registry is itself a finding, so a
+#: new dispatch path cannot ship silently unprotected.
+RECOVERY_SURFACES = {
+    ("pcg_mpi_solver_tpu/solver/driver.py", "_step_chunked"):
+        "calls:run_with_recovery",
+    ("pcg_mpi_solver_tpu/solver/driver.py", "_solve_many_chunked"):
+        "calls:run_many_with_recovery",
+    ("pcg_mpi_solver_tpu/solver/driver.py", "solve_many"):
+        "calls:_dispatch_with_retry",
+    ("pcg_mpi_solver_tpu/solver/driver.py", "step"): "exempt",
+    ("pcg_mpi_solver_tpu/solver/newmark.py", "_step_chunked"):
+        "calls:run_with_recovery",
+    ("pcg_mpi_solver_tpu/solver/newmark.py", "step"): "exempt",
+}
+
+
+def _top_level_functions(tree: ast.Module):
+    """Module-level functions and class methods (nested closures belong
+    to — and are walked with — their enclosing definition)."""
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            out.extend(n for n in node.body
+                       if isinstance(n, ast.FunctionDef))
+        elif isinstance(node, ast.FunctionDef):
+            out.append(node)
+    return out
+
+
+def _is_dispatch_surface(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "dispatch" \
+                and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Constant) \
+                    and a.value in SOLVE_DISPATCH_NAMES:
+                return True
+        if isinstance(f, ast.Attribute) and f.attr == "_step_fn":
+            return True
+    return False
+
+
+def _calls_name(fn: ast.FunctionDef, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            got = (f.attr if isinstance(f, ast.Attribute)
+                   else getattr(f, "id", ""))
+            if got == name:
+                return True
+    return False
+
+
+def check_recovery_coverage(sources) -> List[str]:
+    """Coverage violations for ``{relpath: source}`` (the rule feeds the
+    real files; tests feed seeded-violation sources)."""
+    errs: List[str] = []
+    for rel, source in sources.items():
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            errs.append(f"{rel}:0: unparseable ({e})")
+            continue
+        lines = source.splitlines()
+        seen = set()
+        for fn in _top_level_functions(tree):
+            key = (rel, fn.name)
+            req = RECOVERY_SURFACES.get(key)
+            if _is_dispatch_surface(fn):
+                seen.add(key)
+                if req is None:
+                    errs.append(
+                        f"{rel}:{fn.lineno}: `{fn.name}` opens a "
+                        "Krylov-terminal dispatch but is not registered "
+                        "in RECOVERY_SURFACES — wrap it in the recovery "
+                        "harness (run_with_recovery / "
+                        "run_many_with_recovery / _dispatch_with_retry) "
+                        "and register it, or register a documented "
+                        "exemption")
+                    continue
+            if req is None:
+                continue
+            if req.startswith("calls:"):
+                want = req.split(":", 1)[1]
+                if not _calls_name(fn, want):
+                    errs.append(
+                        f"{rel}:{fn.lineno}: dispatch surface "
+                        f"`{fn.name}` no longer calls its registered "
+                        f"recovery harness `{want}` — the surface runs "
+                        "unprotected")
+            elif req == "exempt":
+                seg = "\n".join(
+                    lines[fn.lineno - 1:fn.end_lineno or fn.lineno])
+                if "recovery-exempt:" not in seg:
+                    errs.append(
+                        f"{rel}:{fn.lineno}: dispatch surface "
+                        f"`{fn.name}` is registered exempt but carries "
+                        "no `recovery-exempt:` comment — document why "
+                        "no recovery harness applies, or wrap it")
+        # stale registry entries: the function moved/renamed, so the
+        # registry would silently vouch for nothing
+        names = {fn.name for fn in _top_level_functions(tree)}
+        for (f, name), _req in RECOVERY_SURFACES.items():
+            if f == rel and name not in names:
+                errs.append(
+                    f"{rel}:0: RECOVERY_SURFACES registers "
+                    f"`{name}` but no such function exists — update "
+                    "the registry")
+    return errs
+
+
+@rule("recovery-coverage", kind="ast", fast=True,
+      doc="every Krylov dispatch surface in driver.py/newmark.py "
+          "(one-shot, chunked scalar, chunked blocked, mixed inner) is "
+          "wrapped by the recovery harness or carries a documented "
+          "`recovery-exempt:` justification")
+def recovery_coverage_rule(ctx) -> List[Finding]:
+    sources = {}
+    for rel in COVERAGE_FILES:
+        path = os.path.join(REPO, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError as e:
+            return [Finding(rule="recovery-coverage", loc=rel,
+                            message=f"unreadable ({e})")]
+    findings = []
+    for err in check_recovery_coverage(sources):
+        loc, _, msg = err.partition(": ")
+        findings.append(Finding(rule="recovery-coverage", loc=loc,
+                                message=msg))
+    return findings

@@ -670,11 +670,18 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
                             relres=float(mres.relres.max(initial=0.0)),
                             iters=int(mres.iters.max(initial=0)),
                             wall_s=mres.solve_wall_s)
+            # per-column resilience attribution (ISSUE 9): a blocked
+            # throughput number that silently absorbed recovery
+            # restarts or reported a quarantined column as healthy
+            # would benchmark a lie — stamp the counts on the line
+            run_extra["nrhs_quarantined"] = len(mres.quarantined)
+            run_extra["nrhs_recoveries"] = int(mres.recoveries)
             _log(f"# timed blocked solve: nrhs={nrhs} "
                  f"flags={mres.flags.tolist()} "
                  f"iters={mres.iters.tolist()} wall={r1.wall_s:.3f}s "
                  f"(+{mres.wall_s - mres.solve_wall_s:.3f}s rhs staging, "
-                 "excluded)")
+                 "excluded; quarantined="
+                 f"{list(mres.quarantined)} recoveries={mres.recoveries})")
         else:
             with _REC.span("timed_solve", emit=True):
                 r1 = s.step(1.0)

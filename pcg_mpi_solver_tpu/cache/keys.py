@@ -26,7 +26,11 @@ from pcg_mpi_solver_tpu import __version__
 # serialized (partition pickle layout, AOT export calling convention
 # expectations, key payload shape).  Additive key fields need no bump —
 # they change the key hash by themselves.
-CACHE_SCHEMA = 1
+# 2: ISSUE 9 — the blocked (pcg_many) and fused loop bodies gained
+#    per-column recovery / drift-guard carry leaves and the
+#    quarantine-flag finalize; AOT entries exported from the old
+#    programs must not be deserialized into the new semantics.
+CACHE_SCHEMA = 2
 
 # Monkeypatchable in tests to simulate a package-version bump without
 # editing the package.

@@ -51,6 +51,10 @@ def _load_settings(path, args) -> "RunConfig":
         # opts into the single-reduction Chronopoulos–Gear loop
         pcg_variant=(getattr(args, "pcg_variant", None)
                      or sp.get("PcgVariant", "classic")),
+        # dispatch cap override (settings-only; -1 = auto): tests and
+        # small chaos drills force the chunked/resumable path below the
+        # auto-engage size, where snapshots/recovery actually exist
+        iters_per_dispatch=int(sp.get("ItersPerDispatch", -1)),
     )
     time_history = TimeHistoryConfig(
         time_step_delta=th.get("TimeStepDelta", [0.0, 1.0]),
@@ -266,7 +270,16 @@ def cmd_solve_many(args):
     construction-time preflight.  One Krylov loop solves all columns
     lockstep — converged columns freeze, per-iteration collective count
     independent of the block width — and per-RHS flags/relres/iters are
-    printed and emitted as `rhs_solve` telemetry events."""
+    printed and emitted as `rhs_solve` telemetry events.
+
+    Resilience rides the blocked path for real: --snapshot-every /
+    --resume persist and continue the blocked carry mid-solve
+    (``many_*.npz``), and --max-recoveries bounds the PER-COLUMN
+    recovery ladder — a flag-2/4 breakdown or NaN/Inf poison in one
+    column restarts/escalates that column alone while the others keep
+    iterating bit-identically; an unrecoverable column is QUARANTINED
+    (flag 5 + `rhs_quarantine` telemetry) instead of failing the block
+    (docs/RUNBOOK.md "Blocked solve failure modes & quarantine")."""
     from pcg_mpi_solver_tpu.models.mdf import read_mdf
     from pcg_mpi_solver_tpu.solver.driver import Solver, normalize_rhs_block
 
@@ -276,12 +289,6 @@ def cmd_solve_many(args):
     cfg.snapshot_every = int(args.snapshot_every or 0)
     if args.max_recoveries is not None:
         cfg.solver.max_recoveries = int(args.max_recoveries)
-        # the knob must not pretend to do something it doesn't (the
-        # breakdown ladder rides the scalar paths only; blocked columns
-        # fall back to their per-column min-residual iterate)
-        print(">note: --max-recoveries does not yet apply to blocked "
-              "solves — the recovery ladder is a scalar-path feature; "
-              "failed columns return their min-residual iterate")
     model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
     if args.rhs:
         # the ONE shape heuristic lives in normalize_rhs_block (shared
@@ -320,10 +327,18 @@ def cmd_solve_many(args):
           f"({s.setup_cache} partition)")
     res = s.solve_many(fb, resume=bool(args.resume))
     for j in range(res.nrhs):
+        tag = "  [QUARANTINED]" if j in res.quarantined else ""
         print(f">rhs {j}: flag={int(res.flags[j])} "
-              f"iters={int(res.iters[j])} relres={res.relres[j]:.3e}")
+              f"iters={int(res.iters[j])} relres={res.relres[j]:.3e}{tag}")
     print(f">block wall: {res.wall_s:.2f}s ({res.nrhs} load cases, "
           f"one operator)")
+    if res.recoveries:
+        print(f">recoveries: {res.recoveries} per-column ladder "
+              f"attempt(s) consumed")
+    if res.quarantined:
+        print(f">quarantined columns: {list(res.quarantined)} — "
+              "returned their min-residual iterate (flag 5); see "
+              "docs/RUNBOOK.md 'Blocked solve failure modes'")
     out = os.path.join(cfg.result_path, "u_many")
     os.makedirs(cfg.result_path, exist_ok=True)
     np.save(out, s.displacement_global_many(res.x))

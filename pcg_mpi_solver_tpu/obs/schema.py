@@ -76,6 +76,17 @@ EVENT_KINDS: Dict[str, tuple] = {
     # per-RHS outcome of a batched solve — one event per column/tenant,
     # carrying the rhs (column) index
     "rhs_solve": ("rhs", "flag", "relres", "iters"),
+    # one QUARANTINED column of a batched solve (resilience/): the
+    # column's recovery budget was spent (or absent) on `trigger`; the
+    # block completed anyway and the column reports flag 5 with its
+    # min-residual iterate — the billing/ops signal for a pathological
+    # tenant load case
+    "rhs_quarantine": ("rhs", "trigger", "flag", "attempts"),
+    # fused-variant residual drift (arXiv:2501.03743): deferred
+    # true-residual checks that disagreed with the recurrence norm this
+    # solve (`drift` = count; blocked solves add per-column `cols`) —
+    # sustained drift also routes into the ladder as flag 6
+    "resid_drift": ("drift",),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }
@@ -93,7 +104,8 @@ BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 # ``nrhs_planned`` — a line must never fabricate batched throughput that
 # was not run.
 BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
-                        "nrhs_planned", "dof_iter_rhs_per_s")
+                        "nrhs_planned", "dof_iter_rhs_per_s",
+                        "nrhs_quarantined", "nrhs_recoveries")
 # ``setup_cache``: warm-path partition attribution (cache/ subsystem).
 BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
 

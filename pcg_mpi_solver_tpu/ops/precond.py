@@ -147,6 +147,18 @@ def corner_block_field(Ke: jnp.ndarray, ck: jnp.ndarray,
     return g
 
 
+def make_fallback_prec(ops, data: dict, kind: str):
+    """The recovery ladder's fallback preconditioner inverse for a solve
+    configured with ``kind``, or None when no weaker-but-safer inverse
+    exists (:func:`fallback_kind`).  The blocked multi-RHS cycle wires
+    this as ``pcg_many``'s ``inv_diag_fb`` so the per-column ladder can
+    flip ONE broken column to the safe inverse (carry ``prec_sel``)
+    while every other column keeps the configured preconditioner
+    bit-identically."""
+    fb = fallback_kind(kind)
+    return None if fb is None else make_prec(ops, data, fb)
+
+
 def make_prec(ops, data: dict, kind: str):
     """The preconditioner inverse for ``kind`` ("jacobi" | "block3"), ready
     for ``ops.apply_prec`` inside the PCG body — the one shared builder for

@@ -30,25 +30,29 @@ state put/fetch closures import jax lazily), matching ``cache/`` and
 """
 
 from pcg_mpi_solver_tpu.resilience.engine import (
-    RecoveryHooks, TimeHistoryGuard, kinematic_state_io,
-    run_with_recovery)
+    ManyRecoveryHooks, RecoveryHooks, TimeHistoryGuard,
+    kinematic_state_io, run_many_with_recovery, run_with_recovery)
 from pcg_mpi_solver_tpu.resilience.faultinject import (
     FaultPlan, InjectedDispatchError, SimulatedKill)
 from pcg_mpi_solver_tpu.resilience.recovery import (
     DispatchGuard, RecoveryLadder, ResilienceContext, breakdown_trigger,
-    is_device_loss)
+    column_trigger, is_device_loss, retry_deadline_s)
 
 __all__ = [
     "FaultPlan",
     "InjectedDispatchError",
     "SimulatedKill",
     "DispatchGuard",
+    "ManyRecoveryHooks",
     "RecoveryHooks",
     "RecoveryLadder",
     "ResilienceContext",
     "TimeHistoryGuard",
     "breakdown_trigger",
+    "column_trigger",
     "is_device_loss",
     "kinematic_state_io",
+    "retry_deadline_s",
+    "run_many_with_recovery",
     "run_with_recovery",
 ]

@@ -30,7 +30,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from pcg_mpi_solver_tpu.resilience.recovery import (
-    RecoveryLadder, breakdown_trigger, is_device_loss)
+    RecoveryLadder, breakdown_trigger, column_trigger, is_device_loss)
 
 
 # ----------------------------------------------------------------------
@@ -139,6 +139,235 @@ def run_with_recovery(engine, data, fext, carry, normr0, n2b, prec, *,
                   attempts=ladder.attempt,
                   actions=list(ladder.actions_taken))
     return eng, x_fin, flag, relres, total
+
+
+# ----------------------------------------------------------------------
+# Per-column recovery for blocked multi-RHS solves (ISSUE 9 tentpole)
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ManyRecoveryHooks:
+    """Driver-supplied blocked-solve programs for
+    :func:`run_many_with_recovery`.
+
+    ``cycle(carry, budget) -> (x, carry)``: one capped resumable blocked
+    dispatch (driver ``many_cycle`` program; budget = remaining
+    iteration allowance).
+
+    ``recover(carry, restart_mask, fallback_mask, quarantine_mask) ->
+    carry``: the masked per-column surgery program
+    (``solver/pcg.restart_carry_many`` behind one jitted dispatch) —
+    cold-restarts masked columns at their min-residual iterate, flips
+    ``fallback_mask`` columns to the scalar-Jacobi fallback
+    preconditioner, stamps ``quarantine_mask`` columns terminal.
+
+    ``has_fallback``: whether the cycle program was built with a
+    fallback preconditioner operand (``ops/precond.fallback_kind`` of
+    the configured precond is not None) — without one the ladder's
+    fallback rung repeats the plain restart instead.
+    """
+
+    cycle: Callable[[Any, int], Tuple[Any, Any]]
+    recover: Callable[[Any, Any, Any, Any], Any]
+    has_fallback: bool = False
+
+
+def _upgrade_many_carry(carry: Dict[str, Any], nrhs: int,
+                        fused: bool) -> Dict[str, Any]:
+    """Back-compat shim for blocked snapshots written before the
+    per-column recovery state existed: fill the ``prec_sel`` (and fused
+    ``drift``) leaves with their cold values — zeros, i.e. exactly the
+    pre-upgrade behavior — so pre-existing ``many_*.npz`` resume points
+    still resume instead of failing a pytree mismatch (the
+    ``CheckpointManager.restore`` legacy-shim precedent)."""
+    carry = dict(carry)
+    carry.setdefault("prec_sel", np.zeros(nrhs, np.int32))
+    if fused:
+        carry.setdefault("drift", np.zeros(nrhs, np.int32))
+    return carry
+
+
+def run_many_with_recovery(carry, *, scfg, nrhs: int, hooks, recorder,
+                           resilience=None, resume: bool = False,
+                           fused: bool = False, total0: int = 0,
+                           iters_cols0=None):
+    """Run a blocked (multi-RHS) chunked solve to termination with
+    FAULT ISOLATION BETWEEN COLUMNS — the blocked twin of
+    :func:`run_with_recovery`.
+
+    Per capped dispatch, every column's carry flag and residual norm are
+    classified (:func:`~pcg_mpi_solver_tpu.resilience.recovery.column_trigger`):
+    a flag-2/4/6 breakdown or NaN/Inf carry in column *k* consumes one
+    attempt of column *k*'s OWN bounded
+    :class:`~pcg_mpi_solver_tpu.resilience.recovery.RecoveryLadder`
+    (masked min-residual restart -> per-column scalar-Jacobi fallback)
+    while healthy columns keep iterating — or stay frozen — with
+    bit-identical arithmetic; a column whose budget is spent (or absent,
+    ``scfg.max_recoveries <= 0``) is QUARANTINED: terminal
+    ``QUARANTINE_FLAG``, one ``rhs_quarantine`` telemetry event naming
+    the column, the block completes regardless.  The dispatch guard,
+    mid-solve ``many_*.npz`` snapshots, resume, and deterministic
+    faults all thread through ``resilience``
+    (:class:`~pcg_mpi_solver_tpu.resilience.recovery.ResilienceContext`,
+    optional), exactly like the scalar path.
+
+    Returns ``(x, carry, flags, total, iters_cols, quarantined,
+    recoveries, drift_cols)``.
+    """
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.solver.pcg import QUARANTINE_FLAG
+
+    rec = recorder
+    note = rec.note if rec is not None else (lambda s: None)
+    R = int(nrhs)
+    total = int(total0)
+    iters_cols = (np.zeros(R, np.int64) if iters_cols0 is None
+                  else np.asarray(iters_cols0, np.int64).copy())
+    faults = resilience.faults if resilience is not None else None
+    max_iter = int(scfg.max_iter)
+    ladders: Dict[int, RecoveryLadder] = {}
+    actions_taken: list = []
+
+    # ---- mid-solve resume (``many_*.npz``) ---------------------------
+    st = resilience.load_resume_state() if resilience is not None else None
+    if st is not None and str(np.asarray(st.get("kind", ""))) == "many":
+        carry = resilience.restore_device(
+            {"carry": _upgrade_many_carry(st["carry"], R, fused)})["carry"]
+        total = int(np.asarray(st["total"]))
+        iters_cols = np.asarray(st["iters_cols"], np.int64).copy()
+        note(f"resumed blocked solve (nrhs={R}) at {total} iterations")
+    elif resume:
+        # the negative signal matters operationally: a pruned/corrupt/
+        # absent snapshot must leave a breadcrumb that this run started
+        # COLD, not a stream indistinguishable from a successful resume
+        note(f"solve_many resume requested but no usable blocked "
+             f"snapshot found (nrhs={R}); starting cold")
+
+    flags = np.asarray(carry["flag"])
+    quarantined = {k for k in range(R) if flags[k] == QUARANTINE_FLAG}
+    # drift accounting ACCUMULATES per-dispatch increments: the carry's
+    # drift leaf resets to 0 on every ladder restart (restart_carry_many
+    # cold state), so reading it once at the end would report 0 exactly
+    # on the solves where drift triggered a recovery
+    drift_cols = np.zeros(R, np.int64)
+    drift_prev = np.zeros(R, np.int64)
+    x_fin = carry["x"]
+    while np.any(flags == 1) and total < max_iter:
+        try:
+            if faults is not None:
+                faults.on_dispatch()
+            x_fin, carry = hooks.cycle(carry, max_iter - total)
+            execv = np.asarray(carry["exec"])
+            flags = np.asarray(carry["flag"])
+            normr = np.asarray(carry["normr_act"], dtype=np.float64)
+        except Exception as e:          # noqa: BLE001 — classified below
+            st = (resilience.handle_dispatch_failure(e, "many")
+                  if resilience is not None else None)
+            if st is None:
+                raise
+            # re-dispatch from the snapshot (the donated blocked carry
+            # may have been consumed by the failed dispatch — the host
+            # snapshot is the one copy that cannot have been)
+            carry = resilience.restore_device(
+                {"carry": _upgrade_many_carry(st["carry"], R,
+                                              fused)})["carry"]
+            total = int(np.asarray(st["total"]))
+            iters_cols = np.asarray(st["iters_cols"], np.int64).copy()
+            flags = np.asarray(carry["flag"])
+            # the restored snapshot predates any later quarantine/drift:
+            # re-derive BOTH from the restored carry so a column
+            # quarantined after the snapshot is re-classified (and
+            # re-recovered or re-quarantined) instead of being skipped
+            # forever in its restored poisoned state
+            quarantined = {k for k in range(R)
+                           if flags[k] == QUARANTINE_FLAG}
+            if fused and "drift" in carry:
+                drift_prev = np.asarray(carry["drift"], dtype=np.int64)
+            continue
+        if faults is not None:
+            faults.on_dispatch_done()
+        iters_cols += execv.astype(np.int64)
+        total += int(execv.max()) if execv.size else 0
+        if fused and "drift" in carry:
+            cur = np.asarray(carry["drift"], dtype=np.int64)
+            drift_cols += np.maximum(cur - drift_prev, 0)
+            drift_prev = cur
+
+        # ---- per-column trigger classification + ladder --------------
+        triggers = {}
+        for k in range(R):
+            if k in quarantined:
+                continue
+            t = column_trigger(int(flags[k]), float(normr[k]))
+            if t is not None:
+                triggers[k] = t
+        if triggers:
+            restart_m = np.zeros(R, bool)
+            fb_m = np.zeros(R, bool)
+            quar_m = np.zeros(R, bool)
+            for k, trig in sorted(triggers.items()):
+                lad = ladders.get(k)
+                if lad is None and scfg.max_recoveries > 0:
+                    # the ladder's fallback rung must match what the
+                    # COMPILED cycle program can actually do: without a
+                    # wired fallback inverse (hooks.has_fallback — the
+                    # programs are built once per width), advertising
+                    # the rung would emit `fallback_prec` events for
+                    # what is really a second plain restart
+                    lad = ladders[k] = RecoveryLadder(
+                        precond=(scfg.precond if hooks.has_fallback
+                                 else "jacobi"), mixed=False,
+                        max_recoveries=scfg.max_recoveries,
+                        recorder=rec, extra={"rhs": k})
+                action = lad.next_action(trig) if lad is not None else None
+                if action is None:
+                    quar_m[k] = True
+                    quarantined.add(k)
+                    if rec is not None:
+                        rec.event("rhs_quarantine", rhs=k, trigger=trig,
+                                  flag=QUARANTINE_FLAG,
+                                  attempts=lad.attempt if lad else 0)
+                        rec.inc("resilience.rhs_quarantine")
+                    note(f"solve_many: column {k} quarantined "
+                         f"({trig}, attempts="
+                         f"{lad.attempt if lad else 0})")
+                else:
+                    actions_taken.append(action)
+                    restart_m[k] = True
+                    if action == "fallback_prec" and hooks.has_fallback:
+                        fb_m[k] = True
+                    note(f"solve_many recovery: column {k} {action} "
+                         f"after {trig} (total={total})")
+            carry = hooks.recover(carry, jnp.asarray(restart_m),
+                                  jnp.asarray(fb_m), jnp.asarray(quar_m))
+            flags = np.asarray(carry["flag"])
+            if fused and "drift" in carry:
+                # restarted columns come back with a zeroed drift leaf;
+                # re-baseline so the next dispatch's increment is honest
+                drift_prev = np.asarray(carry["drift"], dtype=np.int64)
+        if not np.any(flags == 1):
+            break
+        if resilience is not None:
+            resilience.after_chunk(lambda: dict(
+                kind="many", total=total, iters_cols=iters_cols,
+                carry=carry))
+            if faults is not None:
+                carry = faults.at_boundary(carry, blocked=True)
+    recoveries = sum(l.attempt for l in ladders.values())
+    if recoveries and rec is not None:
+        rec.event("recovery_done", flag=[int(f) for f in flags],
+                  relres=None, attempts=recoveries,
+                  actions=actions_taken)
+    if rec is not None and int(drift_cols.sum()) > 0:
+        # the fused residual-drift telemetry twin (obs/schema
+        # `resid_drift`): cumulative drifted true-residual checks per
+        # column, surfaced once per blocked solve
+        rec.event("resid_drift", drift=int(drift_cols.sum()),
+                  cols=[int(v) for v in drift_cols])
+        rec.gauge("resid.drift", int(drift_cols.sum()))
+    return (x_fin, carry, flags, total, iters_cols,
+            sorted(quarantined), recoveries, drift_cols)
 
 
 # ----------------------------------------------------------------------

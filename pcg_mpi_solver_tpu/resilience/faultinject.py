@@ -10,15 +10,16 @@ A :class:`FaultPlan` is parsed from a spec string (env ``PCG_TPU_FAULTS``
 or passed programmatically, e.g. ``Solver.fault_plan = FaultPlan(...)``):
 
     spec     := term ("," term)*
-    term     := mode "@" ["s:"] index ["*" count]
+    term     := mode "@" ["s:" | "col:"] index ["*" count]
     mode     := "kill" | "exc" | "nan" | "inf" | "rho0"
     index    := 0-based position in the mode's counter (see below);
                 with the "s:" prefix, the ABSOLUTE timestep number of a
-                time-history run instead
+                time-history run; with the "col:" prefix, the COLUMN
+                index of a blocked multi-RHS solve
     count    := consecutive firings (default 1; "exc@3*2" also fails the
                 first retry of dispatch 3)
 
-Three counter domains.  The first two are monotone over the life of the
+Four counter domains.  The first two are monotone over the life of the
 plan (they keep running across recovery restarts, so a second fault can
 be aimed at a later ladder rung):
 
@@ -35,7 +36,18 @@ be aimed at a later ladder rung):
   replays past N does not re-fire a consumed fault, while ``*count``
   deliberately re-fires it to exercise budget exhaustion.  Step-domain
   modes are ``kill``/``nan``/``inf`` (poison lands on the kinematic
-  state leaf ``u``).
+  state leaf ``u``);
+* the COLUMN domain ("col:" prefix — ``nan@col:2``, ``rho0@col:0``) is
+  indexed by the RHS-block COLUMN of a blocked multi-RHS solve
+  (``Solver.solve_many``): the fault fires at the next blocked chunk
+  boundary (after any due snapshot, like the boundary domain) and
+  poisons ONLY that column of the carry — ``nan``/``inf`` land on the
+  column's residual, ``rho0`` zeroes the column's rho — so the
+  per-column recovery ladder and quarantine paths run deterministically
+  in tier-1 while every other column stays bit-identical (the poison is
+  a ``jnp.where`` column select, never a whole-block rescale).
+  ``*count`` re-fires it at that many consecutive boundaries to defeat
+  a bounded per-column recovery budget.
 
 Modes and the recovery path each one exercises:
 
@@ -66,6 +78,7 @@ MODES = ("kill", "exc", "nan", "inf", "rho0")
 _DISPATCH_MODES = ("exc",)
 _BOUNDARY_MODES = ("kill", "nan", "inf", "rho0")
 _STEP_MODES = ("kill", "nan", "inf")
+_COL_MODES = ("nan", "inf", "rho0")
 
 
 class SimulatedKill(BaseException):
@@ -83,10 +96,12 @@ class InjectedDispatchError(RuntimeError):
 
 
 def _parse(spec: str):
-    """spec string -> ({mode: {index: count}}, {mode: {step: count}})
-    for the dispatch/boundary domains and the step domain."""
+    """spec string -> ({mode: {index: count}}, {mode: {step: count}},
+    {mode: {col: count}}) for the dispatch/boundary domains, the step
+    domain, and the per-column domain of blocked multi-RHS solves."""
     out: Dict[str, Dict[int, int]] = {}
     steps: Dict[str, Dict[int, int]] = {}
+    cols: Dict[str, Dict[int, int]] = {}
     for term in (t.strip() for t in spec.split(",")):
         if not term:
             continue
@@ -98,10 +113,13 @@ def _parse(spec: str):
                 count = int(c)
             rest = rest.strip()
             step_domain = rest.startswith("s:")
-            idx = int(rest[2:] if step_domain else rest)
+            col_domain = rest.startswith("col:")
+            idx = int(rest[4:] if col_domain
+                      else rest[2:] if step_domain else rest)
         except ValueError:
             raise ValueError(
-                f"bad fault term {term!r} (want mode@[s:]index[*count])")
+                f"bad fault term {term!r} "
+                "(want mode@[s:|col:]index[*count])")
         mode = mode.strip()
         if mode not in MODES:
             raise ValueError(f"unknown fault mode {mode!r} "
@@ -115,9 +133,15 @@ def _parse(spec: str):
                     f"fault mode {mode!r} has no step-domain trigger "
                     f"(valid at s: indices: {', '.join(_STEP_MODES)})")
             steps.setdefault(mode, {})[idx] = count
+        elif col_domain:
+            if mode not in _COL_MODES:
+                raise ValueError(
+                    f"fault mode {mode!r} has no column-domain trigger "
+                    f"(valid at col: indices: {', '.join(_COL_MODES)})")
+            cols.setdefault(mode, {})[idx] = count
         else:
             out.setdefault(mode, {})[idx] = count
-    return out, steps
+    return out, steps, cols
 
 
 class FaultPlan:
@@ -129,7 +153,7 @@ class FaultPlan:
     """
 
     def __init__(self, spec: str, recorder=None):
-        self._faults, self._step_faults = _parse(spec)
+        self._faults, self._step_faults, self._col_faults = _parse(spec)
         self.recorder = recorder
         self.dispatches = 0         # completed Krylov dispatches
         self.boundaries = 0         # completed chunk boundaries
@@ -143,12 +167,18 @@ class FaultPlan:
 
     @property
     def armed(self) -> bool:
-        return any(self._faults.values()) or self.step_armed
+        return (any(self._faults.values()) or self.step_armed
+                or self.col_armed)
 
     @property
     def step_armed(self) -> bool:
         """Any step-domain fault still pending."""
         return any(self._step_faults.values())
+
+    @property
+    def col_armed(self) -> bool:
+        """Any column-domain fault still pending."""
+        return any(self._col_faults.values())
 
     def next_step_fault(self, after: int) -> Optional[int]:
         """Smallest pending step-domain index > ``after``, or None — the
@@ -189,11 +219,17 @@ class FaultPlan:
         """Called after a dispatch completes successfully."""
         self.dispatches += 1
 
-    def at_boundary(self, carry: dict) -> dict:
+    def at_boundary(self, carry: dict, blocked: bool = False) -> dict:
         """Called at a chunk boundary AFTER any snapshot was taken (the
         snapshot must hold the clean state — corruption happens to the
         live carry, as it would on real hardware).  Returns the
         (possibly poisoned) carry; may raise :class:`SimulatedKill`.
+
+        ``blocked`` marks a blocked multi-RHS boundary: pending
+        column-domain faults (``mode@col:k``) then fire too, poisoning
+        ONLY column ``k`` of the blocked carry (nan/inf on the column's
+        residual, rho0 on the column's rho) — every other column's
+        leaves stay bitwise untouched.
 
         A poison mode whose target leaf is absent from this path's carry
         (``rho0`` needs ``rho`` — the mixed outer state has none) is NOT
@@ -206,11 +242,36 @@ class FaultPlan:
             if leaf in carry and self._take(mode, idx):
                 self._fire(mode, "boundary", idx)
                 carry = _poison(carry, mode)
+        if blocked:
+            # block width from the carry itself: a column fault aimed
+            # past the actual width cannot land — like the absent-leaf
+            # case above it must be neither consumed nor recorded
+            r, rho = carry.get("r"), carry.get("rho")
+            width = (r.shape[-1] if getattr(r, "ndim", 0) == 3
+                     else rho.shape[0]
+                     if getattr(rho, "ndim", 0) == 1 else 0)
+            for mode, leaf in (("nan", "r"), ("inf", "r"),
+                               ("rho0", "rho")):
+                pend = self._col_faults.get(mode, {})
+                for col in sorted(pend):
+                    if col < width and leaf in carry \
+                            and self._take_col(mode, col):
+                        self._fire(mode, "col", col)
+                        carry = _poison_col(carry, mode, col, leaf)
         if self._take("kill", idx):
             self._fire("kill", "boundary", idx)
             raise SimulatedKill(
                 f"injected kill at chunk boundary {idx} (PCG_TPU_FAULTS)")
         return carry
+
+    def _take_col(self, mode: str, col: int) -> bool:
+        pending = self._col_faults.get(mode, {})
+        if pending.get(col, 0) <= 0:
+            return False
+        pending[col] -= 1
+        if pending[col] <= 0:
+            del pending[col]
+        return True
 
     def _take_step(self, mode: str, t: int) -> bool:
         pending = self._step_faults.get(mode, {})
@@ -266,4 +327,33 @@ def _poison(carry: dict, mode: str, leaf: str = "r") -> dict:
         # next apply_prec trips the flag-2 Inf-preconditioner exit
         out[leaf] = jnp.where(r != 0, jnp.asarray(float("inf"), r.dtype),
                               r)
+    return out
+
+
+def _poison_col(carry: dict, mode: str, col: int, leaf: str) -> dict:
+    """Column-domain poisoner for a blocked multi-RHS carry: corrupt
+    ONLY column ``col`` (trailing RHS axis of the (P, n_loc, R) vectors,
+    index ``col`` of the (R,) scalars).  Built from ``jnp.where`` column
+    selects so every other column's values stay bitwise identical — the
+    fault-isolation tests compare them bit for bit.  Same new-leaves
+    discipline as :func:`_poison` (donated-carry contract)."""
+    import jax.numpy as jnp
+
+    out = dict(carry)
+    if mode == "rho0":
+        rho = out.get("rho")
+        if rho is not None and getattr(rho, "ndim", 0) == 1:
+            mask = jnp.arange(rho.shape[0]) == col
+            out["rho"] = jnp.where(mask, jnp.zeros((), rho.dtype), rho)
+        return out
+    r = out.get(leaf)
+    if r is None or getattr(r, "ndim", 0) != 3:
+        return out
+    mask = (jnp.arange(r.shape[-1]) == col)[None, None, :]
+    if mode == "nan":
+        out[leaf] = jnp.where(mask, r * jnp.asarray(float("nan"),
+                                                    r.dtype), r)
+    elif mode == "inf":
+        out[leaf] = jnp.where(mask & (r != 0),
+                              jnp.asarray(float("inf"), r.dtype), r)
     return out

@@ -69,6 +69,41 @@ def breakdown_trigger(flag: int, relres: float) -> Optional[str]:
     return None
 
 
+def column_trigger(flag: int, normr: float) -> Optional[str]:
+    """Per-column ladder trigger of a blocked multi-RHS carry
+    (:func:`resilience.engine.run_many_with_recovery`): the blocked
+    twin of :func:`breakdown_trigger`, reading the column's carry flag
+    and carry residual norm.  A flag-1 (still running) column with a
+    non-finite norm is the NaN-carry case — no MATLAB flag ever trips
+    on NaN, so the host must intervene before the column burns the
+    whole lockstep budget on poison."""
+    from pcg_mpi_solver_tpu.solver.pcg import BREAKDOWN_FLAGS
+
+    if flag in BREAKDOWN_FLAGS:
+        return f"flag{flag}"
+    if flag == 1 and not math.isfinite(normr):
+        return "nan_carry"
+    return None
+
+
+def retry_deadline_s() -> Optional[float]:
+    """Optional wall clamp on retry storms (``PCG_TPU_RETRY_DEADLINE_S``
+    seconds, env-only): a scarce hardware window must not be eaten by
+    backoff loops.  A malformed value must not kill the solve the knob
+    protects — it disables the deadline with a warning instead."""
+    raw = os.environ.get("PCG_TPU_RETRY_DEADLINE_S", "")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"PCG_TPU_RETRY_DEADLINE_S={raw!r} is not a "
+                      "number; retry deadline disabled")
+        return None
+
+
 class DispatchGuard:
     """Retry-with-backoff + deadline budget for device dispatches.
 
@@ -117,12 +152,16 @@ class RecoveryLadder:
     """
 
     def __init__(self, *, precond: str, mixed: bool, max_recoveries: int,
-                 recorder=None):
+                 recorder=None, extra: Optional[Dict[str, Any]] = None):
         from pcg_mpi_solver_tpu.ops.precond import fallback_kind
 
         self.max_recoveries = int(max_recoveries)
         self.attempt = 0
         self.recorder = recorder
+        # extra fields stamped on every `recovery` event this ladder
+        # emits (the per-column ladders of a blocked solve tag theirs
+        # with the column index: extra={"rhs": k})
+        self.extra = dict(extra or {})
         self.actions_taken: List[str] = []
         rungs = ["restart_minres"]
         if fallback_kind(precond) is not None:
@@ -146,7 +185,8 @@ class RecoveryLadder:
         self.actions_taken.append(action)
         if self.recorder is not None:
             self.recorder.event("recovery", action=action,
-                                attempt=self.attempt, trigger=trigger)
+                                attempt=self.attempt, trigger=trigger,
+                                **self.extra)
             self.recorder.inc(f"resilience.recovery.{action}")
         return action
 

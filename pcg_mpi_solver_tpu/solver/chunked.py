@@ -531,12 +531,16 @@ class ChunkedEngine:
             def _restore_direct(st):
                 """Snapshot state -> (carry, total, relres): the ONE
                 direct-state restore, shared by mid-step resume and the
-                guard's re-dispatch so the two cannot drift."""
-                c = resilience.restore_device(
-                    {"carry": st["carry"]})["carry"]
+                guard's re-dispatch so the two cannot drift.  Fused
+                snapshots written before the drift-guard leaf existed
+                resume with its cold value (the legacy-shim precedent of
+                CheckpointManager.restore)."""
+                sc = dict(st["carry"])
+                if self.variant == "fused":
+                    sc.setdefault("drift", np.zeros((), np.int32))
+                c = resilience.restore_device({"carry": sc})["carry"]
                 return (c, int(np.asarray(st["total"])),
-                        float(np.asarray(
-                            st["carry"]["normr_act"])) / n2b_f)
+                        float(np.asarray(sc["normr_act"])) / n2b_f)
 
             if resume is not None and _state_kind(resume) == "direct":
                 carry, total, relres = _restore_direct(resume)
@@ -602,6 +606,18 @@ class ChunkedEngine:
             # ever updated by committed finite iterations, so it stays
             # finite through NaN poisoning and flag-2/4 breakdowns)
             self.restart_x = carry["xmin"]
+            if self.variant == "fused" and self._rec is not None \
+                    and "drift" in carry:
+                # fused residual-drift telemetry (obs/schema
+                # `resid_drift`): how many deferred true-residual checks
+                # disagreed with the recurrence norm this solve (flag 6
+                # routes sustained drift into the ladder; the count is
+                # the observability twin) — one scalar fetch, at
+                # termination only
+                d = int(carry["drift"])
+                if d > 0:
+                    self._rec.event("resid_drift", drift=d)
+                    self._rec.gauge("resid.drift", d)
         return x_fin, flag, relres, total
 
 

@@ -133,9 +133,11 @@ def normalize_rhs_block(fexts, n_dof: int, dtype=None) -> np.ndarray:
 class ManySolveResult:
     """Per-RHS outcome of a batched :meth:`Solver.solve_many` block:
     flags/relres/iters are (nrhs,) per-column vectors (MATLAB pcg flag
-    taxonomy per column), ``x`` the device-resident blocked solution
-    (n_parts, n_loc, nrhs) on effective dofs — fetch global per-column
-    vectors with :meth:`Solver.displacement_global_many`."""
+    taxonomy per column, plus flag 5 = quarantined — see
+    ``solver/pcg.QUARANTINE_FLAG`` and docs/RUNBOOK.md "Blocked solve
+    failure modes & quarantine"), ``x`` the device-resident blocked
+    solution (n_parts, n_loc, nrhs) on effective dofs — fetch global
+    per-column vectors with :meth:`Solver.displacement_global_many`."""
     flags: np.ndarray
     relres: np.ndarray
     iters: np.ndarray
@@ -147,6 +149,15 @@ class ManySolveResult:
     # per-iteration denominator for nrhs A/Bs, since the scalar step()
     # baseline derives its rhs in-graph from device-resident data
     solve_wall_s: float = 0.0
+
+    # fault isolation between columns (resilience/): the column indices
+    # that ended QUARANTINED (flag 5 — unrecoverable breakdown/poison,
+    # reported as their min-residual iterate + true residual), the total
+    # per-column recovery-ladder attempts consumed, and the fused
+    # residual-drift check count (0 for classic)
+    quarantined: tuple = ()
+    recoveries: int = 0
+    drift: int = 0
 
     @property
     def nrhs(self) -> int:
@@ -969,23 +980,13 @@ class Solver:
 
                 self._snap_store = SnapshotStore.for_solver(self)
             store = self._snap_store
-        # optional wall clamp on the retry storm (a scarce hardware
-        # window must not be eaten by backoff loops): seconds, env-only.
-        # A malformed value must not kill the solve the knob protects.
-        deadline = os.environ.get("PCG_TPU_RETRY_DEADLINE_S", "")
-        try:
-            deadline = float(deadline) if deadline else None
-        except ValueError:
-            import warnings
+        from pcg_mpi_solver_tpu.resilience.recovery import retry_deadline_s
 
-            warnings.warn(f"PCG_TPU_RETRY_DEADLINE_S={deadline!r} is not "
-                          "a number; retry deadline disabled")
-            deadline = None
         return ResilienceContext(
             store=store, step=len(self.flags) + 1, snapshot_every=every,
             fetch_state=self._fetch_state, put_state=self._put_state,
             guard=DispatchGuard(retries=scfg.dispatch_retries,
-                                deadline_s=deadline,
+                                deadline_s=retry_deadline_s(),
                                 recorder=self._rec),
             faults=plan, recorder=self._rec, resume=self._resume_pending,
             ladder_armed=scfg.max_recoveries > 0)
@@ -1191,6 +1192,7 @@ class Solver:
 
         progs = self._ensure_many_programs(R)
         t_solve0 = time.perf_counter()      # staging done: Krylov wall
+        quarantined, recoveries, drift = (), 0, 0
         if "solve" in progs:
             if resume or int(getattr(self.config, "snapshot_every", 0)) > 0:
                 # the one-shot blocked path (mixed precision, or below
@@ -1201,11 +1203,44 @@ class Solver:
                     "blocked solve runs as ONE dispatch (mixed "
                     "precision, or below the dispatch cap) — no "
                     "mid-solve snapshots exist on this path")
-            with self._rec.dispatch("solve_many"):
-                x, flags, relres, iters = progs["solve"](self.data, fb_dev)
-                flags = np.asarray(flags)
-                relres = np.asarray(relres, dtype=np.float64)
-                iters = np.asarray(iters)
+
+            def _one_shot():
+                x, flags, relres, iters = progs["solve"](self.data,
+                                                         fb_dev)
+                # blocking fetches INSIDE the retry guard: a dispatch
+                # that dies mid-execution must count as a failed attempt
+                return (x, np.asarray(flags),
+                        np.asarray(relres, dtype=np.float64),
+                        np.asarray(iters))
+
+            # retry-guarded one-shot dispatch: the blocked program
+            # donates nothing, so a device-loss failure re-dispatches
+            # the identical stateless program instead of failing the
+            # whole block request
+            x, flags, relres, iters = self._dispatch_with_retry(
+                "solve_many", _one_shot)
+            # one-shot quarantine semantics (recovery-exempt from the
+            # ladder: a single stateless dispatch has no resumable carry
+            # to restart columns from — the in-graph finalize already
+            # handed failed columns their min-residual iterate): flag
+            # 2/4/6 breakdowns, in-graph flag-5 poison, and any residual
+            # non-finiteness report as quarantined columns + telemetry
+            from pcg_mpi_solver_tpu.solver.pcg import (
+                BREAKDOWN_FLAGS, QUARANTINE_FLAG)
+
+            quar = (np.isin(flags, BREAKDOWN_FLAGS + (QUARANTINE_FLAG,))
+                    | ~np.isfinite(relres))
+            if quar.any():
+                for j in np.flatnonzero(quar):
+                    trig = ("nan_carry" if not np.isfinite(relres[j])
+                            or int(flags[j]) == QUARANTINE_FLAG
+                            else f"flag{int(flags[j])}")
+                    self._rec.event("rhs_quarantine", rhs=int(j),
+                                    trigger=trig,
+                                    flag=QUARANTINE_FLAG, attempts=0)
+                    self._rec.inc("resilience.rhs_quarantine")
+                flags = np.where(quar, QUARANTINE_FLAG, flags)
+                quarantined = tuple(int(j) for j in np.flatnonzero(quar))
         else:
             rhs_hash = ""
             if resume or int(getattr(self.config, "snapshot_every", 0)) > 0:
@@ -1215,20 +1250,66 @@ class Solver:
                 from pcg_mpi_solver_tpu.cache.keys import array_hash
 
                 rhs_hash = array_hash(fb)
-            x, flags, relres, iters = self._solve_many_chunked(
+            (x, flags, relres, iters, quarantined, recoveries,
+             drift) = self._solve_many_chunked(
                 fb_dev, R, progs, resume, rhs_hash=rhs_hash)
         wall = time.perf_counter() - t0
         res = ManySolveResult(flags=flags, relres=relres, iters=iters,
                               wall_s=wall, x=x,
-                              solve_wall_s=time.perf_counter() - t_solve0)
+                              solve_wall_s=time.perf_counter() - t_solve0,
+                              quarantined=tuple(quarantined),
+                              recoveries=int(recoveries),
+                              drift=int(drift))
         self._rec.event("solve_many", nrhs=R, wall_s=round(wall, 6),
                         flags=[int(f) for f in flags],
-                        iters_max=int(iters.max()) if R else 0)
+                        iters_max=int(iters.max()) if R else 0,
+                        quarantined=[int(j) for j in res.quarantined],
+                        recoveries=int(recoveries))
         for j in range(R):
             # per-RHS telemetry: one event per tenant/load case
             self._rec.event("rhs_solve", rhs=j, flag=int(flags[j]),
-                            relres=float(relres[j]), iters=int(iters[j]))
+                            relres=float(relres[j]), iters=int(iters[j]),
+                            quarantined=bool(j in res.quarantined))
         return res
+
+    def _dispatch_with_retry(self, name: str, fn):
+        """Retry-with-backoff guard for a NON-DONATING device dispatch
+        (resilience/recovery.DispatchGuard): a device-loss-shaped
+        failure re-runs ``fn`` after backoff, bounded by
+        ``solver.dispatch_retries`` and ``PCG_TPU_RETRY_DEADLINE_S``.
+        Only stateless dispatches may pass through here — a program that
+        donates an operand must never be re-dispatched with the same
+        arguments (the donated buffer may already be consumed); those
+        paths re-dispatch from a host snapshot instead
+        (ResilienceContext.handle_dispatch_failure)."""
+        from pcg_mpi_solver_tpu.resilience.recovery import (
+            DispatchGuard, retry_deadline_s)
+
+        plan = self.fault_plan
+        guard = None
+        while True:
+            try:
+                if plan is not None:
+                    plan.on_dispatch()
+                with self._rec.dispatch(name):
+                    out = fn()
+                if plan is not None:
+                    plan.on_dispatch_done()
+                return out
+            except Exception as e:      # noqa: BLE001 — classified below
+                if guard is None:
+                    guard = DispatchGuard(
+                        retries=self.config.solver.dispatch_retries,
+                        deadline_s=retry_deadline_s(),
+                        recorder=self._rec)
+                if not guard.should_retry(e):
+                    raise
+                self._rec.event("recovery", action="redispatch",
+                                attempt=guard.failures,
+                                trigger="device_loss",
+                                error=f"{type(e).__name__}: {e}")
+                self._rec.inc("resilience.recovery.redispatch")
+                guard.backoff()
 
     def displacement_global_many(self, x) -> np.ndarray:
         """Blocked device solution (n_parts, n_loc, nrhs) -> global host
@@ -1251,7 +1332,7 @@ class Solver:
             return self._many_progs[R]
         from pcg_mpi_solver_tpu.solver.pcg import (
             carry_part_specs, cold_carry_many, pcg_many, pcg_mixed_many,
-            select_best_many)
+            restart_carry_many, select_best_many)
 
         scfg = self.config.solver
         mixed = self.mixed
@@ -1261,7 +1342,13 @@ class Solver:
         P, Rsp = self._part_spec, self._rep_spec
         cap = self._dispatch_cap
         chunked = cap > 0 and not mixed
-        progs = {}
+        # per-column ladder rung 2 (fallback preconditioner): wire the
+        # scalar-Jacobi inverse as a second cycle operand only when the
+        # ladder can use it — with precond already "jacobi" (or the
+        # ladder disabled) the selection is compiled out and the cycle
+        # program is unchanged
+        use_fb = chunked and self._many_use_fb()
+        progs = {"has_fallback": use_fb} if chunked else {}
 
         def smap(f, in_specs, out_specs, donate=()):
             return jax.jit(jax.shard_map(
@@ -1314,6 +1401,10 @@ class Solver:
         else:
             carry_specs = carry_part_specs(P, Rsp, fused=fused_v,
                                            many=True)
+            # prec rides as ONE operand either way: the plain primary
+            # inverse, or the (primary, scalar-Jacobi fallback) pair the
+            # per-column ladder selects from via the carry's prec_sel
+            prec_specs = (P, P) if use_fb else P
 
             def _start(data, fb):
                 self._rec.inc("trace.step")
@@ -1327,25 +1418,47 @@ class Solver:
                     jnp.zeros_like(fext), fext, normr0,
                     self.ops.dot_dtype, fused=fused_v)
                 prec = self._make_prec(self.ops, data)
+                if use_fb:
+                    from pcg_mpi_solver_tpu.ops.precond import (
+                        make_fallback_prec)
+
+                    prec = (prec, make_fallback_prec(self.ops, data,
+                                                     scfg.precond))
                 return fext, carry0, normr0, prec
 
             progs["start"] = smap(_start, (self._specs, P),
-                                  (P, carry_specs, Rsp, P))
+                                  (P, carry_specs, Rsp, prec_specs))
 
             def _cycle(data, fext, prec, carry, budget):
+                inv, inv_fb = prec if use_fb else (prec, None)
                 res, carry2 = pcg_many(
-                    self.ops, data, fext, carry["x"], prec,
+                    self.ops, data, fext, carry["x"], inv,
                     tol=scfg.tol,
                     max_iter=jnp.minimum(cap, budget),
                     glob_n_dof_eff=glob_n_eff,
                     max_stag_steps=scfg.max_stag_steps,
                     max_iter_nominal=scfg.max_iter,
-                    carry_in=carry, return_carry=True, variant=variant)
+                    carry_in=carry, return_carry=True, variant=variant,
+                    inv_diag_fb=inv_fb)
                 return res.x, carry2
 
             progs["cycle"] = smap(
-                _cycle, (self._specs, P, P, carry_specs, Rsp),
+                _cycle, (self._specs, P, prec_specs, carry_specs, Rsp),
                 (P, carry_specs), donate=(3,))
+
+            def _recover(data, fext, carry, restart_m, fb_m, quar_m):
+                # masked per-column ladder surgery (pcg.
+                # restart_carry_many): ONE blocked matvec; unmasked
+                # columns pass through bit-identically.  Compiled lazily
+                # by jit — a healthy solve never pays for it.
+                return restart_carry_many(
+                    self.ops, data, fext, carry, restart_m, fb_m,
+                    quar_m, fused=fused_v)
+
+            progs["recover"] = smap(
+                _recover,
+                (self._specs, P, carry_specs, Rsp, Rsp, Rsp),
+                carry_specs)
 
             def _final(data, fext, carry):
                 # the ONE terminal per-column selection lives in
@@ -1405,6 +1518,20 @@ class Solver:
             return None
         return jax.jit(exported.call)
 
+    def _many_use_fb(self) -> bool:
+        """Whether the blocked cycle programs carry the scalar-Jacobi
+        FALLBACK preconditioner operand (per-column ladder rung 2).
+        The ONE predicate shared by the program builder and the blocked
+        snapshot fingerprint (``SnapshotStore.for_many_solver``): a
+        carry whose ``prec_sel`` flipped a column to the fallback must
+        never resume into a program compiled without one — that resume
+        fails as a clear ``many_fallback`` fingerprint mismatch."""
+        from pcg_mpi_solver_tpu.ops.precond import fallback_kind
+
+        scfg = self.config.solver
+        return bool(scfg.max_recoveries > 0
+                    and fallback_kind(scfg.precond) is not None)
+
     def _many_snap_store(self, R: int, rhs_hash: str):
         """Blocked-solve snapshot store for one (width, rhs-content)
         request shape (lazy; the fingerprint embeds both, so a resume
@@ -1418,6 +1545,33 @@ class Solver:
                 self, R, rhs_hash=rhs_hash)
         return self._many_snap[key]
 
+    def _make_many_resilience(self, store, resume: bool):
+        """Blocked-solve resilience context (``kind="many"`` snapshot
+        states at the fixed pseudo-step 1): the dispatch guard, the
+        ``many_*.npz`` snapshot cadence, mid-solve resume, and the fault
+        plan — the blocked twin of :meth:`_make_resilience`.  None when
+        nothing is armed."""
+        scfg = self.config.solver
+        every = int(getattr(self.config, "snapshot_every", 0))
+        plan = self.fault_plan
+        if store is None and plan is None and scfg.max_recoveries <= 0:
+            return None
+        from pcg_mpi_solver_tpu.resilience.recovery import (
+            DispatchGuard, ResilienceContext, retry_deadline_s)
+
+        def fetch(state):
+            return {k: (self._fetch_state(v) if k == "carry"
+                        else np.asarray(v)) for k, v in state.items()}
+
+        return ResilienceContext(
+            store=store, step=1, snapshot_every=every,
+            fetch_state=fetch, put_state=self._put_state,
+            guard=DispatchGuard(retries=scfg.dispatch_retries,
+                                deadline_s=retry_deadline_s(),
+                                recorder=self._rec),
+            faults=plan, recorder=self._rec, resume=resume,
+            ladder_armed=scfg.max_recoveries > 0)
+
     def _solve_many_chunked(self, fb_dev, R: int, progs, resume: bool,
                             rhs_hash: str = ""):
         """Host budget loop for a blocked direct solve: capped resumable
@@ -1425,65 +1579,70 @@ class Solver:
         flags deciding termination, optional mid-solve snapshots every
         ``config.snapshot_every`` chunk boundaries.  The snapshot is
         discarded only on successful completion — a crashed/killed solve
-        leaves it for ``solve_many(..., resume=True)``."""
+        leaves it for ``solve_many(..., resume=True)``.
+
+        The loop itself — per-column breakdown/NaN classification, the
+        bounded per-column recovery ladder, column quarantine, the
+        guarded re-dispatch, snapshots and fault injection — is the
+        shared :func:`resilience.engine.run_many_with_recovery` harness;
+        this method supplies the blocked device programs (cycle,
+        masked recover) as
+        :class:`~pcg_mpi_solver_tpu.resilience.engine.ManyRecoveryHooks`."""
+        from pcg_mpi_solver_tpu.resilience.engine import (
+            ManyRecoveryHooks, run_many_with_recovery)
+
         scfg = self.config.solver
         rec = self._rec
+        fused_v = scfg.pcg_variant == "fused"
         every = int(getattr(self.config, "snapshot_every", 0))
         store = (self._many_snap_store(R, rhs_hash)
                  if (every > 0 or resume) else None)
         with rec.dispatch("many_start"):
             fext, carry, normr0, prec = progs["start"](self.data, fb_dev)
             jax.block_until_ready(normr0)
-        total = 0
-        iters_cols = np.zeros(R, dtype=np.int64)
-        flags = np.asarray(carry["flag"])
-        if resume and store is not None:
-            t = store.latest()
-            st = store.load(t) if t is not None else None
-            if st is not None and str(np.asarray(
-                    st.get("kind", ""))) == "many":
-                carry = self._put_state({"carry": st["carry"]})["carry"]
-                total = int(np.asarray(st["total"]))
-                iters_cols = np.asarray(st["iters_cols"],
-                                        dtype=np.int64).copy()
-                flags = np.asarray(carry["flag"])
-                rec.note(f"resumed blocked solve (nrhs={R}) at "
-                         f"{total} iterations")
-            else:
-                # the negative signal matters operationally: a pruned/
-                # corrupt/absent snapshot must leave a breadcrumb that
-                # this run started COLD, not a stream indistinguishable
-                # from a successful resume
-                rec.note(f"solve_many resume requested but no usable "
-                         f"blocked snapshot found (nrhs={R}); "
-                         "starting cold")
-        chunk_i = 0
-        x_fin = carry["x"]
-        while np.any(flags == 1) and total < scfg.max_iter:
-            budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
+        ctx = self._make_many_resilience(store, resume)
+
+        def _cycle(carry, budget):
             with rec.dispatch("many_cycle"):
-                x_fin, carry = progs["cycle"](self.data, fext, prec,
-                                              carry, budget)
-                execv = np.asarray(carry["exec"])
-                flags = np.asarray(carry["flag"])
-            iters_cols += execv.astype(np.int64)
-            total += int(execv.max()) if execv.size else 0
-            chunk_i += 1
-            if not np.any(flags == 1):
-                break
-            if store is not None and every > 0 and chunk_i % every == 0:
-                state = dict(kind="many", total=total,
-                             iters_cols=iters_cols,
-                             carry=self._fetch_state(carry))
-                store.save(1, state)
+                x, c2 = progs["cycle"](self.data, fext, prec, carry,
+                                       jnp.asarray(budget, jnp.int32))
+                # blocking fetch inside the span (async dispatch)
+                jax.block_until_ready(c2["exec"])
+            return x, c2
+
+        def _recover(carry, restart_m, fb_m, quar_m):
+            with rec.dispatch("many_recover"):
+                c2 = progs["recover"](self.data, fext, carry,
+                                      restart_m, fb_m, quar_m)
+                jax.block_until_ready(c2["flag"])
+            return c2
+
+        (x_fin, carry, flags, _total, iters_cols, quarantined,
+         recoveries, drift_cols) = run_many_with_recovery(
+            carry, scfg=scfg, nrhs=R, recorder=rec,
+            hooks=ManyRecoveryHooks(cycle=_cycle, recover=_recover,
+                                    has_fallback=bool(
+                                        progs.get("has_fallback"))),
+            resilience=ctx, resume=resume, fused=fused_v)
         with rec.dispatch("many_final"):
             x_fin, relres = progs["final"](self.data, fext, carry)
             relres = np.asarray(relres, dtype=np.float64)
-        if store is not None:
-            store.discard(1)
-        return x_fin, flags, relres, iters_cols
+        if ctx is not None:
+            # the solve completed: its mid-solve snapshot must not
+            # outlive it (a store always implies a ctx —
+            # _make_many_resilience never returns None with one)
+            ctx.discard()
+        return (x_fin, flags, relres, iters_cols, quarantined,
+                recoveries, int(drift_cols.sum()))
 
     def step(self, delta: float) -> StepResult:
+        # recovery-exempt: the one-shot step DONATES the previous
+        # solution vector, so a failed dispatch must never be re-run
+        # with the same (possibly consumed) operand, and a single
+        # stateless dispatch has no resumable carry for the ladder to
+        # restart from — resilience is the chunked path's job
+        # (_step_chunked -> run_with_recovery); the except arm below
+        # only restores a retryable zero state.
         t0 = time.perf_counter()
         if self._dispatch_cap > 0:
             flag, relres, iters = self._step_chunked(delta)

@@ -632,6 +632,11 @@ class NewmarkSolver:
         return flag, relres, total
 
     def step(self, delta_next: float) -> StepResult:
+        # recovery-exempt: the one-shot Newmark step is a single
+        # stateless dispatch with no resumable carry to restart from —
+        # resilience is the chunked path's job (_step_chunked ->
+        # run_with_recovery), and the time-history level already has the
+        # TimeHistoryGuard rollback/resume harness around run().
         t0 = time.perf_counter()
         if self._dispatch_cap > 0:
             flag, relres, iters = self._step_chunked(delta_next)

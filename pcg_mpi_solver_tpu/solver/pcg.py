@@ -45,16 +45,43 @@ from pcg_mpi_solver_tpu.obs.trace import trace_record, trace_specs
 from pcg_mpi_solver_tpu.ops.matvec import Ops
 
 # Flag taxonomy for recovery policy (resilience/): flags 2 (Inf
-# preconditioner) and 4 (rho/pq breakdown) are RECOVERABLE-by-restart —
-# they mean the Krylov recurrence collapsed, not that the system is
-# unsolvable, so restarting CG from the tracked min-residual iterate (a
-# fresh direction set, possibly with a weaker-but-safer preconditioner)
+# preconditioner), 4 (rho/pq breakdown) and 6 (sustained fused
+# residual drift, below) are RECOVERABLE-by-restart — they mean the
+# Krylov recurrence collapsed, not that the system is unsolvable, so
+# restarting CG from the tracked min-residual iterate (a fresh
+# direction set, possibly with a weaker-but-safer preconditioner)
 # routinely completes the solve.  Flags 1 (budget) and 3 (stagnation /
 # tolerance floor) are NOT in this set: restarts cannot conjure more
 # iterations or a finer floor.  NaN carries trip NO flag at all (every
 # breakdown predicate compares false on NaN) — detecting them is the
 # host-side budget loop's job (solver/chunked.py).
-BREAKDOWN_FLAGS = (2, 4)
+BREAKDOWN_FLAGS = (2, 4, 6)
+
+# Terminal flag of a QUARANTINED column of a blocked multi-RHS solve
+# (resilience/engine.run_many_with_recovery, and pcg_many's one-shot
+# finalize for a NaN-poisoned column): the column's recovery budget is
+# spent (or there is none) and its reported solution is the tracked
+# min-residual iterate with its recomputed true residual — the block
+# completes instead of failing on one pathological tenant.  Documented
+# in docs/RUNBOOK.md "Blocked solve failure modes & quarantine".
+QUARANTINE_FLAG = 5
+
+# Fused-variant residual-drift guard (satellite of ISSUE 9, per the
+# communication-reduced CG survey arXiv:2501.03743 §4: recurrence-based
+# variants accumulate true-vs-recurrence residual drift).  The deferred
+# true-residual check (mode 1) already owns an honest recomputed norm;
+# when it exceeds FUSED_DRIFT_FACTOR x the recurrence norm that
+# prompted the candidacy (and the check did not converge), the
+# iteration is counted as DRIFTED in the carry's ``drift`` leaf.  At
+# FUSED_DRIFT_LIMIT drifted checks the loop exits with flag 6
+# (DRIFT_FLAG) — a recoverable breakdown: the ladder restarts from the
+# min-residual iterate with a fresh recurrence instead of letting the
+# solve grind on a residual recurrence that no longer tracks truth.
+# Constants, not SolverConfig knobs: they gate a failure diagnostic,
+# not a numerics choice, so they must not fork cache keys/fingerprints.
+DRIFT_FLAG = 6
+FUSED_DRIFT_FACTOR = 2.0
+FUSED_DRIFT_LIMIT = 3
 
 # Loop formulations (SolverConfig.pcg_variant): "classic" is the
 # MATLAB-compatible 3-reduction body, "fused" the Chronopoulos–Gear
@@ -110,6 +137,9 @@ def cold_carry(x0, r0, normr0, dot_dtype, trace=None,
         out["q"] = jnp.zeros_like(x0)
         out["alpha"] = jnp.asarray(np.inf, dd)
         out["fresh"] = jnp.asarray(1, jnp.int32)
+        # drifted-true-residual-check count (FUSED_DRIFT_LIMIT guard);
+        # rides the resumable carry so capped dispatches accumulate it
+        out["drift"] = zero_i
     if trace is not None:
         out["trace"] = trace
     return out
@@ -123,17 +153,20 @@ def carry_part_specs(part_spec, rep_spec, trace: bool = False,
     leaves — the A.p vector and two replicated scalars).  ``many`` is
     the RHS-blocked carry (:func:`pcg_many`): same keys with (R,)
     bookkeeping vectors (still replicated) plus the per-RHS ``flag``
-    leaf — a blocked resume must keep already-terminated columns frozen
-    across dispatch boundaries, which the scalar carry never needed."""
+    and ``prec_sel`` leaves — a blocked resume must keep
+    already-terminated columns frozen and per-column recovery state
+    (which preconditioner each column runs, resilience/) intact across
+    dispatch boundaries, which the scalar carry never needed."""
     P, R = part_spec, rep_spec
     out = dict(x=P, r=P, p=P, rho=R, stag=R, moresteps=R,
                normrmin=R, xmin=P, imin=R, since_best=R, best_at_reset=R,
                win_start=R, win_count=R,
                normr_act=R, exec=R)
     if fused:
-        out.update(q=P, alpha=R, fresh=R)
+        out.update(q=P, alpha=R, fresh=R, drift=R)
     if many:
         out["flag"] = R
+        out["prec_sel"] = R
     if trace:
         out["trace"] = trace_specs(R)
     return out
@@ -351,6 +384,13 @@ def pcg(
                            else jnp.asarray(np.inf, ops.dot_dtype))
         carry0["fresh"] = (carry_in["fresh"] if warm
                            else jnp.asarray(1, jnp.int32))
+        # residual-drift guard state: cumulative drifted-check count
+        # (exported, resumes across dispatches) and the recurrence norm
+        # of the pending candidate (internal — mode is always 0 at loop
+        # exit, so it never needs to ride the exported carry)
+        carry0["drift"] = (carry_in["drift"] if warm
+                           else jnp.asarray(0, jnp.int32))
+        carry0["chk_normr"] = jnp.asarray(0.0, ops.dot_dtype)
     if traced:
         carry0["trace"] = trace0
 
@@ -451,6 +491,11 @@ def pcg(
                 out["trace"] = jax.tree_util.tree_map(
                     lambda a, b: jnp.where(record, a, b),
                     rec_tr, c["trace"])
+        # carry leaves the epilogue does not own (the fused drift guard
+        # state) pass through unchanged unless ``extra`` overrode them —
+        # the while carry must stay type-stable across every branch
+        for k in c:
+            out.setdefault(k, c[k])
         return out
 
     def body(c):
@@ -677,9 +722,12 @@ def pcg(
                                fresh=jnp.asarray(1, jnp.int32)),
                     record=~already)
                 # Candidate: defer to the next trip's true-residual
-                # check of the CURRENT iterate; nothing is committed.
+                # check of the CURRENT iterate; nothing is committed
+                # (``chk_normr`` records the recurrence norm the check
+                # will be compared against — the drift guard).
                 pending = dict(c, stag=stag, iter_out=i,
-                               mode=jnp.asarray(1, jnp.int32))
+                               mode=jnp.asarray(1, jnp.int32),
+                               chk_normr=normr)
                 return jax.tree_util.tree_map(
                     lambda a, b: jnp.where(candidate, a, b),
                     pending, resolved)
@@ -696,12 +744,28 @@ def pcg(
             # re-fire without an intervening committed update.
             r_true = fext - kx
             normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
-            return _resolve(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
-                            stag=c["stag"], normr_act=normr_act,
-                            candidate=jnp.asarray(True), i=i,
-                            extra=dict(q=c["q"], alpha=c["alpha"],
-                                       fresh=jnp.asarray(0, jnp.int32),
-                                       i=i))
+            # residual-drift guard (arXiv:2501.03743): a non-converged
+            # check whose TRUE residual exceeds FUSED_DRIFT_FACTOR x the
+            # recurrence norm that prompted the candidacy means the
+            # recurrence residual no longer tracks truth
+            disagree = ((normr_act > tolb)
+                        & (normr_act > jnp.asarray(
+                            FUSED_DRIFT_FACTOR, normr_act.dtype)
+                           * c["chk_normr"]))
+            drift = (c["drift"] + disagree).astype(jnp.int32)
+            out = _resolve(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
+                           stag=c["stag"], normr_act=normr_act,
+                           candidate=jnp.asarray(True), i=i,
+                           extra=dict(q=c["q"], alpha=c["alpha"],
+                                      fresh=jnp.asarray(0, jnp.int32),
+                                      i=i, drift=drift))
+            # sustained drift: exit recoverably (flag 6) instead of
+            # grinding on a stale recurrence — the ladder restarts from
+            # the min-residual iterate with a fresh recurrence
+            drift_exit = (out["flag"] == 1) & (drift >= FUSED_DRIFT_LIMIT)
+            out["flag"] = jnp.where(drift_exit, DRIFT_FLAG,
+                                    out["flag"]).astype(jnp.int32)
+            return out
 
         return jax.lax.cond(is_check, post_check, post_iterate,
                             (c, operand, kop))
@@ -764,9 +828,9 @@ def pcg(
                 "best_at_reset", "win_start", "win_count", "normr_act"]
         if fused:
             # the Chronopoulos–Gear recurrence state resumes like the
-            # rest of the Krylov carry (q = A.p, the previous alpha, and
-            # the update-since-check gate)
-            keys += ["q", "alpha", "fresh"]
+            # rest of the Krylov carry (q = A.p, the previous alpha, the
+            # update-since-check gate, and the drift-guard count)
+            keys += ["q", "alpha", "fresh", "drift"]
         carry = {k: c[k] for k in keys}
         # Executed body-iteration count for host-side budget accounting
         # (result.iters reports the min-residual index on failure, which
@@ -996,9 +1060,11 @@ def _colsel(mask, a, b):
 
 def cold_carry_many(x0, r0, normr0, dot_dtype, fused: bool = False) -> dict:
     """Blocked twin of :func:`cold_carry`: x0/r0 are (P, n_loc, R), the
-    bookkeeping rides as (R,) vectors, and the per-RHS ``flag`` leaf
-    (all-1 = running) joins the carry so a resumed dispatch keeps
-    already-terminated columns frozen.  Same donation contract."""
+    bookkeeping rides as (R,) vectors, and the per-RHS ``flag`` and
+    ``prec_sel`` leaves (all-1 = running, all-0 = primary
+    preconditioner) join the carry so a resumed dispatch keeps
+    already-terminated columns frozen and per-column recovery state
+    intact.  Same donation contract."""
     dd = dot_dtype
     R = x0.shape[-1]
     zi = jnp.zeros((R,), jnp.int32)
@@ -1011,11 +1077,13 @@ def cold_carry_many(x0, r0, normr0, dot_dtype, fused: bool = False) -> dict:
         since_best=zi, best_at_reset=n0,
         win_start=n0, win_count=zi,
         normr_act=n0, exec=zi,
-        flag=jnp.ones((R,), jnp.int32))
+        flag=jnp.ones((R,), jnp.int32),
+        prec_sel=zi)
     if fused:
         out["q"] = jnp.zeros_like(x0)
         out["alpha"] = jnp.full((R,), np.inf, dd)
         out["fresh"] = jnp.ones((R,), jnp.int32)
+        out["drift"] = zi
     return out
 
 
@@ -1041,7 +1109,12 @@ def select_best_many(ops: Ops, data: dict, fext: jnp.ndarray, carry: dict,
     if always_min:
         x, relres = carry["xmin"], normr_min / den
     else:
-        use_min = normr_min < carry["normr_act"]
+        # a NaN/Inf-poisoned column compares False everywhere: force the
+        # min-residual fallback so a quarantined column still returns an
+        # internally-consistent finite (x, relres) pair (xmin is only
+        # ever updated by committed finite iterations)
+        use_min = ((normr_min < carry["normr_act"])
+                   | ~jnp.isfinite(carry["normr_act"]))
         x = _colsel(use_min, carry["xmin"], carry["x"])
         relres = jnp.where(use_min, normr_min, carry["normr_act"]) / den
     if respect_flags:
@@ -1052,6 +1125,61 @@ def select_best_many(ops: Ops, data: dict, fext: jnp.ndarray, carry: dict,
         x = jnp.where(zero[None, None, :], jnp.zeros_like(x), x)
         relres = jnp.where(zero, 0.0, relres)
     return x, relres
+
+
+def restart_carry_many(ops: Ops, data: dict, fext: jnp.ndarray,
+                       carry: dict, restart_mask, fallback_mask,
+                       quarantine_mask, fused: bool = False) -> dict:
+    """Per-column recovery surgery on a blocked resumable carry (the
+    masked twin of the scalar ladder's min-residual restart,
+    resilience/engine.run_many_with_recovery):
+
+    * ``restart_mask`` columns get a COLD Krylov carry at their tracked
+      min-residual iterate ``xmin`` — residual recomputed by ONE blocked
+      matvec for the whole block, flag back to 1 (running), recurrence/
+      bookkeeping/drift state reset;
+    * ``fallback_mask`` (a subset of restart) columns additionally flip
+      their ``prec_sel`` to the scalar-Jacobi fallback preconditioner
+      (the per-column rung-2 escalation);
+    * ``quarantine_mask`` columns get the terminal ``QUARANTINE_FLAG``
+      and are otherwise frozen (their min-residual fallback happens once,
+      in :func:`select_best_many`).
+
+    Every UNMASKED column's leaves pass through bit-identically
+    (``jnp.where`` selects, never rescales), which is what keeps healthy
+    columns' solutions bit-identical to a fault-free block run — the
+    fault-isolation contract of tests/test_pcg_many.py."""
+    eff = data["eff"]
+    w = data["weight"] * eff
+    dd = carry["rho"].dtype
+    R = fext.shape[-1]
+    m = restart_mask
+    xmin = carry["xmin"]
+    r_new = fext - eff[..., None] * ops.matvec(data, xmin)
+    normr_new = jnp.sqrt(ops.wdot_many(w, r_new, r_new))
+    zi = jnp.zeros((R,), jnp.int32)
+    out = dict(carry)
+    out["x"] = _colsel(m, xmin, carry["x"])
+    out["r"] = _colsel(m, r_new, carry["r"])
+    out["p"] = _colsel(m, jnp.zeros_like(xmin), carry["p"])
+    out["rho"] = jnp.where(m, jnp.ones((R,), dd), carry["rho"])
+    for k in ("stag", "moresteps", "imin", "since_best", "win_count",
+              "exec"):
+        out[k] = jnp.where(m, zi, carry[k]).astype(jnp.int32)
+    for k in ("normrmin", "best_at_reset", "win_start", "normr_act"):
+        out[k] = jnp.where(m, normr_new, carry[k])
+    out["prec_sel"] = jnp.where(fallback_mask, 1,
+                                carry["prec_sel"]).astype(jnp.int32)
+    out["flag"] = jnp.where(
+        quarantine_mask, QUARANTINE_FLAG,
+        jnp.where(m, 1, carry["flag"])).astype(jnp.int32)
+    if fused:
+        out["q"] = _colsel(m, jnp.zeros_like(xmin), carry["q"])
+        out["alpha"] = jnp.where(m, jnp.full((R,), np.inf, dd),
+                                 carry["alpha"])
+        out["fresh"] = jnp.where(m, 1, carry["fresh"]).astype(jnp.int32)
+        out["drift"] = jnp.where(m, zi, carry["drift"]).astype(jnp.int32)
+    return out
 
 
 def pcg_many(
@@ -1073,6 +1201,7 @@ def pcg_many(
     progress_ratio: float = 0.7,
     progress_min_gain: float = 30.0,
     variant: str = "classic",
+    inv_diag_fb: Optional[jnp.ndarray] = None,
 ):
     """Blocked multi-RHS ``pcg``: solves K.x_j = fext_j for every column
     j of the RHS block in ONE lockstep while-loop with a per-RHS
@@ -1080,6 +1209,15 @@ def pcg_many(
     whose ``x`` is (P, n_loc, R) and whose flag/relres/iters are (R,)
     per-column vectors, or ``(result, carry)`` with ``return_carry``
     (the resumable-dispatch contract of :func:`pcg`, per column).
+
+    ``inv_diag_fb`` (optional) is the scalar-Jacobi FALLBACK
+    preconditioner inverse for per-column recovery: the carry's
+    ``prec_sel`` leaf selects, per column, whether the primary or the
+    fallback inverse preconditions that column's residual — the
+    recovery ladder flips one broken column to the safe inverse while
+    every other column's arithmetic stays bit-identical (both applies
+    are collective-free elementwise/small-matmul work, so the body psum
+    count is untouched).  Without it the selection is compiled out.
 
     See the module-level "Batched multi-RHS PCG" note for the exact
     per-column semantics and the collective-count contract."""
@@ -1150,6 +1288,9 @@ def pcg_many(
                    else normr0.astype(dd)),
         win_count=carry_in["win_count"] if warm else zi,
         mode=zi,
+        # per-column preconditioner selector (0 = primary, 1 = fallback):
+        # recovery state that must resume with the rest of the carry
+        prec_sel=(carry_in["prec_sel"] if warm else zi),
     )
     if fused:
         carry0["q"] = carry_in["q"] if warm else jnp.zeros_like(x0)
@@ -1157,6 +1298,18 @@ def pcg_many(
                            else jnp.full((R,), np.inf, dd))
         carry0["fresh"] = (carry_in["fresh"] if warm
                            else jnp.ones((R,), jnp.int32))
+        carry0["drift"] = carry_in["drift"] if warm else zi
+        carry0["chk_normr"] = jnp.zeros((R,), dd)
+
+    def _prec_apply(c):
+        """Per-column preconditioner apply: the primary inverse, with
+        ``prec_sel`` columns flipped to the fallback inverse when one is
+        wired (collective-free — the psum budget is untouched)."""
+        z = ops.apply_prec(inv_diag, c["r"])
+        if inv_diag_fb is not None:
+            z = _colsel(c["prec_sel"] > 0,
+                        ops.apply_prec(inv_diag_fb, c["r"]), z)
+        return z
 
     def cond(c):
         return jnp.any((c["flag"] == 1) & (c["i"] < max_iter))
@@ -1222,6 +1375,11 @@ def pcg_many(
         )
         if extra:
             out.update(extra)
+        # recovery/drift leaves the epilogue does not own pass through
+        # unchanged (prec_sel, and the fused drift-guard state) — the
+        # while carry must stay type-stable across every branch
+        for k in c:
+            out.setdefault(k, c[k])
         return out
 
     def _merge_cases(c, cases):
@@ -1250,7 +1408,7 @@ def pcg_many(
         it_m = active & ~is_check
 
         # -- pre (mode 0): z, rho, beta, direction recurrence ----------
-        z = ops.apply_prec(inv_diag, c["r"])
+        z = _prec_apply(c)
         inf_col = jnp.isinf(z).any(axis=(0, 1)).astype(dd)
         red = ops.wdots_many(w, [(z, c["r"])], extra=[inf_col])
         rho_new, flag2 = red[0], red[1] > 0
@@ -1323,7 +1481,7 @@ def pcg_many(
         is_check = (c["mode"] == 1) & active
         it_m = active & ~is_check
 
-        z = ops.apply_prec(inv_diag, c["r"])
+        z = _prec_apply(c)
         operand = _colsel(is_check, c["x"], z)
         kop = amul(operand)          # A.z (iterate cols) / A.x (check cols)
 
@@ -1370,17 +1528,30 @@ def pcg_many(
                        alpha=alpha.astype(dd),
                        fresh=jnp.ones((R,), jnp.int32)))
         pend = dict(c, stag=stag, iter_out=i,
-                    mode=jnp.ones((R,), jnp.int32))
+                    mode=jnp.ones((R,), jnp.int32),
+                    chk_normr=jnp.where(candidate, normr.astype(dd),
+                                        c["chk_normr"]))
         brk = dict(c, flag=new_flag, iter_out=i, rho=rho)
 
         r_true = fext - kop
         normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
+        # per-column residual-drift guard (same contract as the scalar
+        # fused post_check): a non-converged check whose true residual
+        # exceeds FUSED_DRIFT_FACTOR x the recurrence norm counts as
+        # drifted; at FUSED_DRIFT_LIMIT the column exits with flag 6
+        disagree = ((normr_chk > tolb)
+                    & (normr_chk > jnp.asarray(FUSED_DRIFT_FACTOR, dd)
+                       * c["chk_normr"]))
+        drift = (c["drift"] + disagree).astype(jnp.int32)
         chk = _resolve_many(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
                             stag=c["stag"], normr_act=normr_chk,
                             candidate=jnp.ones((R,), bool), i=i,
                             extra=dict(q=c["q"], alpha=c["alpha"],
                                        fresh=jnp.zeros((R,), jnp.int32),
-                                       i=i))
+                                       i=i, drift=drift))
+        drift_exit = (chk["flag"] == 1) & (drift >= FUSED_DRIFT_LIMIT)
+        chk["flag"] = jnp.where(drift_exit, DRIFT_FLAG,
+                                chk["flag"]).astype(jnp.int32)
 
         m_brk = it_m & (flag2 | breakdown) & ~candidate
         m_pend = it_m & candidate
@@ -1403,7 +1574,12 @@ def pcg_many(
             x_bad, relres_bad = c["xmin"], normr_min / n2b
             iters_bad = c["imin"]
         else:
-            use_min = normr_min < c["normr_act"]
+            # NaN-poisoned columns compare False: force the min-residual
+            # fallback so a poisoned column still reports a finite,
+            # internally-consistent (x, relres) pair (quarantine
+            # semantics — the host has no ladder on the one-shot path)
+            use_min = ((normr_min < c["normr_act"])
+                       | ~jnp.isfinite(c["normr_act"]))
             x_bad = _colsel(use_min, c["xmin"], c["x"])
             relres_bad = jnp.where(use_min, normr_min,
                                    c["normr_act"]) / n2b
@@ -1422,15 +1598,26 @@ def pcg_many(
     relres = jnp.where(zero_rhs, 0.0, relres)
     iters = jnp.where(skip_mask, 0, iters + 1)
     flag = jnp.where(zero_rhs, 0, c["flag"]).astype(jnp.int32)
+    if not return_carry:
+        # One-shot terminal reporting: a NaN/Inf-poisoned column trips
+        # NO MATLAB flag, but finalize() already handed it the finite
+        # min-residual fallback — surface the poisoning as the terminal
+        # QUARANTINE_FLAG instead of a flag that reads like an honest
+        # budget/stagnation exit.  The resumable path must NOT do this:
+        # the host-side per-column ladder reads flag 1 + a non-finite
+        # carry norm as its nan_carry trigger.
+        poisoned = ~jnp.isfinite(c["normr_act"]) & (flag != 0) & ~zero_rhs
+        flag = jnp.where(poisoned, QUARANTINE_FLAG, flag).astype(jnp.int32)
 
     result = PCGResult(x=x, flag=flag, relres=relres.astype(jnp.float32),
                        iters=iters)
     if return_carry:
         keys = ["x", "r", "p", "rho", "stag", "moresteps",
                 "normrmin", "xmin", "imin", "since_best",
-                "best_at_reset", "win_start", "win_count", "normr_act"]
+                "best_at_reset", "win_start", "win_count", "normr_act",
+                "prec_sel"]
         if fused:
-            keys += ["q", "alpha", "fresh"]
+            keys += ["q", "alpha", "fresh", "drift"]
         carry = {k: c[k] for k in keys}
         carry["flag"] = flag
         # executed body-iteration count per column; columns that never

@@ -26,6 +26,13 @@ Two record granularities share the directory and the fingerprint guard:
   kill-and-resume continues MID-TIME-HISTORY with bit-identical
   probe/frame history, and on-disk retention is bounded to the newest K
   files (``PCG_TPU_SNAP_KEEP``).
+* ``many_{t:06d}.npz`` — mid-solve blocked carry of a batched multi-RHS
+  solve (:class:`SnapshotStore.for_many_solver`, driven by
+  ``resilience/engine.run_many_with_recovery``): the fingerprint embeds
+  the block width AND the rhs content hash.  Retention pruning and the
+  corrupt-tolerant :meth:`SnapshotStore.latest` pointer are
+  PREFIX-SCOPED, so they govern this namespace exactly like
+  ``snap_*``/``step_*`` (asserted in tests/test_pcg_many.py).
 
 A fingerprint of the model and solver configuration guards all of them
 against resuming with mismatched state.
@@ -423,6 +430,13 @@ class SnapshotStore:
         fp = dict(_fingerprint(solver))
         fp["nrhs"] = int(nrhs)
         fp["rhs_hash"] = str(rhs_hash)
+        # whether the blocked cycle programs carry the fallback-
+        # preconditioner operand (driver._many_use_fb): a carry whose
+        # ``prec_sel`` flipped a column to the fallback must never
+        # resume into a program compiled without one — the selection
+        # would be silently compiled out
+        fp["many_fallback"] = bool(
+            getattr(solver, "_many_use_fb", lambda: False)())
         return cls(solver.config.checkpoint_path, fp, prefix="many")
 
     @classmethod
@@ -536,6 +550,12 @@ class SnapshotStore:
         # fingerprint without it must keep comparing equal to itself.
         if self.fingerprint is not None and "nrhs" in self.fingerprint:
             saved.setdefault("nrhs", 1)
+        if self.fingerprint is not None \
+                and "many_fallback" in self.fingerprint:
+            # blocked snapshots written before the per-column fallback
+            # wiring existed can only have come from programs without
+            # the fallback operand
+            saved.setdefault("many_fallback", False)
         if self.fingerprint is not None:
             # snapshots written before the fingerprint-completeness
             # sweep (analysis/) did not record these numerics knobs;

@@ -333,6 +333,26 @@ def check_rhs_block(fexts: Any, n_dof: int) -> List[CheckResult]:
             "they solve to x = 0 but still ride every blocked matvec"))
     else:
         results.append(CheckResult("rhs_block_zero", "ok"))
+    # norm spread across the block: per-column tolerances are RELATIVE
+    # (tolb_j = tol * ||b_j||), so a column whose load norm is many
+    # orders below its block-mates chases an absolute residual near the
+    # working-precision floor of the SHARED lockstep arithmetic — the
+    # classic way one tenant column ends flag 3 (stagnation) or enters
+    # the recovery ladder while the rest of the block converges.  Warn,
+    # don't fail: the solve is still well-defined.
+    if finite_cols.all() and not zero_cols.any() and a.shape[1] > 1:
+        norms = np.linalg.norm(a, axis=0)
+        lo, hi = float(norms.min()), float(norms.max())
+        if lo > 0 and hi / lo > 1e10:
+            results.append(CheckResult(
+                "rhs_block_spread", "warn",
+                f"column load norms span {hi / lo:.1e}x (min rhs "
+                f"{int(np.argmin(norms))}, max rhs "
+                f"{int(np.argmax(norms))}): the small-norm column may "
+                "stagnate/quarantine near the precision floor of the "
+                "blocked solve — consider solving it separately"))
+        else:
+            results.append(CheckResult("rhs_block_spread", "ok"))
     return results
 
 

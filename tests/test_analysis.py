@@ -348,13 +348,72 @@ def test_rule_catalog_complete():
     rules = {r.id: r for r in engine.list_rules()}
     expected = {"collective-budget", "hot-loop-purity", "dtype-discipline",
                 "donation-integrity", "fingerprint-completeness",
-                "recovery-paths", "telemetry-schema"}
+                "recovery-paths", "recovery-coverage", "telemetry-schema"}
     assert expected <= set(rules)
     assert len(expected) >= 5
     # the pre-hardware-window gate covers the structural claims
     assert rules["collective-budget"].fast
     assert rules["recovery-paths"].fast
+    assert rules["recovery-coverage"].fast
     assert not rules["fingerprint-completeness"].fast
+
+
+# ----------------------------------------------------------------------
+# recovery-coverage (ISSUE 9): dispatch surfaces wrapped or exempted
+# ----------------------------------------------------------------------
+
+def test_recovery_coverage_clean_on_real_tree():
+    from pcg_mpi_solver_tpu.analysis.rules_ast import (
+        recovery_coverage_rule)
+
+    assert recovery_coverage_rule(None) == []
+
+
+def test_recovery_coverage_seeded_violations():
+    """Every failure class fires on seeded sources: an unregistered
+    Krylov dispatch surface, a registered surface that dropped its
+    harness call, an exempt surface without the documented marker, and
+    a stale registry entry."""
+    from pcg_mpi_solver_tpu.analysis.rules_ast import (
+        check_recovery_coverage)
+
+    rel = "pcg_mpi_solver_tpu/solver/driver.py"
+
+    # (1) unregistered surface: a new method opening a terminal span
+    src = (
+        "class Solver:\n"
+        "    def step(self):\n"
+        "        # recovery-exempt: test stub\n"
+        "        self._step_fn()\n"
+        "    def _step_chunked(self):\n"
+        "        run_with_recovery()\n"
+        "    def _solve_many_chunked(self):\n"
+        "        run_many_with_recovery()\n"
+        "    def solve_many(self):\n"
+        "        return self._dispatch_with_retry('solve_many', f)\n"
+        "    def sneaky_new_path(self):\n"
+        "        with self._rec.dispatch('many_cycle'):\n"
+        "            pass\n")
+    errs = check_recovery_coverage({rel: src})
+    assert any("sneaky_new_path" in e and "not registered" in e
+               for e in errs), errs
+    assert not any("_step_chunked" in e for e in errs)
+
+    # (2) registered surface that no longer calls its harness
+    src2 = src.replace("run_with_recovery()", "pass")
+    errs2 = check_recovery_coverage({rel: src2})
+    assert any("_step_chunked" in e and "run_with_recovery" in e
+               for e in errs2), errs2
+
+    # (3) exempt surface without the documented marker
+    src3 = src.replace("        # recovery-exempt: test stub\n", "")
+    errs3 = check_recovery_coverage({rel: src3})
+    assert any("`step`" in e and "recovery-exempt" in e
+               for e in errs3), errs3
+
+    # (4) stale registry entry: the registered function vanished
+    errs4 = check_recovery_coverage({rel: "x = 1\n"})
+    assert any("no such function" in e for e in errs4), errs4
 
 
 def test_baseline_suppression_and_undocumented_entry():

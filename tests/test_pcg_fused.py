@@ -313,3 +313,102 @@ def test_cross_variant_resume_rejected_by_fingerprint(model, tmp_path):
     s2 = Solver(model, cfg_c, mesh=make_mesh(1), n_parts=1)
     with pytest.raises(ValueError, match="pcg_variant"):
         s2.solve(resume=True)
+
+
+# ----------------------------------------------------------------------
+# Residual-drift guard (ISSUE 9 satellite, arXiv:2501.03743): the fused
+# deferred true-residual check counts disagreements with the recurrence
+# norm and exits recoverably (flag 6) on sustained drift.
+# ----------------------------------------------------------------------
+
+_DRIFT_SETUP = {}
+
+
+def _direct_pcg_setup(nx=5):
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+    from pcg_mpi_solver_tpu.parallel.partition import partition_model
+
+    if nx in _DRIFT_SETUP:
+        return _DRIFT_SETUP[nx]
+    m = make_cube_model(nx, 4, 4, h=0.5, nu=0.3, load="traction",
+                        heterogeneous=True)
+    pm = partition_model(m, 1)
+    data = device_data(pm, jnp.float64)
+    ops = Ops.from_model(pm, dot_dtype=jnp.float64)
+    eff = data["eff"]
+    fext = eff * data["F"]
+    d = eff * ops.diag(data)
+    inv = jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 0.0)
+    _DRIFT_SETUP[nx] = (m, ops, data, fext, inv)
+    return _DRIFT_SETUP[nx]
+
+
+def test_fused_drift_guard_exits_flag6_and_counts():
+    """Recurrence drift re-emerges after every self-correcting deferred
+    check (the check resets r to truth, but a drifting recurrence lies
+    again) — emulated by re-poisoning the carry residual before each
+    capped dispatch.  Each poisoned dispatch's check disagrees (>2x)
+    and counts into the resumable ``drift`` leaf; at FUSED_DRIFT_LIMIT
+    the solve exits with the recoverable DRIFT_FLAG instead of grinding
+    on the stale norm, and breakdown_trigger routes it to the ladder."""
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.resilience import breakdown_trigger
+    from pcg_mpi_solver_tpu.solver.pcg import (
+        DRIFT_FLAG, FUSED_DRIFT_LIMIT, pcg)
+
+    import jax
+
+    m, ops, data, fext, inv = _direct_pcg_setup()
+    kw = dict(tol=1e-8, max_iter=1, max_iter_nominal=200,
+              glob_n_dof_eff=int(np.asarray(m.dof_eff).sum()),
+              variant="fused", return_carry=True)
+    res, carry = pcg(ops, data, fext, jnp.zeros_like(fext), inv,
+                     **dict(kw, max_iter=5))
+    assert int(carry["drift"]) == 0, "healthy fused solve: no drift"
+    # one jitted resumable dispatch, re-run per poisoned carry (the
+    # shapes never change, so the loop pays one trace)
+    step = jax.jit(lambda c: pcg(ops, data, fext, jnp.zeros_like(fext),
+                                 inv, carry_in=c, **kw))
+    for k in range(FUSED_DRIFT_LIMIT):
+        # the recurrence claims convergence; the true residual disagrees
+        carry = dict(carry)
+        carry["r"] = carry["r"] * 1e-14
+        res, carry = step(carry)
+        assert int(carry["drift"]) == k + 1
+    assert int(res.flag) == DRIFT_FLAG
+    assert breakdown_trigger(int(res.flag), float(res.relres)) == "flag6"
+
+
+def test_fused_drift_guard_per_column():
+    """Blocked twin: only the column whose recurrence keeps lying exits
+    flag 6 and counts drift; the healthy column's state is untouched
+    (per-column drift isolation)."""
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.solver.pcg import (
+        DRIFT_FLAG, FUSED_DRIFT_LIMIT, pcg_many)
+
+    import jax
+
+    m, ops, data, fext1, inv = _direct_pcg_setup()
+    fb = jnp.stack([fext1, 0.5 * fext1], axis=-1)
+    kw = dict(tol=1e-8, max_iter=1, max_iter_nominal=200,
+              glob_n_dof_eff=int(np.asarray(m.dof_eff).sum()),
+              variant="fused", return_carry=True)
+    res, carry = pcg_many(ops, data, fb, jnp.zeros_like(fb), inv,
+                          **dict(kw, max_iter=5))
+    lie = jnp.asarray([1e-14, 1.0])
+    step = jax.jit(lambda c: pcg_many(ops, data, fb,
+                                      jnp.zeros_like(fb), inv,
+                                      carry_in=c, **kw))
+    for _ in range(FUSED_DRIFT_LIMIT):
+        carry = dict(carry)
+        carry["r"] = carry["r"] * lie[None, None, :]
+        res, carry = step(carry)
+    assert int(res.flag[0]) == DRIFT_FLAG
+    assert int(carry["drift"][0]) >= FUSED_DRIFT_LIMIT
+    assert int(res.flag[1]) != DRIFT_FLAG
+    assert int(carry["drift"][1]) == 0

@@ -32,8 +32,22 @@ from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
 from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
 from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
 from pcg_mpi_solver_tpu.parallel.partition import BUILD_CALLS
+from pcg_mpi_solver_tpu.resilience import FaultPlan, SimulatedKill
 from pcg_mpi_solver_tpu.solver.driver import Solver
 from pcg_mpi_solver_tpu.validate import PreflightError, check_rhs_block
+
+
+class _Cap:
+    """Metrics sink collecting events for assertions."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, ev):
+        self.events.append(ev)
+
+    def close(self):
+        pass
 
 
 def _cfg(*, mode="direct", tol=1e-8, ipd=-1, cache_dir="", snap=0,
@@ -250,8 +264,201 @@ def test_same_width_different_rhs_resume_rejected(model, tmp_path):
 
 
 # ----------------------------------------------------------------------
-# Warm path: zero partition builds, zero step re-traces (PR-2 contract)
+# Per-column resilience (ISSUE 9): recovery ladder, quarantine, fault
+# isolation between columns
 # ----------------------------------------------------------------------
+
+def _res_solver(model, tmp_path, *, variant="classic", maxrec=2, snap=0,
+                fault=None, cap=None, ipd=20, precond="jacobi"):
+    cfg = _cfg(ipd=ipd, snap=snap, variant=variant,
+               scratch=str(tmp_path))
+    cfg.solver.max_recoveries = maxrec
+    cfg.solver.precond = precond
+    rec = MetricsRecorder(sinks=[cap]) if cap is not None else None
+    s = Solver(model, cfg, mesh=make_mesh(2), n_parts=2,
+               backend="general", recorder=rec)
+    if fault:
+        s.fault_plan = FaultPlan(fault, recorder=s.recorder)
+    return s
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("PCG_TPU_RETRY_BACKOFF_S", "0.01")
+
+
+@pytest.mark.parametrize("variant", ["classic", "fused"])
+def test_chunked_column_fault_chaos_matrix(model, tmp_path, variant):
+    """Chaos matrix, chunked blocked path: each of {nan, inf, rho0}
+    injected into ONE column engages that column's recovery ladder
+    (restart from its min-residual iterate) while the block completes —
+    and under classic the HEALTHY column's solution and iteration count
+    are bit-identical to a fault-free block run (fault isolation).
+    With the ladder disabled the same poison QUARANTINES the column
+    (flag 5 + telemetry) and healthy-column isolation still holds.
+    One solver runs every leg: the fault plan and the recovery budget
+    are host-side state, so the compiled blocked programs are shared."""
+    from pcg_mpi_solver_tpu.obs.schema import validate_event
+
+    F = np.asarray(model.F)
+    fb = np.stack([F, _hard_load(model)], axis=-1)
+    # the bit-identity reference is only consumed by the classic legs
+    # (fused is documented non-bit-exact) — skip its solve under fused
+    ref = (_res_solver(model, tmp_path / "ref").solve_many(fb)
+           if variant == "classic" else None)
+    if ref is not None:
+        assert list(ref.flags) == [0, 0] and ref.recoveries == 0
+
+    cap = _Cap()
+    s = _res_solver(model, tmp_path / "run", variant=variant, cap=cap)
+    for mode in ("nan", "inf", "rho0"):
+        n0 = len(cap.events)
+        s.fault_plan = FaultPlan(f"{mode}@col:1", recorder=s.recorder)
+        res = s.solve_many(fb)
+        ev = cap.events[n0:]
+        assert list(res.flags) == [0, 0], \
+            f"{mode}: poisoned column must recover"
+        assert res.recoveries >= 1 and res.quarantined == ()
+        recs = [e for e in ev if e["kind"] == "recovery"]
+        assert recs and all(e["rhs"] == 1 for e in recs), \
+            "recovery events must name the poisoned column"
+        fired = [e for e in ev if e["kind"] == "fault"]
+        assert [(e["mode"], e["point"], e["at"]) for e in fired] == \
+            [(mode, "col", 1)]
+        if ref is not None:
+            np.testing.assert_array_equal(np.asarray(res.x)[..., 0],
+                                          np.asarray(ref.x)[..., 0])
+            assert int(res.iters[0]) == int(ref.iters[0])
+
+    # ladder disabled: quarantine isolation on the same programs
+    s.config.solver.max_recoveries = 0
+    n0 = len(cap.events)
+    s.fault_plan = FaultPlan("nan@col:1", recorder=s.recorder)
+    res = s.solve_many(fb)
+    ev = cap.events[n0:]
+    assert list(res.flags) == [0, 5] and res.quarantined == (1,)
+    assert np.isfinite(res.relres[1]), \
+        "a quarantined column must report its min-residual truth"
+    q = [e for e in ev if e["kind"] == "rhs_quarantine"]
+    assert len(q) == 1 and q[0]["rhs"] == 1 \
+        and q[0]["trigger"] == "nan_carry"
+    assert validate_event(q[0]) == []
+    rhs_ev = {e["rhs"]: e for e in ev if e["kind"] == "rhs_solve"}
+    assert rhs_ev[1]["quarantined"] and not rhs_ev[0]["quarantined"]
+    if ref is not None:
+        np.testing.assert_array_equal(np.asarray(res.x)[..., 0],
+                                      np.asarray(ref.x)[..., 0])
+
+
+def test_blocked_kill_and_resume_mid_recovery_bit_identical(model,
+                                                            tmp_path):
+    """Satellite 4(a): a blocked solve killed AFTER a per-column
+    recovery resumes bit-identically — the recovery state (per-column
+    flag, prec_sel) rides the snapshotted carry, so the resumed run
+    reproduces the uninterrupted faulted run exactly and re-runs no
+    ladder attempts."""
+    F = np.asarray(model.F)
+    fb = np.stack([F, _hard_load(model)], axis=-1)
+    ref = _res_solver(model, tmp_path / "ref", snap=1,
+                      fault="rho0@col:1").solve_many(fb)
+    assert list(ref.flags) == [0, 0] and ref.recoveries >= 1
+
+    s2 = _res_solver(model, tmp_path / "run", snap=1,
+                     fault="rho0@col:1, kill@2")
+    with pytest.raises(SimulatedKill):
+        s2.solve_many(fb)
+    assert glob.glob(os.path.join(s2.config.checkpoint_path,
+                                  "many_*.npz"))
+
+    cap = _Cap()
+    s3 = _res_solver(model, tmp_path / "run", snap=1, cap=cap)
+    res = s3.solve_many(fb, resume=True)
+    assert list(res.flags) == [0, 0]
+    # the recovery happened BEFORE the kill: the resumed run continues
+    # the post-restart Krylov space without consuming new attempts
+    assert res.recoveries == 0
+    np.testing.assert_array_equal(np.asarray(res.iters),
+                                  np.asarray(ref.iters))
+    np.testing.assert_array_equal(np.asarray(res.x), np.asarray(ref.x))
+
+
+def test_one_shot_retry_guard_and_unlandable_column_fault(model,
+                                                          tmp_path):
+    """One-shot blocked path (ipd=0), both legs on one solver: (a) an
+    injected device-loss exception before the dispatch is retried by
+    the guard (the program donates nothing, so re-dispatch is safe) and
+    the block completes; (b) column faults fire at blocked chunk
+    boundaries, of which the one-shot path has NONE — the plan stays
+    armed and NOT fired (a chaos drill must never read 'exercised' off
+    an injection that could not land), and the solve is untouched."""
+    cap = _Cap()
+    s = _res_solver(model, tmp_path, ipd=0, fault="exc@0", cap=cap)
+    F = np.asarray(model.F)
+    fb = np.stack([F, 0.5 * F], axis=-1)
+    res = s.solve_many(fb)
+    assert list(res.flags) == [0, 0]
+    recs = [e for e in cap.events if e["kind"] == "recovery"]
+    assert [e["action"] for e in recs] == ["redispatch"]
+    assert [f["mode"] for f in s.fault_plan.fired] == ["exc"]
+
+    s.fault_plan = FaultPlan("nan@col:1", recorder=s.recorder)
+    res = s.solve_many(fb)
+    assert list(res.flags) == [0, 0] and res.quarantined == ()
+    assert s.fault_plan.fired == [] and s.fault_plan.col_armed
+
+
+def test_many_snapshot_retention_and_latest(model, tmp_path,
+                                            monkeypatch):
+    """Satellite: PCG_TPU_SNAP_KEEP retention pruning and the
+    corrupt-tolerant latest() pointer are PREFIX-scoped, so they govern
+    the ``many_*`` namespace exactly like ``snap_*``/``step_*``."""
+    from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+    monkeypatch.setenv("PCG_TPU_SNAP_KEEP", "2")
+    s = _chunked_solver(model, tmp_path)
+    store = SnapshotStore.for_many_solver(s, 2, rhs_hash="h")
+    other = SnapshotStore.for_solver(s)     # snap_* neighbor namespace
+    other.save(7, {"kind": "direct", "total": np.int64(1)})
+    for t in (1, 2, 3, 4):
+        store.save(t, {"kind": "many", "total": np.int64(t)})
+    files = sorted(os.path.basename(p) for p in glob.glob(
+        os.path.join(store.path, "many_*.npz")))
+    assert files == ["many_000003.npz", "many_000004.npz"], \
+        "retention must prune the many_* namespace to the newest K"
+    # the neighbor namespace is untouched by many_* pruning
+    assert glob.glob(os.path.join(store.path, "snap_*.npz"))
+    assert store.latest() == 4
+    # corrupt newest -> latest() falls back to the next valid snapshot
+    with open(store._file(4), "wb") as f:
+        f.write(b"torn")
+    assert store.latest() == 3
+    assert store.load(4) is None    # corrupt reads as absent, loudly-ish
+
+
+def test_many_snapshot_fingerprint_tracks_fallback_wiring(model,
+                                                          tmp_path):
+    """A blocked carry whose ``prec_sel`` flipped a column to the
+    fallback preconditioner must never resume into programs compiled
+    WITHOUT the fallback operand (the selection would silently compile
+    out): the many-snapshot fingerprint records the wiring, so such a
+    resume mismatches loudly on ``many_fallback``."""
+    from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+    s = _res_solver(model, tmp_path, precond="block3", maxrec=2)
+    fp_on = SnapshotStore.for_many_solver(s, 2, rhs_hash="h").fingerprint
+    assert fp_on["many_fallback"] is True
+    s.config.solver.max_recoveries = 0      # ladder (and operand) off
+    fp_off = SnapshotStore.for_many_solver(s, 2,
+                                           rhs_hash="h").fingerprint
+    assert fp_off["many_fallback"] is False
+    # the mismatch names the field (same posture as nrhs/rhs_hash)
+    store_on = SnapshotStore(s.config.checkpoint_path, fp_on,
+                             prefix="many")
+    store_on.save(1, {"kind": "many", "total": np.int64(0)})
+    store_off = SnapshotStore(s.config.checkpoint_path, fp_off,
+                              prefix="many")
+    with pytest.raises(ValueError, match="many_fallback"):
+        store_off.load(1)
 
 @pytest.fixture
 def cache_dir(tmp_path):
@@ -404,6 +611,51 @@ def test_cli_solve_many(tmp_path, capsys):
           "--n-parts", "2", "--tol", "1e-8", "--precision", "direct"])
     out = capsys.readouterr().out
     assert ">rhs 1: flag=0" in out and ">success!" in out
+
+
+def test_cli_solve_many_max_recoveries_bites(tmp_path, capsys,
+                                             monkeypatch):
+    """Satellite: --max-recoveries now rides blocked solves for REAL —
+    with the ladder on, an injected per-column fault recovers to flag 0;
+    with --max-recoveries 0 the same fault quarantines the column (flag
+    5) — and the old '--max-recoveries does not yet apply' warning is
+    gone."""
+    import json
+
+    from pcg_mpi_solver_tpu.cli import main
+    from pcg_mpi_solver_tpu.models.mdf import write_mdf
+
+    model = make_cube_model(4, 3, 3, load="traction", heterogeneous=True)
+    src = tmp_path / "src"
+    write_mdf(model, str(src))
+    archive = shutil.make_archive(str(tmp_path / "cube"), "zip", src)
+    scratch = str(tmp_path / "scratch")
+    main(["ingest", archive, scratch])
+    capsys.readouterr()
+
+    # force the chunked/resumable blocked path below the auto-engage
+    # size (settings-only override), so boundary faults can land
+    settings = str(tmp_path / "settings.json")
+    with open(settings, "w") as f:
+        json.dump({"SolverParam": {"ItersPerDispatch": 20}}, f)
+    monkeypatch.setenv("PCG_TPU_FAULTS", "rho0@col:1")
+    monkeypatch.setenv("PCG_TPU_RETRY_BACKOFF_S", "0.01")
+
+    common = ["solve-many", scratch, "--scales", "1.0,0.5",
+              "--n-parts", "2", "--tol", "1e-8", "--precision",
+              "direct", "--settings", settings]
+    main([common[0], common[1], "r1"] + common[2:]
+         + ["--max-recoveries", "2"])
+    out = capsys.readouterr().out
+    assert "does not yet apply" not in out
+    assert ">rhs 1: flag=0" in out
+    assert ">recoveries: 1" in out
+
+    main([common[0], common[1], "r2"] + common[2:]
+         + ["--max-recoveries", "0"])
+    out = capsys.readouterr().out
+    assert ">rhs 1: flag=5" in out and "[QUARANTINED]" in out
+    assert ">quarantined columns: [1]" in out
 
 
 # ----------------------------------------------------------------------

@@ -118,6 +118,82 @@ def test_faultplan_boundary_poison_and_kill():
     assert np.all(np.isfinite(np.asarray(out["r"])))
 
 
+def test_faultplan_column_domain():
+    """Column-domain faults (``mode@col:k``, ISSUE 9): fire only at
+    BLOCKED boundaries, poison only column k (other columns bitwise
+    untouched — the fault-isolation tests depend on it), and consume
+    their counts; kill/exc have no column form."""
+    import jax.numpy as jnp
+
+    blocked = {"r": jnp.asarray([[[1.0, 2.0, 3.0],
+                                  [0.0, 4.0, -1.0]]]),
+               "rho": jnp.asarray([1.0, 2.0, 3.0])}
+    p = FaultPlan("nan@col:1, rho0@col:2")
+    assert p.armed and p.col_armed
+    # non-blocked boundaries never fire column faults
+    out = p.at_boundary(dict(blocked))
+    assert p.fired == [] and p.col_armed
+    out = p.at_boundary(dict(blocked), blocked=True)
+    r = np.asarray(out["r"])
+    assert np.isnan(r[..., 1]).all()
+    np.testing.assert_array_equal(r[..., 0],
+                                  np.asarray(blocked["r"])[..., 0])
+    np.testing.assert_array_equal(r[..., 2],
+                                  np.asarray(blocked["r"])[..., 2])
+    rho = np.asarray(out["rho"])
+    assert rho[2] == 0.0 and rho[0] == 1.0 and rho[1] == 2.0
+    assert sorted((f["mode"], f["point"], f["at"]) for f in p.fired) == \
+        [("nan", "col", 1), ("rho0", "col", 2)]
+    assert not p.col_armed      # counts consumed: later boundaries clean
+    out2 = p.at_boundary(dict(blocked), blocked=True)
+    assert np.all(np.isfinite(np.asarray(out2["r"])))
+
+    # inf lands only on the column's NONZERO entries (constrained dofs
+    # stay exactly 0, same contract as the whole-carry poisoner)
+    p3 = FaultPlan("inf@col:2")
+    out3 = p3.at_boundary(dict(blocked), blocked=True)
+    r3 = np.asarray(out3["r"])
+    assert np.isinf(r3[0, 1, 2]) and r3[0, 0, 2] == np.inf
+    np.testing.assert_array_equal(r3[..., 0],
+                                  np.asarray(blocked["r"])[..., 0])
+
+    # ``*count`` re-fires on consecutive blocked boundaries
+    p4 = FaultPlan("nan@col:0*2")
+    p4.at_boundary(dict(blocked), blocked=True)
+    p4.at_boundary(dict(blocked), blocked=True)
+    assert len(p4.fired) == 2 and not p4.col_armed
+
+    # an out-of-range column cannot land: neither consumed nor fired
+    # (same contract as the absent-leaf case)
+    p5 = FaultPlan("nan@col:7")
+    out5 = p5.at_boundary(dict(blocked), blocked=True)
+    assert p5.fired == [] and p5.col_armed
+    assert np.all(np.isfinite(np.asarray(out5["r"])))
+
+    with pytest.raises(ValueError, match="column-domain"):
+        FaultPlan("kill@col:1")
+    with pytest.raises(ValueError, match="column-domain"):
+        FaultPlan("exc@col:0")
+
+
+def test_column_trigger_classification():
+    """Per-column ladder triggers (resilience/recovery.column_trigger):
+    flags 2/4 and the fused drift flag 6 are breakdown triggers, a
+    still-running column with a non-finite carry norm is nan_carry, and
+    converged/budget/stagnation/quarantined columns trigger nothing."""
+    from pcg_mpi_solver_tpu.resilience import column_trigger
+
+    assert column_trigger(2, 1.0) == "flag2"
+    assert column_trigger(4, 1.0) == "flag4"
+    assert column_trigger(6, 1.0) == "flag6"
+    assert column_trigger(1, float("nan")) == "nan_carry"
+    assert column_trigger(1, float("inf")) == "nan_carry"
+    assert column_trigger(1, 0.5) is None
+    assert column_trigger(0, 1.0) is None
+    assert column_trigger(3, 1.0) is None
+    assert column_trigger(5, float("nan")) is None    # already terminal
+
+
 def test_device_loss_classification():
     assert is_device_loss(InjectedDispatchError("x"))
     assert is_device_loss(RuntimeError("rpc failed: UNAVAILABLE: socket"))

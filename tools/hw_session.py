@@ -31,6 +31,10 @@ Presets:
                             (BENCH_NRHS; detail.dof_iter_rhs_per_s)
   4. Pallas v9 A/B        — first-ever hardware execution of the kernel
                             family (the hw_v9_ab.py step)
+  Step 0.5 (between lint and the flagship) is the blocked-resilience
+  smoke: a tiny solve_many with an injected per-column fault, proving
+  the ISSUE-9 per-column recovery ladder + fault isolation live on the
+  accelerator for seconds of window time.
   Steps 2-4 reuse step 1's warm caches (shared BENCH_CACHE_DIR), so a
   window that dies mid-queue still leaves each completed step's salvage
   line.
@@ -46,6 +50,40 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Blocked-resilience smoke (priority preset step 0.5): tiny solve_many
+# with a per-column NaN fault injected at the first blocked chunk
+# boundary.  Asserts the poisoned column RECOVERS (per-column ladder)
+# and the healthy column matches a fault-free block bit-identically —
+# the ISSUE-9 fault-isolation contract, proven live on the accelerator.
+_MANY_SMOKE = """
+import numpy as np
+from pcg_mpi_solver_tpu.config import RunConfig, SolverConfig, \
+    TimeHistoryConfig
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+from pcg_mpi_solver_tpu.resilience import FaultPlan
+from pcg_mpi_solver_tpu.solver.driver import Solver
+
+m = make_cube_model(6, 5, 5, heterogeneous=True)
+def mk():
+    cfg = RunConfig(solver=SolverConfig(
+        tol=1e-8, max_iter=2000, iters_per_dispatch=25,
+        max_recoveries=2))
+    cfg.time_history = TimeHistoryConfig(time_step_delta=[0.0, 1.0])
+    return Solver(m, cfg, backend="general")
+F = np.asarray(m.F)
+fb = np.stack([F, 0.5 * F], axis=-1)
+ref = mk().solve_many(fb)
+s = mk()
+s.fault_plan = FaultPlan("nan@col:1", recorder=s.recorder)
+res = s.solve_many(fb)
+assert list(res.flags) == [0, 0], (res.flags, res.quarantined)
+assert res.recoveries >= 1, "column fault never engaged the ladder"
+np.testing.assert_array_equal(np.asarray(res.x)[..., 0],
+                              np.asarray(ref.x)[..., 0])
+print("blocked-resilience smoke OK: poisoned column recovered "
+      f"(recoveries={res.recoveries}), healthy column bit-identical")
+"""
 
 
 def log_line(path, msg):
@@ -178,6 +216,14 @@ def run_priority_queue(path, quick: bool):
                        "priority queue before any hardware step (fix the "
                        "invariant or baseline it, then relaunch)")
         return
+    # Step 0.5: blocked-resilience smoke (ISSUE 9) — a tiny solve_many
+    # with an injected per-column fault, ON THE ACCELERATOR: proves the
+    # per-column recovery ladder + fault isolation live (tier-1 only
+    # ever runs it on CPU) for ~seconds of window time.  The healthy
+    # column must match a fault-free run bit-identically and the
+    # poisoned column must recover (flag 0 after a ladder restart).
+    run_step(path, "blocked-resilience smoke", ["-c", _MANY_SMOKE],
+             env_extra={"PCG_TPU_RETRY_BACKOFF_S": "0.01"}, timeout=900)
     # BENCH_NX exported unconditionally so the flagship size is pinned
     # HERE, not silently inherited from bench.py's default
     cache = {"BENCH_CACHE_DIR": os.path.join(REPO, ".pcg_cache")}
