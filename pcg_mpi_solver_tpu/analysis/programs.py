@@ -89,12 +89,13 @@ _MODEL_CACHE: dict = {}
 _MATRIX_CACHE: Dict[bool, List[Program]] = {}
 
 
-def _model(backend: str):
+def _model(backend: str, nx: int = 0):
     """Small synthetic cube per backend: the structured slab path needs
-    grid[0] divisible by n_parts (driver.py can_structured)."""
+    grid[0] divisible by n_parts (driver.py can_structured); mg
+    programs need even dims (one 2:1 coarsening) and pass ``nx=4``."""
     from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
 
-    nx = 4 if backend == "structured" else 3
+    nx = nx or (4 if backend == "structured" else 3)
     if (backend, nx) not in _MODEL_CACHE:
         _MODEL_CACHE[(backend, nx)] = make_cube_model(nx, nx, nx)
     return _MODEL_CACHE[(backend, nx)]
@@ -112,16 +113,18 @@ def _mesh2():
     return make_mesh(2)
 
 
-def build_solver(backend: str = "general", **solver_overrides):
+def build_solver(backend: str = "general", nx: int = 0,
+                 **solver_overrides):
     """A real quasi-static Solver on the 2-device mesh.  One-shot
     dispatch (iters_per_dispatch=0) unless overridden, so ``_step_fn``
-    is the single canonical program."""
+    is the single canonical program.  ``nx`` overrides the model size
+    (mg programs need an even, coarsenable lattice)."""
     from pcg_mpi_solver_tpu.solver.driver import Solver
 
     kw = dict(iters_per_dispatch=0)
     kw.update(solver_overrides)
     cfg = RunConfig(solver=SolverConfig(**kw))
-    return Solver(_model(backend), cfg, mesh=_mesh2(), n_parts=2,
+    return Solver(_model(backend, nx), cfg, mesh=_mesh2(), n_parts=2,
                   backend=backend)
 
 
@@ -184,6 +187,27 @@ def build_programs(fast: bool = False) -> List[Program]:
             jaxpr=step_jaxpr(s32),
             collective_budget=s32.ops.body_collective_budget("classic"),
             n_iface=int(s32.ops.n_iface)))
+    if not fast:
+        # MG-preconditioned programs (ISSUE 10): both variants x nrhs
+        # {1, 8} on the general backend (the acceptance matrix — psum
+        # budget gains 2*degree matvec assemblies + the restriction),
+        # plus classic x {1, 8} on structured (ppermute accounting:
+        # halo count x fine matvecs).  --fast stays general+jacobi
+        # only: the mg traces add seconds the pre-window gate spends
+        # elsewhere.
+        mg_matrix = ([("general", v) for v in ("classic", "fused")]
+                     + [("structured", "classic")])
+        for backend, variant in mg_matrix:
+            s = build_solver(backend, nx=4, precond="mg",
+                             pcg_variant=variant)
+            budget = s.ops.body_collective_budget(variant, precond="mg")
+            for nrhs in (1, 8):
+                jx = step_jaxpr(s) if nrhs == 1 else many_jaxpr(s, nrhs)
+                out.append(Program(
+                    name=f"step[{backend},{variant},mg,nrhs={nrhs},f64]",
+                    backend=backend, variant=variant, nrhs=nrhs,
+                    role="f64", jaxpr=jx, collective_budget=budget,
+                    n_iface=int(s.ops.n_iface)))
     _MATRIX_CACHE[fast] = out
     return out
 

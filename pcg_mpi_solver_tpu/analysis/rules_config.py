@@ -112,6 +112,7 @@ def _key_digest(scfg: SolverConfig, key_fn) -> str:
         abstract="<sig>", mesh=[["parts", 2], "cpu"], backend="general",
         solver=dataclasses.asdict(scfg),
         pcg_variant=scfg.pcg_variant,
+        precond=getattr(scfg, "precond", "jacobi"),
         nrhs=int(getattr(scfg, "nrhs", 1)),
         trace_len=0, glob_n_dof_eff=100,
         donate=bool(scfg.donate_carry),
@@ -121,19 +122,22 @@ def _key_digest(scfg: SolverConfig, key_fn) -> str:
 def check_structural_key_components(key_fn=None) -> List[Finding]:
     """The documented STRUCTURAL key components must move the digest on
     their own (they exist so the key survives a solver-dict/signature
-    serialization refactor): pcg_variant, nrhs, trace_len, donate."""
+    serialization refactor): pcg_variant, precond, nrhs, trace_len,
+    donate."""
     key_fn = key_fn or _default_key_fn()
 
     def k(**over):
         kw = dict(abstract="a", mesh="m", backend="b", solver={},
                   trace_len=0, glob_n_dof_eff=1, donate=True,
-                  jax_version="j", pcg_variant="classic", nrhs=1)
+                  jax_version="j", pcg_variant="classic",
+                  precond="jacobi", nrhs=1)
         kw.update(over)
         return key_fn(**kw)
 
     base = k()
     out = []
     for name, over in (("pcg_variant", {"pcg_variant": "fused"}),
+                       ("precond", {"precond": "mg"}),
                        ("nrhs", {"nrhs": 8}),
                        ("trace_len", {"trace_len": 16}),
                        ("donate", {"donate": False})):

@@ -85,7 +85,10 @@ timed leg solves an nrhs-wide block of the reference load via
 Solver.solve_many and the line carries detail.nrhs +
 detail.dof_iter_rhs_per_s — the nrhs ∈ {1, 4, 16} A/B for a hardware
 window), BENCH_PLATEAU (mixed-mode inner
-plateau-exit window, 0=off), BENCH_PCG_VARIANT (classic|fused PCG loop
+plateau-exit window, 0=off), BENCH_PRECOND (jacobi|block3|mg — the
+ISSUE-10 preconditioner A/B; detail.precond + detail.time_to_tol_s /
+detail.iters make it a time-to-solution comparison),
+BENCH_PCG_VARIANT (classic|fused PCG loop
 formulation — the classic-vs-fused ms/iteration A/B knob; the engaged
 variant is reported in detail.pcg_variant); plus the solver-level performance knobs
 PCG_TPU_MATVEC_FORM / PCG_TPU_PALLAS_V / PCG_TPU_PALLAS_PLANES /
@@ -389,6 +392,12 @@ def _run_config_extra(solver, dtype, mode, pallas_on, n_parts, t_part,
         "pcg_variant": getattr(
             getattr(getattr(solver, "config", None), "solver", None),
             "pcg_variant", "classic"),
+        # jacobi-vs-mg A/B field (BENCH_PRECOND): the engaged
+        # preconditioner, so time_to_tol_s / iters read as a
+        # time-to-solution A/B across rounds (ROADMAP item 4)
+        "precond": getattr(
+            getattr(getattr(solver, "config", None), "solver", None),
+            "precond", "jacobi"),
         "pallas": bool(pallas_on),
         # ops without a form attribute (general backend) never read the
         # form knob; the stencil ops PIN it at construction
@@ -506,6 +515,12 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
                             # (Solver.solve_many)
                             nrhs=int(os.environ.get("BENCH_NRHS", "1")
                                      or 1),
+                            # jacobi|block3|mg preconditioner A/B knob
+                            # (mg = the ISSUE-10 geometric V-cycle:
+                            # time_to_tol_s is the number to read)
+                            precond=(os.environ.get("BENCH_PRECOND",
+                                                    "jacobi")
+                                     or "jacobi"),
                             mixed_plateau_window=int(
                                 os.environ.get("BENCH_PLATEAU", 0)),
                             **solver_kw),

@@ -121,6 +121,7 @@ def step_cache_key(*, abstract: Any, mesh: Any, backend: str,
                    glob_n_dof_eff: int, donate: bool,
                    jax_version: str,
                    pcg_variant: str = "classic",
+                   precond: str = "jacobi",
                    nrhs: int = 1,
                    extra: Optional[Dict[str, Any]] = None) -> str:
     """Key for one AOT-exported PCG step program: the ABSTRACT signature
@@ -137,7 +138,12 @@ def step_cache_key(*, abstract: Any, mesh: Any, backend: str,
     blocked body's carry pytree and every vector shape differ per block
     width, so programs of different nrhs must never collide (the
     abstract signature already separates them — the explicit key field
-    makes the invariant survive any signature-repr change)."""
+    makes the invariant survive any signature-repr change).
+    ``precond`` is the same kind of structural component (ISSUE 10):
+    the mg V-cycle reshapes the loop body's preconditioner apply and
+    its operand pytree, so jacobi/block3/mg programs must never collide
+    even if the solver dict's serialization changes; the MG-shape knobs
+    (levels/degree/dims) ride ``extra["mg"]`` from the driver."""
     return _digest({
         "kind": "aot-step",
         "abstract": abstract,
@@ -145,6 +151,7 @@ def step_cache_key(*, abstract: Any, mesh: Any, backend: str,
         "backend": backend,
         "solver": solver,
         "pcg_variant": str(pcg_variant),
+        "precond": str(precond),
         "nrhs": int(nrhs),
         "trace_len": int(trace_len),
         "glob_n_dof_eff": int(glob_n_dof_eff),

@@ -626,10 +626,13 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
-    p.add_argument("--precond", choices=["jacobi", "block3"], default=None,
-                   help="preconditioner: scalar Jacobi (reference parity) "
-                        "or 3x3 node-block Jacobi (stronger on "
-                        "heterogeneous elasticity)")
+    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None,
+                   help="preconditioner: scalar Jacobi (reference "
+                        "parity), 3x3 node-block Jacobi (stronger on "
+                        "heterogeneous elasticity), or mg — geometric "
+                        "multigrid V-cycle on the lattice hierarchy "
+                        "(>=5x fewer iterations on lattice models; "
+                        "docs/RUNBOOK.md 'Choosing a preconditioner')")
     _add_variant_flag(p)
     p.add_argument("--speed-test", action="store_true",
                    help="disable all exports for clean timing "
@@ -669,7 +672,7 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
-    p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None)
     _add_variant_flag(p)
     p.add_argument("--backend",
                    choices=["auto", "structured", "hybrid", "general"],
@@ -740,7 +743,7 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
-    p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None)
     _add_variant_flag(p)
     p.add_argument("--backend", choices=["auto", "hybrid", "general"],
                    default="auto")
@@ -765,7 +768,7 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default="mixed")
-    p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None)
     _add_variant_flag(p)
     p.add_argument("--octree", action="store_true",
                    help="graded octree model with transition pattern types "
@@ -795,7 +798,7 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
-    p.add_argument("--precond", choices=["jacobi", "block3"], default=None)
+    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None)
     _add_variant_flag(p)
     p.add_argument("--backend",
                    choices=["auto", "structured", "hybrid", "general"],

@@ -94,10 +94,33 @@ class SolverConfig:
     # passed to Solver.solve_many always defines the executed width.
     nrhs: int = 1
     # Preconditioner: "jacobi" (scalar diag(K)^-1 — the reference's only
-    # choice, pcg_solver.py:346-352) or "block3" (assembled 3x3 node-block
+    # choice, pcg_solver.py:346-352), "block3" (assembled 3x3 node-block
     # Jacobi, inverted per node — stronger on vector-valued elasticity;
-    # beyond-reference, BASELINE.json config 4 "block-Jacobi").
+    # beyond-reference, BASELINE.json config 4 "block-Jacobi"), or "mg"
+    # (matrix-free geometric multigrid V-cycle on the octree/structured
+    # level lattice, ops/mg.py — a FIXED symmetric PSD operator, so
+    # plain CG stays valid: fixed-degree Chebyshev–Jacobi smoothing with
+    # setup-time eigenvalue bounds, replicated collective-free coarse
+    # levels, one restriction psum per cycle.  Cuts iteration counts
+    # >=5x on the lattice models at the cost of 2*mg_smooth_degree
+    # assembled matvecs per iteration; needs lattice metadata
+    # (ModelData.grid or .octree with 2:1-coarsenable even dims —
+    # preflight-checked) and the general or structured backend.  The
+    # recovery ladder demotes a broken mg hierarchy to scalar Jacobi
+    # instead of failing (docs/RUNBOOK.md "Choosing a preconditioner").
+    # CLI: --precond; bench: BENCH_PRECOND.)
     precond: str = "jacobi"
+    # MG V-cycle shape knobs (precond="mg" only; both are STRUCTURAL —
+    # they reshape the traced cycle, so they key the AOT step cache and
+    # the snapshot fingerprint via the mg_shape component):
+    #   mg_levels        — coarse levels below the fine lattice; 0 =
+    #                      auto (halve while every dim stays even, down
+    #                      to a few cells per dim).
+    #   mg_smooth_degree — Chebyshev smoothing degree per level; the
+    #                      fine level pays 2*degree assembled matvecs
+    #                      per V-cycle (ops/matvec.precond_cycle_cost).
+    mg_levels: int = 0
+    mg_smooth_degree: int = 2
     # Split the solve into several device dispatches of at most this many
     # Krylov iterations each (-1 = auto: engage on large problems, sized so
     # one dispatch stays well under a minute; 0 = single dispatch).  Long

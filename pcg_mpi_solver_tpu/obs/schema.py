@@ -87,6 +87,11 @@ EVENT_KINDS: Dict[str, tuple] = {
     # solve (`drift` = count; blocked solves add per-column `cols`) —
     # sustained drift also routes into the ladder as flag 6
     "resid_drift": ("drift",),
+    # one MG-preconditioner setup (ops/mg.py, precond="mg"): hierarchy
+    # shape (levels/degree/dims), the estimated per-level Chebyshev
+    # bounds, whether the fine bound came from the partition cache, and
+    # the setup wall — the cost side of the iteration-count win
+    "mg_setup": ("levels", "degree", "wall_s"),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }
@@ -103,9 +108,15 @@ BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 # salvage) report nrhs=1 with the configured sweep width preserved under
 # ``nrhs_planned`` — a line must never fabricate batched throughput that
 # was not run.
+#  ``time_to_tol_s`` (ROADMAP item 4) is the time-to-solution signal of
+#  a leg: wall to CONVERGED-at-tol, null when the solve did not reach
+#  tol — with ``iters`` it makes a preconditioner A/B (BENCH_PRECOND)
+#  read as time-to-solution, not just dof*iter/s.  Both are emitted on
+#  every leg, insurance/salvage lines included.
 BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "nrhs_planned", "dof_iter_rhs_per_s",
-                        "nrhs_quarantined", "nrhs_recoveries")
+                        "nrhs_quarantined", "nrhs_recoveries",
+                        "time_to_tol_s", "iters")
 # ``setup_cache``: warm-path partition attribution (cache/ subsystem).
 BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
 

@@ -64,6 +64,38 @@ PCG_SCALAR_PSUMS = {"classic": 3, "fused": 1}
 # from the per-iteration gauges.
 PCG_DEFERRED_CHECK_PSUMS = 1
 
+# ---------------------------------------------------------------------------
+# Declared per-APPLY collective contract of the preconditioners
+# (SolverConfig.precond), the same one-table discipline as
+# PCG_SCALAR_PSUMS above: consumed by the telemetry gauges
+# (Ops.comm_estimate), the static proof (analysis/ collective-budget
+# rule via Ops.body_collective_budget), and docs.
+#
+# * jacobi / block3 — elementwise / small-matmul applies: zero
+#   collectives of their own.
+# * mg — one geometric V-cycle (ops/mg.py): 2*degree assembled
+#   fine-level matvecs (degree-d Chebyshev pre-smoothing from zero =
+#   d-1, the defect = 1, post-smoothing = d), each carrying exactly the
+#   matvec's own interface collective, plus MG_RESTRICT_PSUMS to
+#   assemble the restricted defect into the replicated coarse
+#   hierarchy.  The smoother itself contributes ZERO collectives (fixed
+#   Chebyshev polynomial, eigenvalue bounds precomputed at setup; the
+#   whole coarse hierarchy is replicated) — every collective in the
+#   cycle is matvec assembly or THE restriction.
+#
+# An unknown precond is a KeyError in both the gauges and the budget —
+# the lint fails loudly instead of silently under-declaring.
+MG_RESTRICT_PSUMS = 1
+PRECOND_CYCLE_MATVECS = {"jacobi": 0, "block3": 0}
+
+
+def precond_cycle_cost(precond: str, mg_degree: int = 2):
+    """(extra assembled matvecs, extra standalone psums) per
+    preconditioner APPLY.  Unknown precond = loud KeyError."""
+    if precond == "mg":
+        return 2 * int(mg_degree), MG_RESTRICT_PSUMS
+    return PRECOND_CYCLE_MATVECS[precond], 0
+
 
 def device_data(pm: PartitionedModel, dtype=jnp.float64,
                 flat: Optional[bool] = None, blocks: bool = True) -> dict:
@@ -158,6 +190,16 @@ class Ops:
     # residual far above tol; HIGHEST is fp32-true (6-pass bf16) and still
     # rides the MXU.
     precision: jax.lax.Precision = jax.lax.Precision.HIGHEST
+    # Chebyshev smoothing degree of the MG V-cycle preconditioner
+    # (SolverConfig.mg_smooth_degree, pinned here at solver construction
+    # because it shapes the traced cycle: 2*degree fine matvecs per
+    # apply — precond_cycle_cost above).  Unused unless the prec operand
+    # is the mg dict (ops/mg.py).
+    mg_degree: int = 2
+    # Replicated first-coarse vector length (ops/mg.coarse_dofs — the
+    # restriction psum's payload), pinned alongside mg_degree so
+    # comm_estimate can report the V-cycle's full psum traffic.
+    mg_coarse_dofs: int = 0
 
     @classmethod
     def from_model(cls, pm: PartitionedModel, dot_dtype=jnp.float64, axis_name=None,
@@ -474,11 +516,18 @@ class Ops:
         return invert_node_blocks(self.node_block_diag(data),
                                   self._as_node3(data["eff"]))
 
-    def apply_prec(self, m: jnp.ndarray, r: jnp.ndarray) -> jnp.ndarray:
+    def apply_prec(self, m, r: jnp.ndarray, data: dict = None) -> jnp.ndarray:
         """z = M^-1 r: elementwise for the scalar Jacobi inverse (ndim 2),
-        batched 3x3 block multiply for the block-Jacobi inverse (ndim 4);
-        backend dof layouts differ only through _as_node3/_from_node3.
+        batched 3x3 block multiply for the block-Jacobi inverse (ndim 4),
+        or one geometric multigrid V-cycle when ``m`` is the mg prec
+        dict (ops/mg.py — then ``data`` must be the device data tree the
+        hierarchy rides, which every PCG body has in scope); backend dof
+        layouts differ only through _as_node3/_from_node3.
         ``r`` may carry a trailing RHS-block axis (P, n_loc, nrhs)."""
+        if isinstance(m, dict):
+            from pcg_mpi_solver_tpu.ops.mg import mg_apply
+
+            return mg_apply(self, data, m, r)
         blocked = r.ndim == 3
         if m.ndim == 2:
             return m[..., None] * r if blocked else m * r
@@ -510,7 +559,8 @@ class Ops:
         return self.iface_assemble(data, self.matvec_local(data, x))
 
     def comm_estimate(self, storage_dtype=None,
-                      variant: str = "classic") -> dict:
+                      variant: str = "classic",
+                      precond: str = "jacobi") -> dict:
         """Static per-PCG-iteration collective estimate from the ops
         shapes, for the telemetry gauges (obs/metrics.py).  ``variant``
         is the PCG loop formulation (SolverConfig.pcg_variant): classic
@@ -533,15 +583,30 @@ class Ops:
         dot_bytes = jnp.dtype(self.dot_dtype).itemsize
         n_iface = int(self.n_iface)
         scalar_psums = PCG_SCALAR_PSUMS[variant]
+        # preconditioner-apply collectives (precond_cycle_cost — the mg
+        # V-cycle's fine matvec assemblies + restriction psum; jacobi/
+        # block3 add zero): same table the collective-budget rule
+        # proves.  The restriction psum's payload is the replicated
+        # first-coarse vector (mg_coarse_dofs, pinned at construction)
+        # — the largest single collective payload of the cycle, so the
+        # bytes estimate must carry it.
+        mv_extra, ps_extra = precond_cycle_cost(precond, self.mg_degree)
         return {
             "pcg_variant": variant,
-            "psums_per_iter": scalar_psums + (1 if n_iface else 0),
+            "precond": precond,
+            "psums_per_iter": (scalar_psums
+                               + ((1 + mv_extra) if n_iface else 0)
+                               + ps_extra),
             "iface_dofs": n_iface,
             "reduce_scalars_per_iter": 6,
-            "bytes_per_iter_est": n_iface * itemsize + 6 * dot_bytes,
+            "bytes_per_iter_est": (n_iface * itemsize * (1 + mv_extra)
+                                   + ps_extra * int(self.mg_coarse_dofs)
+                                   * itemsize
+                                   + 6 * dot_bytes),
         }
 
-    def body_collective_budget(self, variant: str = "classic") -> dict:
+    def body_collective_budget(self, variant: str = "classic",
+                               precond: str = "jacobi") -> dict:
         """Per-primitive collective budget of the TRACED PCG while-loop
         body, the contract the analysis/ collective-budget rule proves
         against every canonical program's jaxpr (and the single source
@@ -551,10 +616,21 @@ class Ops:
         check contributes ``PCG_DEFERRED_CHECK_PSUMS`` extra norm
         psum(s) that a healthy (mode-0) trip never executes.  Keyed per
         primitive so a re-serialized reduction OR a new collective kind
-        sneaking into the hot body both fail the lint."""
-        return {"psum": (PCG_SCALAR_PSUMS[variant]
-                         + (1 if int(self.n_iface) else 0)
-                         + PCG_DEFERRED_CHECK_PSUMS)}
+        sneaking into the hot body both fail the lint.
+
+        ``precond`` extends the budget with the preconditioner apply's
+        declared collectives (``precond_cycle_cost``): the mg V-cycle
+        adds ``2*mg_degree`` assembled fine matvecs (each one interface
+        psum when the partition has shared dofs) plus the restriction
+        psum; the smoother itself contributes zero.  Unknown precond =
+        loud KeyError."""
+        mv_extra, ps_extra = precond_cycle_cost(precond, self.mg_degree)
+        psums = PCG_SCALAR_PSUMS[variant] + PCG_DEFERRED_CHECK_PSUMS
+        if int(self.n_iface):
+            psums += 1 + mv_extra
+        if self.axis_name is not None:
+            psums += ps_extra
+        return {"psum": psums}
 
     def diag(self, data: dict) -> jnp.ndarray:
         return self.iface_assemble(data, self.diag_local(data))

@@ -1,0 +1,712 @@
+"""Matrix-free geometric multigrid V-cycle preconditioner (ISSUE 10).
+
+The dominant remaining term in time-to-solution is iteration COUNT
+(ROADMAP item 4: 3334 Jacobi-preconditioned iterations at 10.33M dofs),
+and Jacobi's count grows with resolution.  HPCG's reference shape
+(arXiv:2304.08232) is CG + a geometric multigrid preconditioner; the
+matrix-free FEM data-locality work (arXiv:2205.08909) shows the level
+operators can stay assembly-free — which everything in this codebase
+already is per level by construction.  ``SolverConfig.precond = "mg"``
+selects it; scalar Jacobi stays the bit-exact default.
+
+Design (the communication shape is the point):
+
+* **Level lattice** — the fine mesh's cell lattice (``models/octree.py``
+  metadata, or ``ModelData.grid`` for the structured backend, where the
+  levels derive analytically) is coarsened by 2 per level while every
+  dim stays even, down to a small fixed coarse size.  Each coarse level
+  is a full uniform brick grid with a per-cell ``ck`` field
+  (volume-averaged fine stiffness — rediscretization, not Galerkin: the
+  brick element's ``Ke`` scales linearly in h through ``ck = E*h``, so
+  the level operator is the SAME matrix-free stencil at every level).
+
+* **Replicated coarse levels** — every level below the fine one is
+  REPLICATED across the mesh: each device runs the identical small
+  dense-stencil work redundantly, so the entire coarse hierarchy —
+  smoothing, level transfers, the coarse sweep — executes with ZERO
+  collectives.  One psum per V-cycle assembles the restricted fine
+  defect into the replicated first-coarse vector (``MG_RESTRICT_PSUMS``);
+  prolongation back to the part-local fine layout is a pure local
+  gather.
+
+* **Chebyshev–Jacobi smoother** — a FIXED-degree Chebyshev polynomial
+  in ``D^-1 A`` (SPD-preserving for ``b >= lambda_max``; the classical
+  symmetric-V-cycle argument gives a symmetric PSD ``M^-1`` when pre-
+  and post-smoothing use the same polynomial).  Chebyshev needs NO
+  inner products — the eigenvalue bounds are estimated ONCE at setup by
+  a few power-iteration matvecs (host numpy per coarse level; one small
+  jitted program for the fine level, cached in the partition cache) —
+  so the smoother contributes zero collectives: every collective in the
+  traced V-cycle is a fine-level matvec's interface assembly or THE
+  restriction psum, statically proven by the analysis/ collective-budget
+  rule (``Ops.body_collective_budget(variant, precond="mg")``).
+
+* **Fixed linear operator** — the cycle shape is static (no inner
+  convergence tests, no adaptivity), so ``M^-1`` is one fixed symmetric
+  PSD linear operator and plain (non-flexible) PCG remains valid: two
+  applies to the same vector are bitwise identical.
+
+Per-V-cycle fine-level work: ``2 * degree`` assembled matvecs (degree-d
+pre-smoothing from zero costs d-1, the defect 1, post-smoothing d), each
+carrying exactly the matvec's own interface collective (1 psum general /
+``STENCIL_HALO_PPERMUTES`` structured) — see ``precond_cycle_cost`` in
+``ops/matvec.py``, the single table the telemetry gauges, the static
+proof and this module share.
+
+The RHS-block axis (``pcg_many``) batches by vmapping the single-column
+cycle over the trailing axis: psum/ppermute COUNTS are independent of
+nrhs (payloads widen), proven at nrhs in {1, 8} by the lint.
+
+Not supported: the hybrid level-grid backend (its stencil costs minutes
+of compile per instantiation — 2*degree more instantiations per body is
+a different engineering problem), scalar (Poisson-class) models, and
+models without lattice metadata; ``validate/`` preflights all three.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Tuning constants (NOT SolverConfig knobs: they gate smoother quality, and
+# the two that shape the traced program — level count and smoothing degree —
+# ARE knobs, see SolverConfig.mg_levels / mg_smooth_degree).
+# ---------------------------------------------------------------------------
+
+#: safety factor on the power-iteration lambda_max estimate: Chebyshev
+#: smoothing is SPD-preserving only for b >= true lambda_max, and power
+#: iteration converges from below
+MG_LAM_SAFETY = 1.2
+#: smoother interval [lam/alpha, lam] — Chebyshev targets the upper part
+#: of the spectrum; the coarse correction owns the rest
+MG_SMOOTH_ALPHA = 4.0
+#: coarsest-level "solve": one fixed Chebyshev sweep over the (nearly)
+#: full interval [lam/alpha, lam]
+MG_COARSE_ALPHA = 30.0
+MG_COARSE_DEGREE = 10
+#: power-iteration matvecs for the per-level lambda_max estimates
+MG_POWER_ITERS = 16
+#: auto-coarsening stops at this many cells per dim (or when a dim odd)
+MG_MIN_COARSE_DIM = 2
+MG_MAX_LEVELS = 8
+
+# 8 hex corners in the element-dof order of models/element.py
+# HEX_CORNERS (shared with the structured slab stencil)
+_CORNERS = [(0, 0, 0), (1, 0, 0), (1, 1, 0), (0, 1, 0),
+            (0, 0, 1), (1, 0, 1), (1, 1, 1), (0, 1, 1)]
+
+
+class MGSetupError(ValueError):
+    """The model/config cannot build an MG hierarchy (named reason)."""
+
+
+# ---------------------------------------------------------------------------
+# Host-side hierarchy construction
+# ---------------------------------------------------------------------------
+
+def fine_lattice(model) -> Tuple[Optional[Tuple[int, int, int]],
+                                 Optional[np.ndarray]]:
+    """The fine cell-lattice dims and per-node integer lattice coords of
+    a lattice-structured model, or ``(None, None)``.
+
+    Octree models carry exact lattice metadata (``model.octree``); plain
+    structured-grid models (``model.grid``) recover coords from
+    ``node_coords / h``.  This is the ONE eligibility probe shared by
+    the preflight check and the hierarchy builder."""
+    ot = getattr(model, "octree", None)
+    if ot:
+        X, Y, Z = (int(d) for d in ot["dims"])
+        sy, sz = (int(s) for s in ot["strides"])
+        keys = np.asarray(ot["node_keys"])
+        lat = np.stack([keys % sy, (keys // sy) % (Y + 1), keys // sz],
+                       axis=1).astype(np.int64)
+        return (X, Y, Z), lat
+    if getattr(model, "grid", None) is not None:
+        nx, ny, nz, h = model.grid
+        nc = np.asarray(model.node_coords, float)
+        latf = (nc - nc.min(axis=0)) / float(h)
+        lat = np.rint(latf).astype(np.int64)
+        if np.abs(latf - lat).max() > 1e-6:
+            return None, None
+        return (int(nx), int(ny), int(nz)), lat
+    return None, None
+
+
+def plan_levels(dims, n_levels: int = 0) -> List[Tuple[int, int, int]]:
+    """Coarse-level cell dims, finest-coarse first.  Coarsens by 2 while
+    every dim stays even, down to ``MG_MIN_COARSE_DIM`` (auto) or for
+    exactly ``n_levels`` levels.  Raises :class:`MGSetupError` (named
+    reason) when the lattice cannot coarsen at least once — the
+    preflight surfaces the same reason before any partition build."""
+    d = np.asarray(dims, np.int64)
+    out: List[Tuple[int, int, int]] = []
+    while len(out) < (n_levels or MG_MAX_LEVELS):
+        if np.any(d % 2):
+            break
+        d = d // 2
+        out.append(tuple(int(v) for v in d))
+        if not n_levels and int(d.max()) <= MG_MIN_COARSE_DIM:
+            break
+    if not out:
+        raise MGSetupError(
+            f"precond='mg' cannot coarsen the {tuple(int(v) for v in dims)}"
+            " cell lattice: every dim must be even for at least one "
+            "2:1 coarsening (fewer than 2 levels)")
+    if n_levels and len(out) < n_levels:
+        raise MGSetupError(
+            f"SolverConfig.mg_levels={n_levels} but the "
+            f"{tuple(int(v) for v in dims)} lattice only supports "
+            f"{len(out)} coarsening(s)")
+    return out
+
+
+def _ravel(dims_c, pts) -> np.ndarray:
+    """Flat node id on a (cx, cy, cz)-cell grid: C-order over (ix, iy,
+    iz) — the SAME ordering ``_to_grid``/``_to_flat`` use at runtime."""
+    cx, cy, cz = dims_c
+    return (pts[..., 0] * (cy + 1) + pts[..., 1]) * (cz + 1) + pts[..., 2]
+
+
+def trilinear_transfer(lat, dims_c, scale: int):
+    """Trilinear prolongation stencil of nodes at integer lattice coords
+    ``lat`` (units of the FINER lattice) from the coarse node grid of
+    ``dims_c`` cells (coarse spacing = ``scale`` finer units).
+
+    Returns ``(gidx, gw)``: (n, 8) flat coarse node ids and weights with
+    ``fine = sum_k gw[:, k] * coarse[gidx[:, k]]``.  Restriction is the
+    exact transpose (same arrays, scatter instead of gather), which is
+    what keeps the V-cycle operator symmetric."""
+    lat = np.asarray(lat, np.float64)
+    dims_c = tuple(int(v) for v in dims_c)
+    pos = lat / float(scale)
+    cell = np.minimum(np.floor(pos).astype(np.int64),
+                      np.asarray(dims_c, np.int64) - 1)
+    cell = np.maximum(cell, 0)
+    frac = pos - cell
+    gidx = np.zeros((len(lat), 8), np.int64)
+    gw = np.zeros((len(lat), 8), np.float64)
+    for k, (dx, dy, dz) in enumerate(_CORNERS):
+        w = (frac[:, 0] if dx else 1.0 - frac[:, 0]) \
+            * (frac[:, 1] if dy else 1.0 - frac[:, 1]) \
+            * (frac[:, 2] if dz else 1.0 - frac[:, 2])
+        gidx[:, k] = _ravel(dims_c, cell + np.asarray((dx, dy, dz)))
+        gw[:, k] = w
+    return gidx.astype(np.int32), gw
+
+
+def _level_diag_np(diag_Ke, ck) -> np.ndarray:
+    """Assembled nodal diagonal of one replicated brick level:
+    ``diag[c, node] = sum_adjacent-cells ck * diag_Ke[3a + c]`` via the
+    8 pad-translates (the numpy twin of the structured backend's
+    ``diag_local``)."""
+    cx, cy, cz = ck.shape
+    d = np.zeros((3, cx + 1, cy + 1, cz + 1))
+    for a, (dx, dy, dz) in enumerate(_CORNERS):
+        for c in range(3):
+            d[c, dx:dx + cx, dy:dy + cy, dz:dz + cz] \
+                += diag_Ke[3 * a + c] * ck
+    return d
+
+
+def _level_matvec_np(Ke, ck, effg, xg) -> np.ndarray:
+    """Replicated-level stencil matvec in numpy (setup-time power
+    iteration only; the traced twin is :func:`_level_matvec`)."""
+    cx, cy, cz = ck.shape
+    xg = xg * effg
+    slots = [xg[:, dx:dx + cx, dy:dy + cy, dz:dz + cz]
+             for dx, dy, dz in _CORNERS]
+    u = np.concatenate(slots, axis=0).reshape(24, -1)
+    v = (Ke @ (ck.reshape(-1)[None] * u)).reshape(24, cx, cy, cz)
+    y = np.zeros_like(xg)
+    for a, (dx, dy, dz) in enumerate(_CORNERS):
+        y[:, dx:dx + cx, dy:dy + cy, dz:dz + cz] += v[3 * a:3 * a + 3]
+    return y * effg
+
+
+def _np_level_lam(Ke, ck, effg, idiag, iters: int = MG_POWER_ITERS) -> float:
+    """Power-iteration lambda_max estimate of ``D^-1 A`` on one
+    replicated level (host numpy — the level is small by construction)."""
+    x = effg.copy()
+    n = np.linalg.norm(x)
+    if n == 0:
+        return 1.0
+    x /= n
+    lam = 1.0
+    for _ in range(iters):
+        y = idiag * _level_matvec_np(Ke, ck, effg, x)
+        lam = float(np.linalg.norm(y))
+        if lam <= 0 or not np.isfinite(lam):
+            return 1.0
+        x = y / lam
+    return lam
+
+
+def _np_level_lam_min(Ke, ck, effg, idiag, lam_max: float,
+                      iters: int = 2 * MG_POWER_ITERS) -> float:
+    """Shifted power iteration for lambda_min of ``D^-1 A`` on the
+    coarsest level: the degenerate-interval diagnostic the validate/
+    satellite warns on (lam_max/lam_min < 1.05 means the level operator
+    is numerically a multiple of its diagonal)."""
+    x = effg.copy()
+    n = np.linalg.norm(x)
+    if n == 0:
+        return lam_max
+    x /= n
+    mu = 0.0
+    for _ in range(iters):
+        y = lam_max * (effg * x) - idiag * _level_matvec_np(
+            Ke, ck, effg, x)
+        mu = float(np.linalg.norm(y))
+        if mu <= 0 or not np.isfinite(mu):
+            return lam_max
+        x = y / mu
+    return max(lam_max - mu, 0.0)
+
+
+@dataclasses.dataclass
+class MGSetup:
+    """Host product of the hierarchy build: the ``data["mg"]`` subtree
+    (numpy; uploaded with the rest of the device data), the structural
+    meta that must key AOT caches and snapshot fingerprints, and the
+    setup diagnostics."""
+
+    tree: dict
+    meta: dict              # {"levels", "degree", "dims"} — cache/fp keyed
+    coarse_lams: List[float]
+    lam_min_coarse: float
+
+
+def build_mg_host(model, pm, n_levels: int = 0,
+                  degree: int = 2) -> MGSetup:
+    """Build the whole MG hierarchy on host from the model lattice and
+    the partition's node map.
+
+    ``pm`` supplies ``node_gid`` (P, n_node_loc) — the fine-transfer
+    arrays are laid out in the SAME node order as ``ops._as_node3``
+    (asserted equal on both supported backends by tests/test_mg.py).
+    The fine level's lambda_max slot in ``tree["lam"]`` is a placeholder
+    until :func:`estimate_fine_lam` fills it (device matvec required)."""
+    if int(model.n_dof) != 3 * int(model.n_node):
+        raise MGSetupError(
+            "precond='mg' needs the vector (3-dof/node) problem class; "
+            f"this model has n_dof={model.n_dof}, n_node={model.n_node}")
+    if not getattr(pm, "node_layout", True):
+        raise MGSetupError(
+            "precond='mg' needs the node-contiguous dof layout "
+            "(PartitionedModel.node_layout); this partition broke it "
+            "(e.g. node-less spring ghost dofs)")
+    dims, node_lat = fine_lattice(model)
+    if dims is None:
+        raise MGSetupError(
+            "precond='mg' needs lattice metadata (ModelData.grid or "
+            ".octree); this model has neither — use precond='jacobi'")
+    level_dims = plan_levels(dims, n_levels)
+
+    # ---- unit-lattice stiffness-density field E(x) --------------------
+    X, Y, Z = dims
+    E = np.asarray(model.ck, float) * np.asarray(model.ce, float)
+    if getattr(model, "octree", None):
+        leaves = np.asarray(model.octree["leaves"])
+        E_unit = np.zeros((X, Y, Z))
+        for s in np.unique(leaves[:, 3]):
+            sel = leaves[:, 3] == s
+            lx, ly, lz = (leaves[sel, 0], leaves[sel, 1], leaves[sel, 2])
+            for dx in range(int(s)):
+                for dy in range(int(s)):
+                    for dz in range(int(s)):
+                        E_unit[lx + dx, ly + dy, lz + dz] = E[sel]
+        hf = float(model.level.min() / leaves[:, 3].min())
+    else:
+        # structured grid: element id x-fastest (ex + nx*(ey + ny*ez)),
+        # the same convention parallel/structured.py slices
+        E_unit = E.reshape(Z, Y, X).transpose(2, 1, 0)
+        hf = float(model.grid[3])
+
+    # ---- per-node Dirichlet mask on the fine lattice ------------------
+    fixed = np.zeros(model.n_dof, bool)
+    fixed[np.asarray(model.fixed_dof)] = True
+    fixed3 = fixed.reshape(model.n_node, 3)
+    fine_keys = _ravel(dims, node_lat)
+    order = np.argsort(fine_keys)
+    keys_sorted = fine_keys[order]
+
+    # ---- the brick unit stiffness shared by every level ---------------
+    Ke = _brick_Ke(model)
+    diag_Ke = np.diag(Ke).copy()
+
+    # ---- coarse levels ------------------------------------------------
+    levels = []
+    coarse_lams: List[float] = []
+    lam_min_coarse = 0.0
+    for li, dc in enumerate(level_dims):
+        s = 2 ** (li + 1)
+        cx, cy, cz = dc
+        ck_l = (E_unit.reshape(cx, s, cy, s, cz, s)
+                .mean(axis=(1, 3, 5)) * (s * hf))
+        # Dirichlet injection: a coarse node fixed iff a fine mesh node
+        # exists at the same lattice position and is fixed there; absent
+        # positions stay free (safe: the Chebyshev correction operator
+        # is PSD even on a singular level operator — module docstring)
+        eff_l = np.ones((3, cx + 1, cy + 1, cz + 1))
+        cn = np.stack(np.meshgrid(np.arange(cx + 1), np.arange(cy + 1),
+                                  np.arange(cz + 1), indexing="ij"),
+                      axis=-1).reshape(-1, 3)
+        ckeys = _ravel(dims, cn * s)
+        pos = np.searchsorted(keys_sorted, ckeys)
+        pos_c = np.minimum(pos, len(keys_sorted) - 1)
+        present = keys_sorted[pos_c] == ckeys
+        nid = order[pos_c]
+        for c in range(3):
+            fx = np.zeros(len(cn), bool)
+            fx[present] = fixed3[nid[present], c]
+            eff_l[c] = np.where(fx, 0.0, 1.0).reshape(cx + 1, cy + 1,
+                                                      cz + 1)
+        dg = _level_diag_np(diag_Ke, ck_l)
+        idiag = np.where((dg > 0) & (eff_l > 0), 1.0 / np.where(dg > 0, dg, 1.0), 0.0)
+        lam = MG_LAM_SAFETY * _np_level_lam(Ke, ck_l, eff_l, idiag)
+        coarse_lams.append(lam)
+        lev = {"ck": ck_l, "eff": eff_l,
+               "idiag": idiag.reshape(3, -1).T.copy()}   # flat (n, 3)
+        if li + 1 < len(level_dims):
+            # down-transfer: this level's nodes interpolated from the
+            # next coarser grid (spacing ratio 2)
+            ln = np.stack(np.meshgrid(np.arange(cx + 1),
+                                      np.arange(cy + 1),
+                                      np.arange(cz + 1), indexing="ij"),
+                          axis=-1).reshape(-1, 3)
+            gidx, gw = trilinear_transfer(ln, level_dims[li + 1], 2)
+            lev["gidx"], lev["gw"] = gidx, gw
+        else:
+            lam_min_coarse = _np_level_lam_min(
+                Ke, ck_l, eff_l, idiag, lam / MG_LAM_SAFETY)
+        levels.append(lev)
+
+    # ---- fine -> first-coarse transfer (part-local layout) ------------
+    gid = np.asarray(pm.node_gid)                     # (P, n_node_loc)
+    P, nnl = gid.shape
+    valid = gid >= 0
+    lat_loc = np.zeros((P, nnl, 3), np.int64)
+    lat_loc[valid] = node_lat[gid[valid]]
+    gidx, gw = trilinear_transfer(lat_loc.reshape(-1, 3), level_dims[0], 2)
+    gidx = gidx.reshape(P, nnl, 8)
+    gw = gw.reshape(P, nnl, 8)
+    gw[~valid] = 0.0                                  # padded local slots
+
+    tree = {
+        "fine": {"gidx": gidx, "gw": gw},
+        "levels": levels,
+        "Ke": Ke,
+        # [fine, coarse_1, ..., coarse_L]; slot 0 is a placeholder until
+        # estimate_fine_lam fills it post-upload
+        "lam": np.asarray([0.0] + coarse_lams, np.float64),
+    }
+    meta = {"levels": len(level_dims), "degree": int(degree),
+            "dims": [int(v) for v in dims]}
+    return MGSetup(tree=tree, meta=meta, coarse_lams=coarse_lams,
+                   lam_min_coarse=lam_min_coarse)
+
+
+def _brick_Ke(model) -> np.ndarray:
+    """The 24x24 unit (h=1, E=1) brick stiffness the coarse levels
+    rediscretize with: the model's own 8-node brick type when one
+    exists (bitwise the operator the fine mesh uses for its bricks),
+    else the canonical hex element."""
+    ot = getattr(model, "octree", None)
+    bt = ot.get("brick_type") if ot else None
+    if bt is not None and bt in model.elem_lib:
+        return np.asarray(model.elem_lib[bt]["Ke"], float)
+    for lib in model.elem_lib.values():
+        if np.asarray(lib["Ke"]).shape == (24, 24):
+            return np.asarray(lib["Ke"], float)
+    from pcg_mpi_solver_tpu.models.element import hex_stiffness
+
+    nu = float(model.mat_prop[0]["Pos"]) if model.mat_prop else 0.2
+    return hex_stiffness(1.0, 1.0, nu)
+
+
+# ---------------------------------------------------------------------------
+# Traced V-cycle (jnp)
+# ---------------------------------------------------------------------------
+
+def _to_grid(flat, dims_c):
+    """(n_nodes, 3[, R]) flat level vector -> (3, cx+1, cy+1, cz+1[, R])
+    grid (node id = C-order over (ix, iy, iz), matching ``_ravel``)."""
+    cx, cy, cz = dims_c
+    tail = flat.shape[2:]
+    g = flat.reshape((cx + 1, cy + 1, cz + 1, 3) + tail)
+    return jnp_moveaxis(g, 3, 0)
+
+
+def _to_flat(grid):
+    """Inverse of :func:`_to_grid`."""
+    g = jnp_moveaxis(grid, 0, 3)
+    return g.reshape((-1, 3) + g.shape[4:])
+
+
+def jnp_moveaxis(a, src, dst):
+    import jax.numpy as jnp
+
+    return jnp.moveaxis(a, src, dst)
+
+
+def _level_matvec(Ke, ck, effg, x_flat):
+    """Replicated-level assembled stencil matvec: flat (n, 3) -> (n, 3),
+    eff-masked in and out.  8 contiguous slices -> one (24, 24) MXU
+    einsum -> 8 pad-translate adds — the structured backend's ``gse``
+    form on an unsharded grid; NO collectives (the level is replicated,
+    every device does the identical work)."""
+    import jax.numpy as jnp
+
+    cx, cy, cz = ck.shape
+    xg = _to_grid(x_flat, (cx, cy, cz)) * effg
+    slots = [xg[:, dx:dx + cx, dy:dy + cy, dz:dz + cz]
+             for dx, dy, dz in _CORNERS]
+    u = jnp.concatenate(slots, axis=0)               # (24, cx, cy, cz)
+    v = jnp.einsum("de,exyz->dxyz", Ke, ck[None] * u)
+    y = None
+    for a, (dx, dy, dz) in enumerate(_CORNERS):
+        t = jnp.pad(v[3 * a:3 * a + 3],
+                    ((0, 0), (dx, 1 - dx), (dy, 1 - dy), (dz, 1 - dz)))
+        y = t if y is None else y + t
+    return _to_flat(y * effg)
+
+
+def _cheb_smooth(amul, idiag_mul, r, z0, lam, degree: int,
+                 alpha: float):
+    """Fixed-degree Chebyshev–Jacobi smoothing toward ``A z = r`` on the
+    interval ``[lam/alpha, lam]`` (``lam`` already carries the setup
+    safety factor).  ``z0=None`` declares a zero start, eliding the
+    initial defect matvec (degree-d costs d-1 matvecs from zero, d
+    warm).  The recurrence is a FIXED polynomial — no inner products,
+    no convergence tests, zero collectives of its own — which is what
+    keeps the V-cycle a fixed SPD operator under plain CG."""
+    b = lam
+    a = lam / alpha
+    theta = 0.5 * (b + a)
+    delta = 0.5 * (b - a)
+    sigma = theta / delta
+    rho = 1.0 / sigma
+    if z0 is None:
+        res = r
+        z = None
+    else:
+        res = r - amul(z0)
+        z = z0
+    d = idiag_mul(res) / theta
+    for _ in range(1, int(degree)):
+        z = d if z is None else z + d
+        res = r - amul(z)
+        rho_new = 1.0 / (2.0 * sigma - rho)
+        d = (rho_new * rho) * d + (2.0 * rho_new / delta) * idiag_mul(res)
+        rho = rho_new
+    return d if z is None else z + d
+
+
+def _coarse_vcycle(mg, lidx: int, rc, degree: int):
+    """Recursive V-cycle over the REPLICATED coarse levels: Chebyshev
+    pre/post smoothing, trilinear down/up transfers, a fixed Chebyshev
+    sweep on the coarsest level.  Entirely collective-free."""
+    import jax.numpy as jnp
+
+    lev = mg["levels"][lidx]
+    lam = mg["lam"][lidx + 1]
+    Ke = mg["Ke"]
+    idiag = lev["idiag"]
+    amul = lambda v: _level_matvec(Ke, lev["ck"], lev["eff"], v)
+    idiag_mul = lambda v: idiag * v
+    if lidx == len(mg["levels"]) - 1:
+        return _cheb_smooth(amul, idiag_mul, rc, None, lam,
+                            MG_COARSE_DEGREE, MG_COARSE_ALPHA)
+    z = _cheb_smooth(amul, idiag_mul, rc, None, lam, degree,
+                     MG_SMOOTH_ALPHA)
+    s = rc - amul(z)
+    gidx, gw = lev["gidx"], lev["gw"]
+    n_next = mg["levels"][lidx + 1]["idiag"].shape[0]
+    sc = jnp.zeros((n_next, 3), s.dtype).at[gidx.reshape(-1)].add(
+        (gw[..., None] * s[:, None, :]).reshape(-1, 3), mode="drop")
+    zc = _coarse_vcycle(mg, lidx + 1, sc, degree)
+    z = z + (gw[..., None]
+             * jnp.take(zc, gidx, axis=0, mode="clip")).sum(axis=1)
+    return _cheb_smooth(amul, idiag_mul, rc, z, lam, degree,
+                        MG_SMOOTH_ALPHA)
+
+
+def _vcycle_single(ops, data, m, r):
+    """One symmetric V-cycle on a single fine column (P, n_loc)."""
+    import jax.numpy as jnp
+
+    mg = data["mg"]
+    eff = data["eff"]
+    degree = int(ops.mg_degree)
+    lam = mg["lam"][0]
+    idiag = m["mg_diag"]                  # eff-masked fine inverse diag
+    amul = lambda v: eff * ops.matvec(data, v)
+    idiag_mul = lambda v: idiag * v
+
+    # pre-smooth from zero: degree-1 matvecs
+    z = _cheb_smooth(amul, idiag_mul, r, None, lam, degree,
+                     MG_SMOOTH_ALPHA)
+    # defect + owner-deduplicated restriction into the replicated
+    # first-coarse vector: ONE psum for the whole cycle
+    s = r - amul(z)
+    f = mg["fine"]
+    s3 = ops._as_node3(s) * data["node_weight"][..., None]
+    contrib = (f["gw"][..., None] * s3[:, :, None, :])
+    n_c0 = mg["levels"][0]["idiag"].shape[0]
+    part = jnp.zeros((n_c0, 3), s.dtype).at[f["gidx"].reshape(-1)].add(
+        contrib.reshape(-1, 3), mode="drop")
+    sc = ops._psum(part)
+    # the whole coarse hierarchy is replicated: zero collectives
+    zc = _coarse_vcycle(mg, 0, sc, degree)
+    # prolongation back to the part-local fine layout: pure local gather
+    z3 = (f["gw"][..., None]
+          * jnp.take(zc, f["gidx"], axis=0, mode="clip")).sum(axis=2)
+    z = z + eff * ops._from_node3(z3)
+    # post-smooth (same polynomial as pre — the symmetry requirement)
+    return _cheb_smooth(amul, idiag_mul, r, z, lam, degree,
+                        MG_SMOOTH_ALPHA)
+
+
+def mg_apply(ops, data, m, r):
+    """Apply the MG preconditioner: ``z = M^-1 r``.
+
+    ``m`` is the prec operand ``make_prec(ops, data, "mg")`` built —
+    ``{"mg_diag": eff-masked inverse diag of A, "fb": ()}`` — and the
+    hierarchy rides ``data["mg"]``.  ``m["fb"] > 0`` is the recovery
+    ladder's DEMOTION switch: the apply becomes a plain scalar-Jacobi
+    multiply with whatever diagonal inverse the ladder installed
+    (``fallback_prec`` rung; the V-cycle branch is skipped by the cond,
+    while its collectives still appear — once — in the traced body, so
+    the static collective budget is mode-independent).
+
+    ``r`` may carry a trailing RHS block axis (P, n_loc, nrhs): the
+    cycle vmaps over columns — collective COUNTS are independent of the
+    block width (payloads widen), the batched-solve contract."""
+    import jax
+    import jax.numpy as jnp
+
+    if r.ndim == 3:
+        return jax.vmap(lambda col: mg_apply(ops, data, m, col),
+                        in_axes=-1, out_axes=-1)(r)
+
+    fb = m.get("fb")
+    if fb is None:
+        return _vcycle_single(ops, data, m, r)
+    return jax.lax.cond(
+        fb > 0,
+        lambda rr: (m["mg_diag"] * rr).astype(rr.dtype),
+        lambda rr: _vcycle_single(ops, data, m, rr).astype(rr.dtype),
+        r)
+
+
+def cast_tree(tree: dict, dtype) -> dict:
+    """The ``data["mg"]`` subtree with float leaves at the STORAGE dtype
+    (a direct-f32 solve must not promote the cycle to f64 through f64
+    hierarchy operands); index arrays pass through.  Shared by the
+    driver and Newmark constructors."""
+    import jax
+
+    dt = np.dtype(dtype)
+    return jax.tree.map(
+        lambda x: (np.asarray(x).astype(dt)
+                   if np.issubdtype(np.asarray(x).dtype, np.floating)
+                   else np.asarray(x)), tree)
+
+
+def fallback_operand(inv):
+    """The recovery ladder's DEMOTED prec operand for an mg-configured
+    solver: the scalar-Jacobi inverse in the mg prec-operand SHAPE with
+    the ``fb`` switch set, so the compiled cycle's apply takes the plain
+    scalar branch without recompiling anything (mg_apply)."""
+    import jax.numpy as jnp
+
+    return {"mg_diag": inv, "fb": jnp.ones((), jnp.int32)}
+
+
+def coarse_dofs(meta) -> int:
+    """Replicated first-coarse vector length (nodes x 3) of a hierarchy
+    with structural ``meta`` — the mg restriction psum's payload size,
+    consumed by the comm gauges (Ops.comm_estimate)."""
+    if not meta:
+        return 0
+    half = [d // 2 for d in meta["dims"]]
+    return 3 * (half[0] + 1) * (half[1] + 1) * (half[2] + 1)
+
+
+def install_lam_and_report(setup: MGSetup, lam_fine: float, *, trees,
+                           mesh, rep_spec, recorder, wall_s: float,
+                           cached: bool) -> None:
+    """Post-estimation half of the MG setup, shared by driver and
+    Newmark: install the per-level lambda vector into every device tree
+    (f64 + the mixed f32 shadow), emit the ``mg_setup`` telemetry event
+    + the ``mg.levels`` gauge, and surface the degenerate-Chebyshev-
+    interval warning (validate/)."""
+    import warnings
+
+    from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
+    from pcg_mpi_solver_tpu.validate import check_mg_interval
+
+    lam = np.asarray([lam_fine] + list(setup.coarse_lams), np.float64)
+    for t in trees:
+        dt = t["mg"]["lam"].dtype
+        t["mg"]["lam"] = put_sharded(lam.astype(dt), mesh, rep_spec)
+    chk = check_mg_interval(setup.lam_min_coarse,
+                            setup.coarse_lams[-1] / MG_LAM_SAFETY)
+    if chk.status == "warn":
+        warnings.warn(f"[{chk.name}] {chk.detail}")
+    recorder.event(
+        "mg_setup", levels=int(setup.meta["levels"]),
+        degree=int(setup.meta["degree"]),
+        dims=list(setup.meta["dims"]),
+        lam_fine=round(lam_fine, 6),
+        lam_coarse=[round(v, 6) for v in setup.coarse_lams],
+        interval=chk.status, cached=bool(cached),
+        wall_s=round(wall_s, 6))
+    recorder.gauge("mg.levels", int(setup.meta["levels"]))
+
+
+# ---------------------------------------------------------------------------
+# Fine-level eigenvalue bound (device; "a few power-iteration matvecs")
+# ---------------------------------------------------------------------------
+
+def estimate_fine_lam(ops, data, mesh, data_specs, part_spec,
+                      iters: int = MG_POWER_ITERS) -> float:
+    """lambda_max estimate of ``D^-1 A`` on the PARTITIONED fine level:
+    a small jitted power-iteration program (one matvec + one norm psum
+    per iteration, setup-only — cached in the partition cache by the
+    driver so warm runs skip it entirely).  Returns the SAFETY-scaled
+    bound ready for ``data["mg"]["lam"][0]``."""
+    import jax
+    import jax.numpy as jnp
+
+    R = jax.sharding.PartitionSpec()
+
+    def run(data):
+        eff = data["eff"]
+        w = data["weight"] * eff
+        diag = ops.diag(data)
+        idiag = jnp.where((eff > 0) & (diag != 0),
+                          1.0 / jnp.where(diag != 0, diag, 1.0), 0.0)
+        x0 = eff / jnp.maximum(jnp.sqrt(ops.wdot(w, eff, eff)), 1e-30)
+
+        def body(_, c):
+            x, _lam = c
+            y = idiag * (eff * ops.matvec(data, x))
+            nrm = jnp.sqrt(ops.wdot(w, y, y))
+            safe = jnp.maximum(nrm, 1e-30)
+            return (y / safe).astype(x.dtype), nrm
+
+        _x, lam = jax.lax.fori_loop(
+            0, iters, body, (x0.astype(data["eff"].dtype),
+                             jnp.asarray(1.0, ops.dot_dtype)))
+        return lam
+
+    fn = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(data_specs,),
+                               out_specs=R, check_vma=False))
+    lam = float(fn(data))
+    if not np.isfinite(lam) or lam <= 0:
+        lam = 1.0
+    return MG_LAM_SAFETY * lam

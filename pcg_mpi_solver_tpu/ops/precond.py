@@ -111,20 +111,23 @@ def invert_node_blocks(B: jnp.ndarray, eff3: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(ok[..., None, None], inv, scalar).astype(out_dt)
 
 
-VALID_PRECONDS = ("jacobi", "block3")
+VALID_PRECONDS = ("jacobi", "block3", "mg")
 
 
 def fallback_kind(kind: str) -> "str | None":
     """The next-weaker-but-safer preconditioner for the recovery ladder
-    (resilience/): a flag-2/4 breakdown under block-Jacobi retries under
-    scalar Jacobi — the reference's only preconditioner, whose inverse
-    is finite wherever the assembled diagonal is nonzero, so it cannot
-    itself re-introduce the Inf the 3x3 block inverse may have produced
-    on a near-singular block.  Scalar Jacobi has nothing weaker that is
-    still a preconditioner (identity would change iteration counts far
-    more than it saves), so it returns None and the ladder skips to its
-    next rung."""
-    return "jacobi" if kind == "block3" else None
+    (resilience/): a flag-2/4 breakdown under block-Jacobi OR under the
+    geometric multigrid V-cycle retries under scalar Jacobi — the
+    reference's only preconditioner, whose inverse is finite wherever
+    the assembled diagonal is nonzero, so it cannot itself re-introduce
+    the Inf a near-singular 3x3 block inverse produced, nor depend on a
+    level hierarchy that may itself be the broken ingredient (a bad mg
+    hierarchy DEGRADES to scalar Jacobi instead of failing the solve —
+    the demotion rung of ISSUE 10).  Scalar Jacobi has nothing weaker
+    that is still a preconditioner (identity would change iteration
+    counts far more than it saves), so it returns None and the ladder
+    skips to its next rung."""
+    return "jacobi" if kind in ("block3", "mg") else None
 
 
 def corner_block_field(Ke: jnp.ndarray, ck: jnp.ndarray,
@@ -160,12 +163,24 @@ def make_fallback_prec(ops, data: dict, kind: str):
 
 
 def make_prec(ops, data: dict, kind: str):
-    """The preconditioner inverse for ``kind`` ("jacobi" | "block3"), ready
-    for ``ops.apply_prec`` inside the PCG body — the one shared builder for
-    every solver (quasi-static driver, implicit Newmark)."""
+    """The preconditioner inverse for ``kind`` ("jacobi" | "block3" |
+    "mg"), ready for ``ops.apply_prec`` inside the PCG body — the one
+    shared builder for every solver (quasi-static driver, implicit
+    Newmark).
+
+    "mg" returns the prec DICT the V-cycle consumes (ops/mg.py): the
+    eff-masked scalar inverse diagonal (the Chebyshev smoother's D^-1 —
+    bitwise the jacobi inverse) plus the ``fb`` demotion switch the
+    recovery ladder flips to 1 to degrade the apply to plain scalar
+    Jacobi without recompiling the cycle; the hierarchy itself rides
+    ``data["mg"]``."""
     if kind == "block3":
         return ops.block_precond(data)
-    if kind != "jacobi":
-        raise ValueError(f"precond must be 'jacobi'|'block3', got {kind!r}")
+    if kind not in ("jacobi", "mg"):
+        raise ValueError(
+            f"precond must be one of {VALID_PRECONDS}, got {kind!r}")
     diag_k = ops.diag(data)
-    return jnp.where(data["eff"] > 0, 1.0 / diag_k, 0.0)
+    inv = jnp.where(data["eff"] > 0, 1.0 / diag_k, 0.0)
+    if kind == "mg":
+        return {"mg_diag": inv, "fb": jnp.zeros((), jnp.int32)}
+    return inv

@@ -330,17 +330,27 @@ class StructuredOps(Ops):
             y = y + t
         return y
 
-    def body_collective_budget(self, variant: str = "classic") -> dict:
+    def body_collective_budget(self, variant: str = "classic",
+                               precond: str = "jacobi") -> dict:
         """Structured-slab collective contract of the PCG loop body: the
         scalar psums + deferred-check psum from the base table (no iface
         psum — n_iface is 0 by construction; boundary planes combine via
         _halo instead), plus the halo exchange's ``STENCIL_HALO_PPERMUTES``
         ppermutes per matvec.  Proven against the traced body jaxpr by the
         analysis/ collective-budget rule — a stencil change that adds
-        shifts must update the declaration consciously."""
-        budget = dict(super().body_collective_budget(variant))
+        shifts must update the declaration consciously.
+
+        ``precond="mg"`` multiplies the halo count by the V-cycle's
+        fine-level matvecs (1 body matvec + 2*mg_degree cycle matvecs,
+        each = one halo exchange) and the base budget already carries
+        the restriction psum (ops/matvec.precond_cycle_cost — one
+        table for gauges, budget and proof)."""
+        from pcg_mpi_solver_tpu.ops.matvec import precond_cycle_cost
+
+        budget = dict(super().body_collective_budget(variant, precond))
         if self.n_parts > 1 and self.axis_name is not None:
-            budget["ppermute"] = STENCIL_HALO_PPERMUTES
+            mv_extra, _ps = precond_cycle_cost(precond, self.mg_degree)
+            budget["ppermute"] = STENCIL_HALO_PPERMUTES * (1 + mv_extra)
         return budget
 
     def _halo(self, yg):

@@ -51,7 +51,7 @@ class ChunkedEngine:
     def __init__(self, *, mesh, data_specs, part_spec, rep_spec, ops,
                  scfg, glob_n_dof_eff: int, cap: int, mixed: bool,
                  ops32=None, amul_fn=None, trace_len: int = 0,
-                 recorder=None, donate: bool = False):
+                 recorder=None, donate: bool = False, prec_spec=None):
         """``amul_fn``, when given, is a host-level callable
         ``(data, v) -> eff * K.v`` backed by ONE separately-jitted
         program the caller shares across all its out-of-loop f64 matvec
@@ -96,6 +96,11 @@ class ChunkedEngine:
         fused_v = variant == "fused"
         cap = int(cap)
         P, R = part_spec, rep_spec
+        # preconditioner-operand spec: the plain part spec for the array
+        # inverses (jacobi/block3), or the caller-supplied PYTREE of
+        # specs for structured prec operands (the mg dict —
+        # driver/newmark pass {"mg_diag": P, "fb": R})
+        prec_spec = P if prec_spec is None else prec_spec
         carry_specs = carry_part_specs(P, R, trace=self.trace_len > 0,
                                        fused=fused_v)
 
@@ -154,7 +159,7 @@ class ChunkedEngine:
                     variant=variant)
                 return res.x, carry2, res.flag
 
-            in_cycle = (data_specs, P, P, R, carry_specs, R) + (
+            in_cycle = (data_specs, P, prec_spec, R, carry_specs, R) + (
                 (R,) if traced else ())
             # donated f32 carry: each resumable dispatch updates the
             # Krylov state in place instead of copying it
@@ -226,7 +231,7 @@ class ChunkedEngine:
             # donated carry: the resumable Krylov state is aliased across
             # dispatch boundaries instead of copied
             self._cycle_fn = smap(
-                _cycle, (data_specs, P, P, carry_specs, R),
+                _cycle, (data_specs, P, prec_spec, carry_specs, R),
                 (P, carry_specs, R, R), donate_argnums=(3,))
 
             def _final(data, fext, carry):

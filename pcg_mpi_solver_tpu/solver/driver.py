@@ -444,6 +444,41 @@ class Solver:
             ops32_factory = lambda: Ops.from_model(
                 self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS)
 
+        # ---- MG hierarchy (precond="mg" — ops/mg.py): host-built level
+        # lattice + transfers into the device data tree, the Chebyshev
+        # degree pinned on the ops (it shapes the traced V-cycle).  The
+        # hybrid backend is out of scope by design: its level-grid
+        # stencil costs minutes of compile PER INSTANTIATION and the
+        # cycle adds 2*degree more.
+        self._mg_meta = None
+        self._mg_setup = None
+        if solver_cfg.precond == "mg":
+            if self.backend == "hybrid":
+                raise ValueError(
+                    "precond='mg' is not supported on the hybrid "
+                    "level-grid backend; use backend='general' or "
+                    "'structured' (or precond='jacobi'|'block3')")
+            from pcg_mpi_solver_tpu.ops import mg as mgmod
+
+            t_mg0 = time.perf_counter()
+            with self._rec.span("mg_setup"):
+                mg_setup = mgmod.build_mg_host(
+                    model, self.pm,
+                    n_levels=int(solver_cfg.mg_levels),
+                    degree=int(solver_cfg.mg_smooth_degree))
+            # float leaves at the STORAGE dtype (mgmod.cast_tree); the
+            # mixed shadow below re-derives its f32 copy
+            data["mg"] = mgmod.cast_tree(mg_setup.tree, dtype)
+            self._mg_meta = mg_setup.meta
+            self._mg_setup = (mg_setup, time.perf_counter() - t_mg0)
+            deg = int(solver_cfg.mg_smooth_degree)
+            cdofs = mgmod.coarse_dofs(mg_setup.meta)
+            self.ops = dataclasses.replace(self.ops, mg_degree=deg,
+                                           mg_coarse_dofs=cdofs)
+            _base32_factory = ops32_factory
+            ops32_factory = lambda: dataclasses.replace(
+                _base32_factory(), mg_degree=deg, mg_coarse_dofs=cdofs)
+
         if self.mixed:
             # f32 shadow of the float leaves; index/bool arrays are shared
             # (same device buffers), so the extra memory is only the f32 floats.
@@ -471,6 +506,14 @@ class Solver:
         self._part_spec = jax.sharding.PartitionSpec(PARTS_AXIS)
         self._rep_spec = jax.sharding.PartitionSpec()
 
+        if solver_cfg.precond == "mg":
+            # fine-level Chebyshev bound: a few power-iteration matvecs
+            # on the uploaded operator (cached in the partition cache —
+            # warm runs skip the device work), then the per-level lambda
+            # vector joins the device tree and the setup telemetry/
+            # degenerate-interval warning fire
+            self._finish_mg_setup(solver_cfg)
+
         glob_n_eff = self.pm.glob_n_dof_eff
 
         # Static telemetry gauges: problem size, backend, and the per-PCG-
@@ -481,6 +524,7 @@ class Solver:
         self._rec.gauge("n_dof", int(self.pm.glob_n_dof))
         self._rec.gauge("precision_mode", solver_cfg.precision_mode)
         self._rec.gauge("pcg_variant", solver_cfg.pcg_variant)
+        self._rec.gauge("precond", solver_cfg.precond)
         # mixed mode: the Krylov iterations (vectors AND dot reductions)
         # run on the f32 ops, so that is the ops object to size from;
         # the variant sets the per-iteration collective count (fused =
@@ -489,7 +533,8 @@ class Solver:
         iter_dtype = jnp.float32 if self.mixed else dtype
         for k, v in est_ops.comm_estimate(
                 storage_dtype=iter_dtype,
-                variant=solver_cfg.pcg_variant).items():
+                variant=solver_cfg.pcg_variant,
+                precond=solver_cfg.precond).items():
             self._rec.gauge(f"comm.{k}", v)
 
         # In-graph convergence trace: ring length (0 = off) and its float
@@ -647,11 +692,66 @@ class Solver:
     # ------------------------------------------------------------------
     def _make_prec(self, ops, d):
         """Preconditioner inverse per config.solver.precond: scalar Jacobi
-        (P, n_loc) or 3x3 node-block Jacobi (P, n_node_loc, 3, 3); either
-        feeds ops.apply_prec inside the PCG body."""
+        (P, n_loc), 3x3 node-block Jacobi (P, n_node_loc, 3, 3), or the
+        mg V-cycle prec dict; any of them feeds ops.apply_prec inside
+        the PCG body."""
         from pcg_mpi_solver_tpu.ops.precond import make_prec
 
         return make_prec(ops, d, self.config.solver.precond)
+
+    def _prec_operand_spec(self):
+        """shard_map PartitionSpec (pytree) of the preconditioner
+        operand the chunked programs thread: the part spec for the array
+        inverses, the {mg_diag: parts, fb: replicated} dict for mg."""
+        if self.config.solver.precond == "mg":
+            return {"mg_diag": self._part_spec, "fb": self._rep_spec}
+        return self._part_spec
+
+    def _finish_mg_setup(self, scfg):
+        """Post-upload half of the MG setup: estimate the fine-level
+        Chebyshev bound via a few power-iteration matvecs on the REAL
+        partitioned operator (ops/mg.estimate_fine_lam; served from the
+        partition cache on warm runs), then install the per-level lambda
+        vector + emit the ``mg_setup`` telemetry and degenerate-interval
+        warning through the shared ``mg.install_lam_and_report``."""
+        from pcg_mpi_solver_tpu.ops import mg as mgmod
+
+        setup, t_build = self._mg_setup
+        data64 = self.data["f64"] if self.mixed else self.data
+        specs64 = self._specs["f64"] if self.mixed else self._specs
+        t0 = time.perf_counter()
+        cached = False
+        if self._cache_dir:
+            from pcg_mpi_solver_tpu.cache import keys as ckeys
+            from pcg_mpi_solver_tpu.cache.partition_cache import (
+                cached_partition)
+
+            key = ckeys.partition_cache_key(
+                self._model_fp, n_parts=int(self.pm.n_parts),
+                backend=f"mglam-{self.backend}",
+                dtype=str(np.dtype(self.dtype)),
+                extra=dict(setup.meta, iters=mgmod.MG_POWER_ITERS))
+            hit0 = self._rec.counters.get("cache.partition.hit", 0)
+            entry = cached_partition(
+                self._cache_dir, key,
+                lambda: {"lam": mgmod.estimate_fine_lam(
+                    self.ops, data64, self.mesh, specs64,
+                    self._part_spec)},
+                recorder=self._rec, label="mg_lam")
+            cached = self._rec.counters.get("cache.partition.hit",
+                                            0) > hit0
+            lam_fine = float(entry["lam"])
+        else:
+            with self._rec.span("mg_lam"):
+                lam_fine = mgmod.estimate_fine_lam(
+                    self.ops, data64, self.mesh, specs64,
+                    self._part_spec)
+        trees = ([self.data["f64"], self.data["f32"]] if self.mixed
+                 else [self.data])
+        mgmod.install_lam_and_report(
+            setup, lam_fine, trees=trees, mesh=self.mesh,
+            rep_spec=self._rep_spec, recorder=self._rec,
+            wall_s=t_build + time.perf_counter() - t0, cached=cached)
 
     # ------------------------------------------------------------------
     # Warm-path subsystem (cache/): partition cache, AOT step, warmup
@@ -714,11 +814,13 @@ class Solver:
             backend=self.backend,
             # every SolverConfig scalar is baked into the traced program
             solver=_dc.asdict(self.config.solver),
-            # also a STRUCTURAL key component (cache/keys.py): the
-            # variant reshapes the loop body and the carry pytree, so
-            # classic/fused programs must never collide even if the
-            # solver dict's serialization ever changes
+            # also STRUCTURAL key components (cache/keys.py): the
+            # variant reshapes the loop body and the carry pytree, the
+            # precond reshapes the body's preconditioner apply (the mg
+            # V-cycle), so those programs must never collide even if
+            # the solver dict's serialization ever changes
             pcg_variant=self.config.solver.pcg_variant,
+            precond=self.config.solver.precond,
             trace_len=self.trace_len,
             glob_n_dof_eff=int(self.pm.glob_n_dof_eff),
             donate=bool(donate_step),
@@ -733,6 +835,10 @@ class Solver:
                    "pallas_planes": (pallas_planes()
                                      if self.pallas_variant != "off"
                                      else None),
+                   # MG-shape components (level count / smoothing
+                   # degree / lattice dims): they shape the traced
+                   # V-cycle beyond what the solver dict records
+                   "mg": self._mg_meta,
                    "x64": bool(jax.config.jax_enable_x64)})
         exported = aot.cached_step(
             self._cache_dir, key, jax.jit(shard_step), abstract_args,
@@ -870,10 +976,11 @@ class Solver:
                 prec = self._make_prec(self.ops, data64)
             return carry0, normr0, n2b, prec
 
+        prec_spec = self._prec_operand_spec()
         self._start_post_fn = jax.jit(jax.shard_map(
             _start_post, mesh=self.mesh,
             in_specs=(self._specs, P, P, P),
-            out_specs=(carry_specs, R, R, P), check_vma=False))
+            out_specs=(carry_specs, R, R, prec_spec), check_vma=False))
 
         self._engine = ChunkedEngine(
             mesh=self.mesh, data_specs=self._specs, part_spec=P,
@@ -881,7 +988,8 @@ class Solver:
             glob_n_dof_eff=glob_n_eff, cap=self._dispatch_cap,
             mixed=mixed, ops32=self.ops32 if mixed else None,
             amul_fn=self._amul64_fn, trace_len=self.trace_len,
-            recorder=self._rec, donate=self._donate)
+            recorder=self._rec, donate=self._donate,
+            prec_spec=prec_spec)
         self._finish_fn = jax.jit(lambda x, udi: x + udi)
 
     def _step_chunked(self, delta):
@@ -1065,23 +1173,35 @@ class Solver:
 
     def _fallback_prec(self):
         """Scalar-Jacobi fallback preconditioner inverse (ladder rung 2):
-        weaker than block3 but its inverse is finite wherever the
+        weaker than block3/mg but its inverse is finite wherever the
         assembled diagonal is nonzero, so it cannot re-introduce the Inf
-        a near-singular 3x3 block inverse produced.  Built/compiled only
-        when the rung actually fires."""
+        a near-singular 3x3 block inverse produced — nor depend on an mg
+        hierarchy that may itself be the broken ingredient.  Under
+        precond='mg' the fallback keeps the mg PREC-OPERAND SHAPE with
+        the ``fb`` demotion switch set (the compiled cycle's apply then
+        takes the plain scalar-Jacobi branch — ops/mg.mg_apply — so a
+        broken hierarchy DEGRADES without recompiling anything).
+        Built/compiled only when the rung actually fires."""
         from pcg_mpi_solver_tpu.ops.precond import make_prec
 
         if self._fallback_prec_fn is None:
             mixed = self.mixed
+            mg = self.config.solver.precond == "mg"
 
             def _fb(data):
                 if mixed:
-                    return make_prec(self.ops32, data["f32"], "jacobi")
-                return make_prec(self.ops, data, "jacobi")
+                    inv = make_prec(self.ops32, data["f32"], "jacobi")
+                else:
+                    inv = make_prec(self.ops, data, "jacobi")
+                if mg:
+                    from pcg_mpi_solver_tpu.ops.mg import fallback_operand
+
+                    return fallback_operand(inv)
+                return inv
 
             self._fallback_prec_fn = jax.jit(jax.shard_map(
                 _fb, mesh=self.mesh, in_specs=(self._specs,),
-                out_specs=self._part_spec, check_vma=False))
+                out_specs=self._prec_operand_spec(), check_vma=False))
         with self._rec.dispatch("fallback_prec"):
             prec = self._fallback_prec_fn(self.data)
             jax.block_until_ready(prec)
@@ -1402,9 +1522,12 @@ class Solver:
             carry_specs = carry_part_specs(P, Rsp, fused=fused_v,
                                            many=True)
             # prec rides as ONE operand either way: the plain primary
-            # inverse, or the (primary, scalar-Jacobi fallback) pair the
-            # per-column ladder selects from via the carry's prec_sel
-            prec_specs = (P, P) if use_fb else P
+            # inverse (array, or the mg prec dict), or the (primary,
+            # scalar-Jacobi fallback) pair the per-column ladder selects
+            # from via the carry's prec_sel (the fallback is always the
+            # plain scalar array)
+            pspec = self._prec_operand_spec()
+            prec_specs = (pspec, P) if use_fb else pspec
 
             def _start(data, fb):
                 self._rec.inc("trace.step")
@@ -1499,6 +1622,7 @@ class Solver:
             backend=self.backend,
             solver=_dc.asdict(self.config.solver),
             pcg_variant=self.config.solver.pcg_variant,
+            precond=self.config.solver.precond,
             nrhs=R,
             trace_len=0,
             glob_n_dof_eff=int(self.pm.glob_n_dof_eff),
@@ -1510,6 +1634,7 @@ class Solver:
                    "pallas_planes": (pallas_planes()
                                      if self.pallas_variant != "off"
                                      else None),
+                   "mg": self._mg_meta,
                    "x64": bool(jax.config.jax_enable_x64)})
         exported = aot.cached_step(
             self._cache_dir, key, jax.jit(shard), abstract_args,
@@ -1688,8 +1813,13 @@ class Solver:
         self.step_times.append(wall)
         self._proc_step_times.append(wall)
         step_i = len(self.flags)
+        # time_to_tol_s: the ROADMAP-4 time-to-solution signal — wall to
+        # CONVERGED-at-tol, null on any non-0 flag (additive field; the
+        # bench stamps the same semantics on its result lines)
         self._rec.event("step", step=step_i, flag=flag, relres=relres,
-                        iters=iters, wall_s=round(wall, 6))
+                        iters=iters, wall_s=round(wall, 6),
+                        time_to_tol_s=(round(wall, 6) if flag == 0
+                                       else None))
         if self.trace_len and self.last_trace is not None:
             self._rec.event("resid_trace",
                             **self.last_trace.to_event_fields(step_i))
@@ -2074,13 +2204,33 @@ _REPLICATED_KEYS = frozenset(
 
 def _data_specs(data):
     """PartitionSpec pytree for the device data: per-type constant matrices
-    are replicated, everything else is sharded on the leading parts axis."""
+    are replicated, everything else is sharded on the leading parts axis.
+    The ``mg`` subtree (ops/mg.py) is special: only its ``fine``
+    transfer arrays carry the parts axis — the whole coarse hierarchy is
+    REPLICATED by design (that is what makes the coarse V-cycle
+    collective-free)."""
     P = jax.sharding.PartitionSpec
+
+    def const(node):
+        if isinstance(node, dict):
+            return {k: const(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(const(v) for v in node)
+        return P()
 
     def rec(node):
         if isinstance(node, dict):
-            return {k: (P() if k in _REPLICATED_KEYS else rec(v))
-                    for k, v in node.items()}
+            out = {}
+            for k, v in node.items():
+                if k in _REPLICATED_KEYS:
+                    out[k] = P()
+                elif k == "mg":
+                    out[k] = {kk: (rec(vv) if kk == "fine"
+                                   else const(vv))
+                              for kk, vv in v.items()}
+                else:
+                    out[k] = rec(v)
+            return out
         if isinstance(node, (list, tuple)):
             return type(node)(rec(v) for v in node)
         return P(PARTS_AXIS)

@@ -96,6 +96,17 @@ class MassShiftedOps:
         return invert_node_blocks(self.node_block_diag(data),
                                   self.base._as_node3(data["eff"]))
 
+    def apply_prec(self, m, r, data=None):
+        # the mg V-cycle must run on THIS (shifted) operator — the
+        # __getattr__ delegation below would bind mg_apply's ops to the
+        # unshifted base, whose defect matvecs would precondition K
+        # instead of A = K + c*M
+        if isinstance(m, dict):
+            from pcg_mpi_solver_tpu.ops.mg import mg_apply
+
+            return mg_apply(self, data, m, r)
+        return self.base.apply_prec(m, r)
+
     def __getattr__(self, name):
         if name in ("base", "c") or name.startswith("__"):
             raise AttributeError(name)
@@ -146,6 +157,7 @@ class NewmarkSolver:
                 f"SolverConfig.pcg_variant must be one of "
                 f"{VALID_PCG_VARIANTS}, got {scfg.pcg_variant!r}")
         self._rec.gauge("pcg_variant", scfg.pcg_variant)
+        self._rec.gauge("precond", scfg.precond)
         # Preflight gate (validate/): reject a pathological model/config
         # before the partition build below is paid.
         from pcg_mpi_solver_tpu.validate import run_preflight
@@ -203,6 +215,29 @@ class NewmarkSolver:
             pallas_mode=scfg.pallas, mesh=self.mesh,
             kernels_f32=self.mixed or dtype == jnp.float32,
             backend=backend)
+        self._mg_meta = None
+        self._mg_setup = None
+        if scfg.precond == "mg":
+            if self.backend != "general":
+                raise ValueError(
+                    "precond='mg' on the Newmark path is supported on "
+                    "the general backend only (the hybrid level-grid "
+                    "stencil costs minutes of compile per "
+                    "instantiation); use backend='general' or "
+                    "precond='jacobi'|'block3'")
+            # MG hierarchy (ops/mg.py): the level lattice preconditions
+            # the K part; the mass shift rides the fine level through
+            # this solver's shifted matvec/diag (MassShiftedOps.
+            # apply_prec) — coarse levels on K alone keep M^-1 SPD
+            from pcg_mpi_solver_tpu.ops import mg as mgmod
+
+            t_mg0 = time.perf_counter()
+            with self._rec.span("mg_setup"):
+                mg_setup = mgmod.build_mg_host(
+                    model, self.pm, n_levels=int(scfg.mg_levels),
+                    degree=int(scfg.mg_smooth_degree))
+            self._mg_meta = mg_setup.meta
+            self._mg_setup = (mg_setup, time.perf_counter() - t_mg0)
         data = mk_data(dtype)
 
         # Newmark coefficients (a-form)
@@ -216,6 +251,12 @@ class NewmarkSolver:
         cshift = self.a0 + self.a1 * self.damping
 
         base_ops = mk_ops(dot_dtype)
+        if scfg.precond == "mg":
+            from pcg_mpi_solver_tpu.ops import mg as mgmod
+
+            base_ops = dataclasses.replace(
+                base_ops, mg_degree=int(scfg.mg_smooth_degree),
+                mg_coarse_dofs=mgmod.coarse_dofs(self._mg_meta))
         self.ops = MassShiftedOps(base_ops, cshift)
 
         # Assembled lumped-mass diagonal, per-part (reference DiagM,
@@ -227,6 +268,12 @@ class NewmarkSolver:
         data["Vd"] = jnp.asarray(
             np.where(gid >= 0, model.Vd[np.maximum(gid, 0)], 0.0), dtype)
 
+        if scfg.precond == "mg":
+            from pcg_mpi_solver_tpu.ops import mg as mgmod
+
+            # float leaves at the storage dtype (same rule as driver.py)
+            data["mg"] = mgmod.cast_tree(self._mg_setup[0].tree, dtype)
+
         if self.mixed:
             data = {
                 "f64": data,
@@ -234,7 +281,14 @@ class NewmarkSolver:
                     lambda x: x.astype(jnp.float32)
                     if jnp.issubdtype(x.dtype, jnp.floating) else x, data),
             }
-            self.ops32 = MassShiftedOps(mk_ops(jnp.float32), cshift)
+            ops32_base = mk_ops(jnp.float32)
+            if scfg.precond == "mg":
+                from pcg_mpi_solver_tpu.ops import mg as mgmod
+
+                ops32_base = dataclasses.replace(
+                    ops32_base, mg_degree=int(scfg.mg_smooth_degree),
+                    mg_coarse_dofs=mgmod.coarse_dofs(self._mg_meta))
+            self.ops32 = MassShiftedOps(ops32_base, cshift)
         self._specs = _data_specs(data)
 
         from pcg_mpi_solver_tpu.parallel.distributed import put_sharded, put_tree
@@ -242,6 +296,8 @@ class NewmarkSolver:
         self.data = put_tree(data, self.mesh, self._specs)
         self._part_spec = jax.sharding.PartitionSpec(PARTS_AXIS)
         self._rep_spec = jax.sharding.PartitionSpec()
+        if scfg.precond == "mg":
+            self._finish_mg_setup()
         P, n_loc = self.pm.n_parts, self.pm.n_loc
         zeros = lambda: put_sharded(np.zeros((P, n_loc), dtype),
                                     self.mesh, self._part_spec)
@@ -306,7 +362,8 @@ class NewmarkSolver:
         P_, R_ = self._part_spec, self._rep_spec
         self._step_fn = jax.jit(jax.shard_map(
             _step, mesh=self.mesh,
-            in_specs=(self._specs, P_, P_, P_, P_, R_),
+            in_specs=(self._specs, self._prec_operand_spec(),
+                      P_, P_, P_, R_),
             out_specs=(P_, P_, P_, R_, R_, R_), check_vma=False))
 
         # In-graph convergence trace (obs/trace.py), chunked path only:
@@ -377,7 +434,8 @@ class NewmarkSolver:
                 mixed=self.mixed,
                 ops32=self.ops32 if self.mixed else None,
                 trace_len=self.trace_len, recorder=self._rec,
-                donate=self._donate)
+                donate=self._donate,
+                prec_spec=self._prec_operand_spec())
 
         # A = K + c*M is CONSTANT over the run (unlike the quasi-static
         # driver, whose per-step Jacobi rebuild is reference parity):
@@ -391,7 +449,7 @@ class NewmarkSolver:
 
         self._prec = jax.jit(jax.shard_map(
             _prec, mesh=self.mesh,
-            in_specs=(self._specs,), out_specs=P_,
+            in_specs=(self._specs,), out_specs=self._prec_operand_spec(),
             check_vma=False))(self.data)
 
         def _init_accel(data, u, v, delta0):
@@ -426,6 +484,38 @@ class NewmarkSolver:
         self.flags: List[int] = []
         self.relres: List[float] = []
         self.iters: List[int] = []
+
+    # ------------------------------------------------------------------
+    def _prec_operand_spec(self):
+        """shard_map spec (pytree) of the preconditioner operand: the
+        part spec for array inverses, the mg dict spec for precond='mg'
+        (mirrors driver.Solver._prec_operand_spec)."""
+        if self.config.solver.precond == "mg":
+            return {"mg_diag": self._part_spec, "fb": self._rep_spec}
+        return self._part_spec
+
+    def _finish_mg_setup(self):
+        """Post-upload MG setup (the Newmark twin of
+        driver.Solver._finish_mg_setup, without the partition-cache
+        shortcut — Newmark has no cache_dir wiring): estimate the fine
+        Chebyshev bound ON THE SHIFTED OPERATOR, then install the
+        per-level lambda vector + telemetry/warning through the shared
+        ``mg.install_lam_and_report``."""
+        from pcg_mpi_solver_tpu.ops import mg as mgmod
+
+        setup, t_build = self._mg_setup
+        data64 = self.data["f64"] if self.mixed else self.data
+        specs64 = self._specs["f64"] if self.mixed else self._specs
+        t0 = time.perf_counter()
+        with self._rec.span("mg_lam"):
+            lam_fine = mgmod.estimate_fine_lam(
+                self.ops, data64, self.mesh, specs64, self._part_spec)
+        trees = ([self.data["f64"], self.data["f32"]] if self.mixed
+                 else [self.data])
+        mgmod.install_lam_and_report(
+            setup, lam_fine, trees=trees, mesh=self.mesh,
+            rep_spec=self._rep_spec, recorder=self._rec,
+            wall_s=t_build + time.perf_counter() - t0, cached=False)
 
     # ------------------------------------------------------------------
     # Resilience (resilience/): recovery programs + step harness
@@ -478,15 +568,24 @@ class NewmarkSolver:
 
         if self._fallback_prec_fn is None:
             mixed = self.mixed
+            mg = self.config.solver.precond == "mg"
 
             def _fb(data):
                 if mixed:
-                    return make_prec(self.ops32, data["f32"], "jacobi")
-                return make_prec(self.ops, data, "jacobi")
+                    inv = make_prec(self.ops32, data["f32"], "jacobi")
+                else:
+                    inv = make_prec(self.ops, data, "jacobi")
+                if mg:
+                    # mg demotion: keep the compiled prec-operand shape,
+                    # flip the apply to the plain scalar branch
+                    from pcg_mpi_solver_tpu.ops.mg import fallback_operand
+
+                    return fallback_operand(inv)
+                return inv
 
             self._fallback_prec_fn = jax.jit(jax.shard_map(
                 _fb, mesh=self.mesh, in_specs=(self._specs,),
-                out_specs=self._part_spec, check_vma=False))
+                out_specs=self._prec_operand_spec(), check_vma=False))
         with self._rec.dispatch("fallback_prec"):
             prec = self._fallback_prec_fn(self.data)
             jax.block_until_ready(prec)

@@ -215,9 +215,10 @@ def pcg(
     data: dict,
     fext: jnp.ndarray,        # (P, n_loc) rhs, already restricted to eff dofs
     x0: jnp.ndarray,          # (P, n_loc) initial guess (eff-restricted)
-    inv_diag: jnp.ndarray,    # M^-1 on eff dofs (0 elsewhere): (P, n_loc)
-                              # scalar Jacobi, or (P, n_node_loc, 3, 3)
-                              # block-Jacobi (applied via ops.apply_prec)
+    inv_diag,                 # M^-1 on eff dofs (0 elsewhere): (P, n_loc)
+                              # scalar Jacobi, (P, n_node_loc, 3, 3)
+                              # block-Jacobi, or the mg V-cycle prec
+                              # dict (all applied via ops.apply_prec)
     tol,
     max_iter,                 # static int, or traced scalar (then pass
                               # max_iter_nominal for the MoreSteps budget)
@@ -511,9 +512,11 @@ def pcg(
         is_check = c["mode"] == 1
 
         def pre_iterate(c):
-            # scalar Jacobi inverse (P, n_loc) or block-Jacobi inverse
-            # (P, n_node_loc, 3, 3) — ops.apply_prec dispatches on rank
-            z = ops.apply_prec(inv_diag, c["r"])
+            # scalar Jacobi inverse (P, n_loc), block-Jacobi inverse
+            # (P, n_node_loc, 3, 3), or the mg V-cycle dict —
+            # ops.apply_prec dispatches on type/rank (data carries the
+            # mg hierarchy; unused by the array preconditioners)
+            z = ops.apply_prec(inv_diag, c["r"], data=data)
             # The inf-preconditioner predicate must agree across shards or
             # the while_loop exits divergently and collective counts
             # desync; fuse its global reduction into the rho psum (still
@@ -634,8 +637,9 @@ def pcg(
         is_check = c["mode"] == 1
 
         def pre_iterate(c):
-            # scalar or block-Jacobi inverse (classic pre_iterate's z)
-            return ops.apply_prec(inv_diag, c["r"])
+            # scalar/block-Jacobi inverse or mg V-cycle (classic
+            # pre_iterate's z)
+            return ops.apply_prec(inv_diag, c["r"], data=data)
 
         def pre_check(c):
             return c["x"]
@@ -1305,7 +1309,7 @@ def pcg_many(
         """Per-column preconditioner apply: the primary inverse, with
         ``prec_sel`` columns flipped to the fallback inverse when one is
         wired (collective-free — the psum budget is untouched)."""
-        z = ops.apply_prec(inv_diag, c["r"])
+        z = ops.apply_prec(inv_diag, c["r"], data=data)
         if inv_diag_fb is not None:
             z = _colsel(c["prec_sel"] > 0,
                         ops.apply_prec(inv_diag_fb, c["r"]), z)

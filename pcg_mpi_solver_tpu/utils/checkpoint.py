@@ -93,6 +93,12 @@ def _fingerprint(solver) -> dict:
         "dtype": str(np.dtype(solver.dtype)),
         "precision_mode": cfg.solver.precision_mode,
         "precond": cfg.solver.precond,
+        # MG-shape components (ISSUE 10): the V-cycle's level count /
+        # smoothing degree / lattice dims reshape both the traced apply
+        # and its numerical sequence — a resume across any of them must
+        # fail as a named mismatch.  "n/a" for non-mg solvers (and for
+        # every pre-mg record via the restore/load legacy shims).
+        "mg_shape": _mg_shape(solver),
         # the PCG loop formulation reshapes the resumable carry pytree
         # itself (the fused variant rides q/alpha/fresh recurrence
         # leaves) and changes the iteration sequence — a cross-variant
@@ -154,6 +160,17 @@ def _fingerprint(solver) -> dict:
         # construction like the kernel variant
         "f64_refresh": getattr(solver, "f64_refresh", "stencil"),
     }
+
+
+def _mg_shape(solver):
+    """The structural MG components of a solver configured with
+    precond='mg' (driver/newmark stamp ``_mg_meta`` at setup), else
+    "n/a" — JSON-stable for the fingerprint compare."""
+    meta = getattr(solver, "_mg_meta", None)
+    if not meta:
+        return "n/a"
+    return [int(meta["levels"]), int(meta["degree"]),
+            [int(v) for v in meta["dims"]]]
 
 
 def _combine_kd(solver) -> int | str:
@@ -312,6 +329,9 @@ class CheckpointManager:
             # Checkpoints written before the precond field existed can only
             # have come from the scalar-Jacobi path.
             saved.setdefault("precond", "jacobi")
+            # Checkpoints written before the mg_shape field existed can
+            # only have come from a non-mg preconditioner.
+            saved.setdefault("mg_shape", "n/a")
             # Checkpoints written before the pcg_variant field existed
             # can only have come from the classic loop.
             saved.setdefault("pcg_variant", "classic")
@@ -556,6 +576,13 @@ class SnapshotStore:
             # wiring existed can only have come from programs without
             # the fallback operand
             saved.setdefault("many_fallback", False)
+        if self.fingerprint is not None \
+                and "mg_shape" in self.fingerprint:
+            # snapshots written before the mg_shape field existed can
+            # only have come from a non-mg preconditioner — resuming
+            # them under precond='mg' still mismatches (on precond AND
+            # on "n/a" != the live shape), loudly
+            saved.setdefault("mg_shape", "n/a")
         if self.fingerprint is not None:
             # snapshots written before the fingerprint-completeness
             # sweep (analysis/) did not record these numerics knobs;

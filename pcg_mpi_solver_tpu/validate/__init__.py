@@ -15,12 +15,13 @@ Import contract: jax-free at module load (numpy only), matching
 """
 
 from pcg_mpi_solver_tpu.validate.preflight import (
-    CheckResult, PreflightError, check_rhs_block, preflight_checks,
-    resolve_policy, run_preflight)
+    CheckResult, PreflightError, check_mg_interval, check_rhs_block,
+    preflight_checks, resolve_policy, run_preflight)
 
 __all__ = [
     "CheckResult",
     "PreflightError",
+    "check_mg_interval",
     "check_rhs_block",
     "preflight_checks",
     "resolve_policy",

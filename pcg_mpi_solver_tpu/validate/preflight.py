@@ -287,6 +287,61 @@ def _check_explicit_dt(model, context) -> CheckResult:
     return CheckResult("explicit_dt", "ok")
 
 
+def _check_mg_hierarchy(model, scfg) -> CheckResult:
+    """precond='mg' eligibility (ISSUE 10): the model must expose a
+    coarsenable cell lattice BEFORE the partition build / minutes-scale
+    compile is paid — a non-power-of-two structured lattice, a scalar
+    (Poisson-class) model, or a model with no lattice metadata at all
+    would otherwise die mid-setup with a shape error.  Mirrors the named
+    reasons ``ops/mg.build_mg_host`` raises."""
+    if getattr(scfg, "precond", "jacobi") != "mg":
+        return CheckResult("mg_hierarchy", "ok")
+    if int(model.n_dof) != 3 * int(model.n_node):
+        return CheckResult(
+            "mg_hierarchy", "fail",
+            "precond='mg' needs the vector (3-dof/node) problem class; "
+            f"this model has n_dof={model.n_dof}, n_node={model.n_node}")
+    from pcg_mpi_solver_tpu.ops.mg import (
+        MGSetupError, fine_lattice, plan_levels)
+
+    dims, _lat = fine_lattice(model)
+    if dims is None:
+        return CheckResult(
+            "mg_hierarchy", "fail",
+            "precond='mg' needs lattice metadata (ModelData.grid or "
+            ".octree); this model has neither — use precond='jacobi'")
+    try:
+        plan_levels(dims, int(getattr(scfg, "mg_levels", 0)))
+    except MGSetupError as e:
+        return CheckResult("mg_hierarchy", "fail", str(e))
+    return CheckResult("mg_hierarchy", "ok")
+
+
+def check_mg_interval(lmin: float, lmax: float) -> CheckResult:
+    """Degenerate Chebyshev interval diagnostic for the MG smoother
+    (ISSUE 10 satellite): the setup-time eigenvalue estimates
+    [lambda_min, lambda_max] of the coarsest level's D^-1 A.  A ratio
+    under 1.05 means the level operator is numerically a multiple of
+    its diagonal — the Chebyshev polynomial degenerates and the coarse
+    correction adds nothing (usually a sign the hierarchy coarsened
+    into triviality or the estimates failed).  Warn, never fail: the
+    V-cycle is still a valid SPD preconditioner, just a weak one."""
+    if not (math.isfinite(lmax) and lmax > 0):
+        return CheckResult(
+            "mg_cheb_interval", "warn",
+            f"estimated lambda_max={lmax!r} is not a positive finite "
+            "number; the Chebyshev smoother interval is meaningless")
+    lo = max(float(lmin), 0.0)
+    if lo > 0 and lmax / lo < 1.05:
+        return CheckResult(
+            "mg_cheb_interval", "warn",
+            f"estimated Chebyshev interval is degenerate "
+            f"(lambda_max/lambda_min = {lmax / lo:.4f} < 1.05): the "
+            "level operator is numerically a multiple of its diagonal "
+            "— the mg coarse correction adds ~nothing over Jacobi")
+    return CheckResult("mg_cheb_interval", "ok")
+
+
 def check_rhs_block(fexts: Any, n_dof: int) -> List[CheckResult]:
     """Per-column validation of a blocked right-hand side (the
     ``Solver.solve_many`` request gate): shape contract per RHS and a
@@ -383,6 +438,7 @@ def preflight_checks(model, config=None,
         results.append(_check_solver_params(scfg))
         results.append(_check_tol_floor(scfg))
         results.append(_check_snapshot_cadence(config, context))
+        results.append(_check_mg_hierarchy(model, scfg))
     if (context or {}).get("kind") == "dynamics":
         results.append(_check_explicit_dt(model, context))
     return results

@@ -1,0 +1,527 @@
+"""Geometric multigrid V-cycle preconditioner (ISSUE 10, ops/mg.py).
+
+The acceptance contracts, each as a tier-1 CPU test:
+
+* the V-cycle is a FIXED symmetric PSD linear operator (dense M^-1 on a
+  tiny model via one blocked apply; two applies bitwise identical) — so
+  plain non-flexible PCG stays valid;
+* precond="mg" converges in >= 5x fewer PCG iterations than "jacobi" at
+  identical tolerance on the heterogeneous golden-class cube;
+* the traced while-body collective histogram equals
+  ``Ops.body_collective_budget(variant, precond="mg")`` at nrhs in
+  {1, 8} for BOTH pcg variants (general) and for the structured slab
+  (ppermute accounting), and the replicated coarse cycle — smoother
+  included — contributes ZERO collectives;
+* blocked ``pcg_many`` + mg: column bit-parity across block widths;
+* the full resilience stack: kill-and-resume bit-identical, the ladder
+  demotes mg -> scalar-Jacobi fallback without aborting, cross-precond
+  resume is a NAMED fingerprint mismatch;
+* preflight rejects un-coarsenable models with a named reason; the
+  degenerate Chebyshev interval check warns.
+
+Runtime discipline: solver builds dominate tier-1 wall on the 8-way
+virtual CPU mesh, so the module shares builds through module-scoped
+fixtures and uses 2-device meshes wherever the contract allows.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pcg_mpi_solver_tpu.config import RunConfig, SolverConfig, TimeHistoryConfig
+from pcg_mpi_solver_tpu.models import make_cube_model
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+from pcg_mpi_solver_tpu.resilience import FaultPlan
+from pcg_mpi_solver_tpu.solver.driver import Solver
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    monkeypatch.setenv("PCG_TPU_RETRY_BACKOFF_S", "0.01")
+
+
+@pytest.fixture(scope="module")
+def model():
+    # 8x8x8 h=0.5 heterogeneous: the golden-class cube (test_goldens.py
+    # pins 6x5x5, whose odd dims cannot coarsen) at an even,
+    # two-level-coarsenable size
+    return make_cube_model(8, 8, 8, h=0.5, nu=0.3, heterogeneous=True,
+                           seed=0)
+
+
+@pytest.fixture(scope="module")
+def model_small():
+    return make_cube_model(8, 4, 4, h=0.5, nu=0.3, heterogeneous=True,
+                           seed=0)
+
+
+def _cfg(precond="mg", scratch=None, run_id="1", **sk):
+    skw = dict(tol=1e-8, max_iter=2000, precond=precond)
+    skw.update(sk)
+    cfg = RunConfig(
+        solver=SolverConfig(**skw),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0],
+                                       export_flag=False))
+    if scratch is not None:
+        cfg.scratch_path = str(scratch)
+        cfg.run_id = run_id
+    return cfg
+
+
+def _solve(model, precond, backend="general", n_dev=2, **sk):
+    s = Solver(model, _cfg(precond, **sk), mesh=make_mesh(n_dev),
+               n_parts=n_dev, backend=backend)
+    return s, s.step(1.0)
+
+
+@pytest.fixture(scope="module")
+def general_mg(model):
+    """The reference mg solve on the golden-class cube (shared by the
+    iteration-regression and cross-backend tests)."""
+    s, r = _solve(model, "mg", n_dev=4)
+    return s, r, s.displacement_global()
+
+
+@pytest.fixture(scope="module")
+def small_mg(model_small):
+    """Shared small mg solver + solve (variant/mixed parity, blocked
+    solve_many reuse)."""
+    s, r = _solve(model_small, "mg")
+    return s, r, s.displacement_global()
+
+
+@pytest.fixture(scope="module")
+def small_jacobi(model_small):
+    """Shared small jacobi solver (default-untouched + cross-precond
+    fingerprint tests)."""
+    s, r = _solve(model_small, "jacobi")
+    return s, r
+
+
+# ----------------------------------------------------------------------
+# The headline: iteration count
+# ----------------------------------------------------------------------
+
+def test_mg_cuts_iterations_5x_vs_jacobi(model, general_mg):
+    """precond='mg' must converge in >= 5x fewer PCG iterations than
+    'jacobi' at identical tolerance, to the same solution (measured
+    here: ~151 vs ~14)."""
+    _sm, rm, um = general_mg
+    sj, rj = _solve(model, "jacobi", n_dev=4)
+    assert rj.flag == 0 and rm.flag == 0
+    assert 5 * rm.iters <= rj.iters, (rm.iters, rj.iters)
+    uj = sj.displacement_global()
+    np.testing.assert_allclose(um, uj, rtol=1e-6,
+                               atol=1e-7 * np.abs(uj).max())
+
+
+def test_mg_structured_backend_matches_general(model, general_mg):
+    _sg, rg, ug = general_mg
+    ss, rs = _solve(model, "mg", backend="structured", n_dev=8)
+    assert rs.flag == 0
+    assert abs(rs.iters - rg.iters) <= 2
+    np.testing.assert_allclose(ss.displacement_global(), ug, rtol=1e-6,
+                               atol=1e-9)
+
+
+def test_mg_fused_variant_and_mixed_mode(model_small, small_mg):
+    _s0, r0, u0 = small_mg
+    sf, rf = _solve(model_small, "mg", pcg_variant="fused")
+    sm, rm = _solve(model_small, "mg", precision_mode="mixed")
+    assert r0.flag == 0 and rf.flag == 0 and rm.flag == 0
+    scale = np.abs(u0).max()
+    assert np.abs(sf.displacement_global() - u0).max() / scale < 1e-6
+    assert np.abs(sm.displacement_global() - u0).max() / scale < 1e-6
+
+
+def test_mg_jacobi_default_untouched(small_jacobi):
+    """precond='jacobi' must not see any of the mg plumbing: no mg data
+    subtree, the plain array prec operand, the old collective budget,
+    the 'n/a' fingerprint component."""
+    from pcg_mpi_solver_tpu.utils.checkpoint import _fingerprint
+
+    s, r = small_jacobi
+    assert r.flag == 0
+    assert "mg" not in s.data
+    assert s._mg_meta is None
+    assert s.ops.body_collective_budget("classic") == {"psum": 5}
+    assert _fingerprint(s)["mg_shape"] == "n/a"
+
+
+# ----------------------------------------------------------------------
+# Fixed symmetric PSD operator
+# ----------------------------------------------------------------------
+
+def test_vcycle_operator_symmetric_psd_and_fixed():
+    """Dense M^-1 (applied to every basis vector via ONE blocked apply)
+    must be symmetric PSD, strictly positive on effective dofs, and
+    FIXED — two applies to the same block bitwise identical (the
+    non-flexible-CG validity contract)."""
+    m2 = make_cube_model(2, 2, 2, h=1.0, nu=0.3)
+    s = Solver(m2, _cfg("mg"), mesh=make_mesh(2), n_parts=2,
+               backend="general")
+    P = s._part_spec
+
+    def apply_block(data, rb):
+        m = s._make_prec(s.ops, data)
+        return s.ops.apply_prec(m, rb, data=data)
+
+    fn = jax.jit(jax.shard_map(apply_block, mesh=s.mesh,
+                               in_specs=(s._specs, P), out_specs=P,
+                               check_vma=False))
+    n = m2.n_dof
+    gid = np.asarray(s.pm.dof_gid)
+    loc = np.eye(n)[np.clip(gid, 0, None), :] * (gid >= 0)[..., None]
+    from pcg_mpi_solver_tpu.parallel.distributed import put_sharded
+
+    rb = put_sharded(np.ascontiguousarray(loc), s.mesh, P)
+    out1 = fn(s.data, rb)
+    out2 = fn(s.data, rb)
+    np.testing.assert_array_equal(np.asarray(out1),
+                                  np.asarray(out2))  # fixed, bitwise
+    M = s.displacement_global_many(out1)
+    scale = np.abs(M).max()
+    assert np.abs(M - M.T).max() / scale < 1e-12   # symmetric
+    eigs = np.linalg.eigvalsh(0.5 * (M + M.T))
+    assert eigs.min() >= -1e-12 * eigs.max()       # PSD
+    eff = np.zeros(n, bool)
+    eff[np.asarray(m2.dof_eff)] = True
+    assert (np.diag(M)[eff] > 0).all()             # SPD on eff dofs
+    assert np.abs(M[~eff]).max() == 0.0            # fixed dofs untouched
+
+
+# ----------------------------------------------------------------------
+# Static collective budgets (the acceptance matrix)
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend,variant",
+                         [("general", "classic"), ("general", "fused"),
+                          ("structured", "classic")])
+def test_mg_body_collective_budget_proven(backend, variant):
+    """The traced while-body collective histogram must EQUAL the
+    declared mg budget at nrhs in {1, 8}: collective count independent
+    of the block width, and every collective accounted to fine matvec
+    assembly or THE restriction psum (the smoother contributes none)."""
+    from pcg_mpi_solver_tpu.analysis import jaxpr_utils as ju
+    from pcg_mpi_solver_tpu.analysis import programs as ap
+
+    s = ap.build_solver(backend, nx=4, precond="mg", pcg_variant=variant)
+    budget = s.ops.body_collective_budget(variant, precond="mg")
+    for nrhs in (1, 8):
+        jx = ap.step_jaxpr(s) if nrhs == 1 else ap.many_jaxpr(s, nrhs)
+        hists = [h for h in ju.body_collective_histograms(jx) if h]
+        assert len(hists) == 1, hists
+        assert hists[0] == budget, (nrhs, hists[0], budget)
+    # arithmetic of the declaration: base body + 2*degree matvec
+    # assemblies + 1 restriction — nothing attributable to the smoother
+    from pcg_mpi_solver_tpu.ops.matvec import (
+        MG_RESTRICT_PSUMS, precond_cycle_cost)
+
+    base = s.ops.body_collective_budget(variant, precond="jacobi")
+    mv, ps = precond_cycle_cost("mg", s.ops.mg_degree)
+    assert mv == 2 * s.ops.mg_degree and ps == MG_RESTRICT_PSUMS
+    if backend == "general":
+        assert budget["psum"] == base["psum"] + mv + ps
+    else:
+        assert budget["psum"] == base["psum"] + ps
+        assert budget["ppermute"] == base["ppermute"] * (1 + mv)
+
+
+def test_mg_coarse_cycle_is_collective_free():
+    """The replicated coarse hierarchy — Chebyshev smoothers, level
+    transfers, the coarsest sweep — must trace to ZERO collective
+    primitives (the 'smoother contributes zero collectives' claim,
+    statically)."""
+    from pcg_mpi_solver_tpu.analysis.jaxpr_utils import count_primitive
+    from pcg_mpi_solver_tpu.ops import mg as mgmod
+    from pcg_mpi_solver_tpu.parallel.partition import partition_model
+
+    m = make_cube_model(4, 4, 4)
+    pm = partition_model(m, 2)
+    setup = mgmod.build_mg_host(m, pm)
+    tree = jax.tree.map(jnp.asarray, setup.tree)
+    tree["lam"] = jnp.asarray([4.0] + setup.coarse_lams)
+    n0 = tree["levels"][0]["idiag"].shape[0]
+
+    def coarse(rc):
+        return mgmod._coarse_vcycle(tree, 0, rc, 2)
+
+    jx = jax.make_jaxpr(coarse)(jnp.zeros((n0, 3)))
+    for prim in ("psum", "ppermute", "all_gather", "all_to_all"):
+        assert count_primitive(jx.jaxpr, prim) == 0, prim
+
+
+def test_unknown_precond_is_loud_keyerror():
+    from pcg_mpi_solver_tpu.ops.matvec import Ops, precond_cycle_cost
+
+    with pytest.raises(KeyError):
+        precond_cycle_cost("frobnicate")
+    ops = Ops(n_loc=8, n_iface=2)
+    with pytest.raises(KeyError):
+        ops.body_collective_budget("classic", precond="frobnicate")
+    with pytest.raises(KeyError):
+        ops.comm_estimate(precond="frobnicate")
+
+
+# ----------------------------------------------------------------------
+# Blocked multi-RHS
+# ----------------------------------------------------------------------
+
+def test_mg_pcg_many_column_bit_parity(model_small, small_mg):
+    """A column of an nrhs=2 mg block must reproduce the same column of
+    an nrhs=1 mg block bit-identically (block-width independence — the
+    PR-6 contract extended to the V-cycle preconditioner)."""
+    s = small_mg[0]
+    F = np.asarray(model_small.F)
+    fb = np.stack([F, 0.5 * F], axis=-1)
+    res2 = s.solve_many(fb)
+    assert list(res2.flags) == [0, 0]
+    res1 = s.solve_many(F[:, None])
+    u2 = s.displacement_global_many(res2.x)
+    u1 = s.displacement_global_many(res1.x)
+    np.testing.assert_array_equal(u2[:, 0], u1[:, 0])
+    assert int(res2.iters[0]) == int(res1.iters[0])
+
+
+def test_mg_pcg_many_chunked_with_column_fault(model_small):
+    """Per-column resilience rides mg: a NaN-poisoned column recovers
+    through its own ladder (rung 2 = the scalar-Jacobi inv_diag_fb)
+    while the healthy column completes."""
+    cfg = _cfg("mg", iters_per_dispatch=5, max_recoveries=2)
+    s = Solver(model_small, cfg, mesh=make_mesh(2), n_parts=2,
+               backend="general")
+    F = np.asarray(model_small.F)
+    fb = np.stack([F, 0.5 * F], axis=-1)
+    s.fault_plan = FaultPlan("nan@col:1", recorder=s.recorder)
+    res = s.solve_many(fb)
+    assert list(res.flags) == [0, 0], (res.flags, res.quarantined)
+    assert res.recoveries >= 1
+
+
+# ----------------------------------------------------------------------
+# Resilience: kill/resume, ladder demotion, cross-precond resume
+# ----------------------------------------------------------------------
+
+def test_mg_kill_and_resume_bit_identical(model_small, tmp_path):
+    """An uninterrupted chunked mg solve vs kill-at-chunk-2 + resume
+    must be bit-identical (the mg carry/prec state rides the snapshot
+    like every other resumable leaf)."""
+    from pcg_mpi_solver_tpu.resilience.faultinject import SimulatedKill
+
+    def mk(run_id):
+        cfg = _cfg("mg", scratch=tmp_path, run_id=run_id,
+                   iters_per_dispatch=5)
+        cfg.snapshot_every = 1
+        return cfg
+
+    sa = Solver(model_small, mk("a"), mesh=make_mesh(2), n_parts=2)
+    sa.solve()
+    cb = mk("b")
+    sk = Solver(model_small, cb, mesh=make_mesh(2), n_parts=2)
+    sk.fault_plan = FaultPlan("kill@2")
+    with pytest.raises(SimulatedKill):
+        sk.solve()
+    sk2 = Solver(model_small, cb, mesh=make_mesh(2), n_parts=2)
+    sk2.solve(resume=True)
+    assert sk2.flags == sa.flags and sk2.iters == sa.iters
+    np.testing.assert_array_equal(sk2.displacement_global(),
+                                  sa.displacement_global())
+
+
+def test_mg_cross_precond_resume_named_mismatch(small_jacobi, small_mg,
+                                                tmp_path):
+    """A snapshot written under jacobi must refuse to load under mg with
+    a mismatch NAMING precond + mg_shape — never a pytree error deep in
+    the dispatch (tested at the exact guard layer, SnapshotStore.load)."""
+    from pcg_mpi_solver_tpu.utils.checkpoint import (
+        SnapshotStore, _fingerprint)
+
+    store_j = SnapshotStore(str(tmp_path), _fingerprint(small_jacobi[0]))
+    store_j.save(1, {"kind": "direct", "total": np.int64(5)})
+    store_m = SnapshotStore(str(tmp_path), _fingerprint(small_mg[0]))
+    with pytest.raises(ValueError) as ei:
+        store_m.load(1)
+    assert "precond" in str(ei.value) and "mg_shape" in str(ei.value)
+
+
+def test_mg_ladder_demotes_to_scalar_jacobi(model_small, tmp_path):
+    """Two injected NaN carries must walk the ladder restart ->
+    fallback_prec (the mg->scalar-Jacobi DEMOTION: the compiled cycle's
+    fb switch, no recompilation, no abort) and still converge."""
+    from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
+
+    class Cap:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, ev):
+            self.events.append(ev)
+
+        def close(self):
+            pass
+
+    cap = Cap()
+    rec = MetricsRecorder(sinks=[cap])
+    cfg = _cfg("mg", scratch=tmp_path, iters_per_dispatch=5,
+               max_recoveries=2)
+    s = Solver(model_small, cfg, mesh=make_mesh(2), n_parts=2,
+               recorder=rec)
+    s.fault_plan = FaultPlan("nan@1, nan@3", recorder=rec)
+    r = s.step(1.0)
+    acts = [e["action"] for e in cap.events if e["kind"] == "recovery"]
+    assert acts == ["restart_minres", "fallback_prec"], acts
+    assert r.flag == 0 and r.relres <= 1e-7
+    # the demoted prec keeps the mg operand SHAPE with fb=1 (the cycle
+    # program is reused, not recompiled)
+    fb = s._fallback_prec()
+    assert isinstance(fb, dict) and int(fb["fb"]) == 1
+
+
+def test_mg_fallback_kind_and_ladder_rungs():
+    from pcg_mpi_solver_tpu.ops.precond import fallback_kind
+    from pcg_mpi_solver_tpu.resilience.recovery import RecoveryLadder
+
+    assert fallback_kind("mg") == "jacobi"
+    lad = RecoveryLadder(precond="mg", mixed=False, max_recoveries=3)
+    assert lad.next_action("flag4") == "restart_minres"
+    assert lad.next_action("flag4") == "fallback_prec"
+
+
+# ----------------------------------------------------------------------
+# Preflight / validate
+# ----------------------------------------------------------------------
+
+def test_preflight_rejects_uncoarsenable_lattice():
+    from pcg_mpi_solver_tpu.validate import PreflightError
+
+    m5 = make_cube_model(5, 5, 5)
+    with pytest.raises(PreflightError, match="mg_hierarchy"):
+        Solver(m5, _cfg("mg"), mesh=make_mesh(1), n_parts=1)
+
+
+def test_preflight_rejects_overdeep_mg_levels(model_small):
+    from pcg_mpi_solver_tpu.validate import PreflightError
+
+    with pytest.raises(PreflightError, match="mg_levels"):
+        Solver(model_small, _cfg("mg", mg_levels=5), mesh=make_mesh(1),
+               n_parts=1)
+
+
+def test_mg_rejected_on_hybrid_backend():
+    from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+    m = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)
+    with pytest.raises(ValueError, match="hybrid"):
+        Solver(m, _cfg("mg"), mesh=make_mesh(2), n_parts=2,
+               backend="hybrid")
+
+
+def test_mg_octree_model_on_general_backend():
+    """An octree model (graded leaves, transition types) builds its
+    hierarchy from the octree lattice metadata and converges faster
+    than jacobi on the general backend."""
+    from pcg_mpi_solver_tpu.models.octree import make_octree_model
+
+    m = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3,
+                          load="traction", load_value=1.0)
+    sj, rj = _solve(m, "jacobi", backend="general")
+    sm, rm = _solve(m, "mg", backend="general")
+    assert rj.flag == 0 and rm.flag == 0
+    assert rm.iters < rj.iters, (rm.iters, rj.iters)
+    uj, um = sj.displacement_global(), sm.displacement_global()
+    np.testing.assert_allclose(um, uj, rtol=1e-4,
+                               atol=1e-7 * np.abs(uj).max())
+
+
+def test_check_mg_interval_degenerate_warns():
+    from pcg_mpi_solver_tpu.validate import check_mg_interval
+
+    assert check_mg_interval(1.0, 4.0).status == "ok"
+    chk = check_mg_interval(1.0, 1.01)
+    assert chk.status == "warn" and "degenerate" in chk.detail
+    assert check_mg_interval(0.5, float("nan")).status == "warn"
+
+
+def test_mg_setup_event_gauges_and_fingerprint(model_small):
+    from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
+    from pcg_mpi_solver_tpu.obs.schema import validate_event
+    from pcg_mpi_solver_tpu.utils.checkpoint import _fingerprint
+
+    class Cap:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, ev):
+            self.events.append(ev)
+
+        def close(self):
+            pass
+
+    cap = Cap()
+    rec = MetricsRecorder(sinks=[cap])
+    s = Solver(model_small, _cfg("mg"), mesh=make_mesh(2), n_parts=2,
+               recorder=rec)
+    ev = [e for e in cap.events if e["kind"] == "mg_setup"]
+    assert len(ev) == 1 and validate_event(ev[0]) == []
+    assert ev[0]["levels"] == 2 and ev[0]["lam_fine"] > 0
+    assert rec.gauges["precond"] == "mg"
+    assert rec.gauges["mg.levels"] == 2
+    # comm gauges are precond-aware and read the same declared table
+    est = s.ops.comm_estimate(variant="classic", precond="mg")
+    assert est["precond"] == "mg"
+    assert est["psums_per_iter"] > s.ops.comm_estimate(
+        variant="classic", precond="jacobi")["psums_per_iter"]
+    # the snapshot fingerprint carries the structural mg shape
+    fp = _fingerprint(s)
+    assert fp["precond"] == "mg"
+    levels, degree, dims = fp["mg_shape"]
+    assert (levels, degree, dims) == (2, 2, [8, 4, 4])
+    # the step event carries the time_to_tol_s time-to-solution field
+    r = s.step(1.0)
+    step_ev = [e for e in cap.events if e["kind"] == "step"][-1]
+    assert step_ev["time_to_tol_s"] is not None and r.flag == 0
+
+
+def test_mg_warm_cache_reuses_partition_and_lam(model_small, tmp_path):
+    """With cache_dir set, the second construction serves both the
+    partition AND the mg fine-level eigenvalue bound from the cache
+    (the 'cached in the partition cache' satellite), bit-identically."""
+    def mk():
+        cfg = _cfg("mg")
+        cfg.cache_dir = str(tmp_path / "cache")
+        return cfg
+
+    s1 = Solver(model_small, mk(), mesh=make_mesh(2), n_parts=2)
+    r1 = s1.step(1.0)
+    s2 = Solver(model_small, mk(), mesh=make_mesh(2), n_parts=2)
+    r2 = s2.step(1.0)
+    assert s2.setup_cache == "warm"
+    hits = s2.recorder.counters.get("cache.partition.hit", 0)
+    assert hits >= 2          # partition + mg lam entries
+    assert r1.iters == r2.iters
+    np.testing.assert_array_equal(s1.displacement_global(),
+                                  s2.displacement_global())
+
+
+def test_mg_aot_key_structural_component():
+    """precond is a structural AOT-key component: jacobi/mg programs
+    must never collide even with an empty solver dict."""
+    from pcg_mpi_solver_tpu.cache.keys import step_cache_key
+
+    kw = dict(abstract="a", mesh="m", backend="b", solver={},
+              trace_len=0, glob_n_dof_eff=1, donate=True,
+              jax_version="j", pcg_variant="classic", nrhs=1)
+    assert step_cache_key(precond="jacobi", **kw) \
+        != step_cache_key(precond="mg", **kw)
+
+
+def test_cli_demo_with_mg(tmp_path, capsys):
+    """`pcg-tpu demo --precond mg` end to end (the --precond plumbing)."""
+    from pcg_mpi_solver_tpu.cli import main
+
+    main(["demo", "--nx", "4", "--precond", "mg", "--precision",
+          "direct", "--scratch", str(tmp_path / "scratch")])
+    out = capsys.readouterr().out
+    assert "flag=0" in out

@@ -27,9 +27,15 @@ Presets:
   1. flagship classic     — the 10.33M-dof ms/iter anchor (mixed)
   2. flagship fused       — PR-5's single-reduction loop, FIRST hardware
                             measurement (BENCH_PCG_VARIANT=fused)
-  3. nrhs sweep 4, 16     — batched multi-RHS throughput A/B
+  3. MG A/B               — classic+jacobi vs classic+mg at a
+                            multi-level-coarsenable size (BENCH_NX=144;
+                            BENCH_PRECOND=mg): iters + ms/iter +
+                            detail.time_to_tol_s — the ISSUE-10
+                            iteration-count lever, first hardware
+                            measurement
+  4. nrhs sweep 4, 16     — batched multi-RHS throughput A/B
                             (BENCH_NRHS; detail.dof_iter_rhs_per_s)
-  4. Pallas v9 A/B        — first-ever hardware execution of the kernel
+  5. Pallas v9 A/B        — first-ever hardware execution of the kernel
                             family (the hw_v9_ab.py step)
   Step 0.5 (between lint and the flagship) is the blocked-resilience
   smoke: a tiny solve_many with an injected per-column fault, proving
@@ -232,6 +238,17 @@ def run_priority_queue(path, quick: bool):
              env_extra=dict(cache, **size), timeout=3600)
     run_step(path, "flagship fused", ["bench.py"],
              env_extra=dict(cache, BENCH_PCG_VARIANT="fused", **size),
+             timeout=3600)
+    # MG A/B (ISSUE 10): classic+jacobi anchor vs classic+mg at an
+    # even, multi-level-coarsenable size (150 halves once to 75 and
+    # stops; 144 = 16*9 gives the 72/36/18/9 coarse chain), sharing the
+    # warm cache dir — read iters + tpu_ms_per_iter + time_to_tol_s off
+    # the two lines (detail.precond labels them).
+    mg_size = {"BENCH_NX": "24" if quick else "144"}
+    run_step(path, "mg A/B anchor (jacobi)", ["bench.py"],
+             env_extra=dict(cache, **mg_size), timeout=3600)
+    run_step(path, "mg A/B (mg)", ["bench.py"],
+             env_extra=dict(cache, BENCH_PRECOND="mg", **mg_size),
              timeout=3600)
     for nrhs in ("4", "16"):
         run_step(path, f"nrhs sweep ({nrhs})", ["bench.py"],
