@@ -23,7 +23,9 @@ from pcg_mpi_solver_tpu.ops.matvec import (
 # Documented while-body psum counts on a 2-part GENERAL partition (the
 # interface-assembly psum is present; both conditional branches of the
 # body, including the deferred mode-1 true-residual check, are part of
-# the traced body jaxpr): classic 3+1+1 = 5, fused 1+1+1 = 3.
+# the traced body jaxpr): classic 3+1+1 = 5, fused 1+1+1 = 3,
+# pipelined 1+1+1 = 3 (same count as fused — its win is the psum's
+# data-independence from the stencil, proven by the psum-overlap rule).
 EXPECTED_BODY_PSUMS = {
     variant: scalar + 1 + PCG_DEFERRED_CHECK_PSUMS
     for variant, scalar in PCG_SCALAR_PSUMS.items()

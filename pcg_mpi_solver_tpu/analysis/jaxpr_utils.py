@@ -137,6 +137,152 @@ def oversized_loop_consts(closed_jaxpr, threshold_elems: int) -> List[dict]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Collective dependency analysis (the psum-overlap rule): flatten one
+# while-loop body — inlining nested programs (cond branches, pjit calls)
+# via positional operand mapping — and compute, per collective primitive
+# occurrence, the set of OTHER collective occurrences whose outputs it
+# transitively consumes.  Two collectives with no path between them in
+# either direction are data-independent: the scheduler is free to run
+# them (and the compute between them) concurrently, which is exactly the
+# latency-hiding property the pipelined PCG body claims.  Lowering can
+# only preserve or relax this structure (XLA never invents a data
+# dependence), so independence proven on the jaxpr holds for the
+# compiled program.
+# ---------------------------------------------------------------------------
+
+_EMPTY = frozenset()
+
+
+def _sub_invar_deps(eqn, sub, in_deps):
+    """Dependency sets for a nested jaxpr's invars, mapped positionally
+    from the enclosing equation's operands: 1:1 for call-like primitives
+    (pjit, custom_*), offset-1 for cond (invars = [index] + operands),
+    conservative all-operands union otherwise."""
+    n_outer, n_inner = len(eqn.invars), len(sub.invars)
+    if n_inner == n_outer:
+        pairs = list(zip(sub.invars, in_deps))
+    elif n_inner == n_outer - 1 and eqn.primitive.name == "cond":
+        pairs = list(zip(sub.invars, in_deps[1:]))
+    else:
+        union = frozenset().union(*in_deps) if in_deps else _EMPTY
+        pairs = [(v, union) for v in sub.invars]
+    env = {id(v): d for v, d in pairs}
+    for cv in getattr(sub, "constvars", ()):
+        # host constants carry no runtime dependency
+        env.setdefault(id(cv), _EMPTY)
+    return env
+
+
+def collective_dependencies(jaxpr, names=("psum", "ppermute", "all_gather",
+                                          "all_to_all", "pmax", "pmin")
+                            ) -> List[dict]:
+    """One record per collective occurrence in ``jaxpr`` (recursively,
+    program order): ``{"id", "primitive", "out_size", "depends_on"}``
+    where ``depends_on`` is the frozenset of earlier records' ids whose
+    outputs this occurrence transitively consumes.  Loop-bearing nested
+    programs (while/scan inside the analyzed body) are handled
+    CONSERVATIVELY: their loop feedback can wire anything to anything
+    across trips, so every collective found inside one is marked
+    dependent on ITSELF (its own prior-trip occurrence) and on every
+    other collective of the same nested loop — over-approximating
+    dependence, never under-approximating it (the safe direction for
+    an independence proof; a lone psum inside a nested loop must not
+    read as overlappable)."""
+    records: List[dict] = []
+
+    def walk(jaxpr, env, loop_depth=0):
+        def dep_of(v):
+            return env.get(id(v), _EMPTY)
+
+        for eqn in jaxpr.eqns:
+            in_deps = [dep_of(v) for v in eqn.invars]
+            base = frozenset().union(*in_deps) if in_deps else _EMPTY
+            name = eqn.primitive.name
+            subs = sub_jaxprs(eqn)
+            if name in names:
+                rid = len(records)
+                aval = getattr(eqn.outvars[0], "aval", None)
+                size = 1
+                for d in getattr(aval, "shape", ()) or ():
+                    size *= int(d)
+                if loop_depth > 0:
+                    # inside a nested while/scan: the collective's
+                    # prior-trip occurrence can feed this one through
+                    # loop carry, so it is SELF-dependent — even when
+                    # it is the only collective in the nested loop
+                    # (the `inner` mutual marking below is vacuous for
+                    # a singleton)
+                    base = base | {rid}
+                records.append({"id": rid, "primitive": name,
+                                "out_size": size, "depends_on": base})
+                out_dep = base | {rid}
+                for v in eqn.outvars:
+                    env[id(v)] = out_dep
+                continue
+            if subs:
+                looping = name in ("while", "scan")
+                out_union = base
+                per_pos = None
+                inner_ids = []
+                for sub in subs:
+                    sub_env = _sub_invar_deps(eqn, sub, in_deps)
+                    before = len(records)
+                    walk(sub, sub_env, loop_depth + (1 if looping else 0))
+                    inner_ids.extend(range(before, len(records)))
+                    outs = [sub_env.get(id(v), _EMPTY)
+                            for v in sub.outvars]
+                    out_union = out_union.union(*outs) if outs \
+                        else out_union
+                    if (per_pos is not None
+                            and len(outs) == len(per_pos)):
+                        per_pos = [a | b for a, b in zip(per_pos, outs)]
+                    elif per_pos is None:
+                        per_pos = outs
+                    else:
+                        per_pos = None
+                if looping and inner_ids:
+                    # loop feedback: mark the nested collectives mutually
+                    # dependent (conservative), and the loop outputs
+                    # dependent on all of them
+                    inner = frozenset(inner_ids)
+                    for rid in inner_ids:
+                        records[rid]["depends_on"] = (
+                            records[rid]["depends_on"] | (inner - {rid}))
+                    out_union = out_union | inner
+                    per_pos = None
+                if per_pos is not None and len(per_pos) == len(eqn.outvars):
+                    for v, d in zip(eqn.outvars, per_pos):
+                        env[id(v)] = base | d
+                else:
+                    for v in eqn.outvars:
+                        env[id(v)] = out_union
+                continue
+            for v in eqn.outvars:
+                env[id(v)] = base
+
+    walk(jaxpr, {}, 0)
+    return records
+
+
+def independent_collectives(jaxpr, names=("psum", "ppermute", "all_gather",
+                                          "all_to_all", "pmax", "pmin")
+                            ) -> List[dict]:
+    """Records from :func:`collective_dependencies` that neither consume
+    any other collective's output NOR are consumed by any other
+    collective — the fully-overlappable ones.  An empty result means
+    every collective in the body is serialized against at least one
+    other (the classic/fused shape); the pipelined body must show
+    exactly its one scalar reduction here."""
+    recs = collective_dependencies(jaxpr, names)
+    fed = {}
+    for r in recs:
+        for d in r["depends_on"]:
+            fed.setdefault(d, set()).add(r["id"])
+    return [r for r in recs
+            if not r["depends_on"] and not fed.get(r["id"])]
+
+
 def dtype_violations(closed_jaxpr, forbidden: str = "float64") -> List[dict]:
     """Equations whose operands/results carry ``forbidden``-dtype avals.
 

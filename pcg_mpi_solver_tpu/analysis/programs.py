@@ -161,16 +161,22 @@ def program_signature(solver) -> str:
 
 def build_programs(fast: bool = False) -> List[Program]:
     """The canonical matrix, cached per process (tracing only — nothing
-    executes).  Full: 2 variants x nrhs {1,8} x 2 backends + one all-f32
-    program per backend (10 traces, ~2 s).  Fast: the distributed
-    backend only (incl. its f32 program, so every fast-tier rule has a
-    non-vacuous surface)."""
+    executes).  Full: 3 variants x nrhs {1,8} x 2 backends + one all-f32
+    program per backend + the mg programs (20 traces, ~3 s).  Fast: the
+    distributed backend, classic+fused only (incl. its f32 program, so
+    every fast-tier rule has a non-vacuous surface)."""
     if fast in _MATRIX_CACHE:
         return _MATRIX_CACHE[fast]
     out: List[Program] = []
     backends = ("general",) if fast else ("general", "structured")
+    # the full matrix carries all three loop formulations (the
+    # psum-overlap rule needs the pipelined programs AND the
+    # classic/fused negative controls); --fast keeps the pre-ISSUE-11
+    # pair so the hardware-queue gate stays ~1s
+    variants = (("classic", "fused") if fast
+                else ("classic", "fused", "pipelined"))
     for backend in backends:
-        for variant in ("classic", "fused"):
+        for variant in variants:
             s = build_solver(backend, pcg_variant=variant)
             budget = s.ops.body_collective_budget(variant)
             for nrhs in (1, 8):

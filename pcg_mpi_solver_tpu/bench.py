@@ -88,9 +88,12 @@ window), BENCH_PLATEAU (mixed-mode inner
 plateau-exit window, 0=off), BENCH_PRECOND (jacobi|block3|mg — the
 ISSUE-10 preconditioner A/B; detail.precond + detail.time_to_tol_s /
 detail.iters make it a time-to-solution comparison),
-BENCH_PCG_VARIANT (classic|fused PCG loop
-formulation — the classic-vs-fused ms/iteration A/B knob; the engaged
-variant is reported in detail.pcg_variant); plus the solver-level performance knobs
+BENCH_PCG_VARIANT (classic|fused|pipelined PCG loop
+formulation — the 3-way ms/iteration A/B knob: classic's 3 serialized
+reductions vs fused's single psum vs pipelined's stencil-overlapped
+psum; the engaged variant is reported in detail.pcg_variant on EVERY
+line, insurance/salvage included, and schema-validated against the
+canonical name set — obs/schema.BENCH_PCG_VARIANT_VALUES); plus the solver-level performance knobs
 PCG_TPU_MATVEC_FORM / PCG_TPU_PALLAS_V / PCG_TPU_PALLAS_PLANES /
 PCG_TPU_HYBRID_BLOCK (docs/RUNBOOK.md knob table) — the engaged form is
 reported in detail.matvec_form.
@@ -506,8 +509,14 @@ def _solve_once(kind, nx, ny, nz, ot_n, ot_level, backend, n_parts, tol,
         solver=SolverConfig(tol=tol, max_iter=20000, dtype=dtype,
                             dot_dtype="float64", precision_mode=mode,
                             pallas=os.environ.get("BENCH_PALLAS", "auto"),
-                            # classic|fused A/B knob for the hardware
-                            # windows (fused = one collective/iteration)
+                            # classic|fused|pipelined A/B knob for the
+                            # hardware windows (fused = one collective/
+                            # iteration; pipelined = that collective
+                            # overlapped with the stencil).  An unknown
+                            # value fails HERE, loudly, at config build
+                            # (SolverConfig validates against
+                            # config.PCG_VARIANTS) — never as a silent
+                            # classic fallback mislabeling a round.
                             pcg_variant=os.environ.get(
                                 "BENCH_PCG_VARIANT", "classic"),
                             # batched multi-RHS block width: the timed

@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from pcg_mpi_solver_tpu import __version__
+from pcg_mpi_solver_tpu.config import PCG_VARIANTS
 
 # Bump on ANY change to what cache entries contain or how they are
 # serialized (partition pickle layout, AOT export calling convention
@@ -144,6 +145,14 @@ def step_cache_key(*, abstract: Any, mesh: Any, backend: str,
     its operand pytree, so jacobi/block3/mg programs must never collide
     even if the solver dict's serialization changes; the MG-shape knobs
     (levels/degree/dims) ride ``extra["mg"]`` from the driver."""
+    if pcg_variant not in PCG_VARIANTS:
+        # single-source variant discipline (config.PCG_VARIANTS): a key
+        # for a variant no loop builder knows would cache a program that
+        # can never be rebuilt — fail here, loudly, like the gauges and
+        # the collective-budget table do
+        raise KeyError(
+            f"step_cache_key: unknown pcg_variant {pcg_variant!r} "
+            f"(valid: {PCG_VARIANTS})")
     return _digest({
         "kind": "aot-step",
         "abstract": abstract,

@@ -136,16 +136,22 @@ def _finish_telemetry(solver, args) -> None:
 
 
 def _add_variant_flag(p) -> None:
-    p.add_argument("--pcg-variant", choices=["classic", "fused"],
+    from pcg_mpi_solver_tpu.config import PCG_VARIANTS
+
+    p.add_argument("--pcg-variant", choices=list(PCG_VARIANTS),
                    default=None, dest="pcg_variant",
                    help="PCG loop formulation: classic = MATLAB-"
                         "compatible 3-reduction loop (bit-exact "
                         "reference parity; default), fused = "
                         "Chronopoulos-Gear single-reduction recurrence "
                         "(ONE collective per iteration — cuts the "
-                        "between-matvec latency at scale; iteration "
-                        "counts differ by O(1), see docs/RUNBOOK.md "
-                        "'Choosing pcg_variant')")
+                        "between-matvec latency at scale), pipelined = "
+                        "Ghysels-Vanroose depth-1 pipelining (the one "
+                        "collective overlaps the stencil matvec "
+                        "entirely; 4 extra carry vectors, tighter "
+                        "drift guard).  Iteration counts of the non-"
+                        "classic variants differ by O(1); see "
+                        "docs/RUNBOOK.md 'Choosing pcg_variant'")
 
 
 def _add_preflight_flag(p) -> None:

@@ -11,6 +11,22 @@ from __future__ import annotations
 import dataclasses
 from typing import Sequence
 
+# ---------------------------------------------------------------------------
+# Canonical PCG loop-formulation name set (SolverConfig.pcg_variant).
+# THE single source every variant-name surface derives from, so an
+# unknown variant fails loudly everywhere instead of silently falling
+# through one layer's default:
+#   * SolverConfig.__post_init__ (below) — config construction,
+#   * solver/pcg.py VALID_PCG_VARIANTS — the loop builders,
+#   * ops/matvec.py PCG_SCALAR_PSUMS — the collective contract table
+#     (an import-time assert pins its keys to this tuple),
+#   * cache/keys.py step_cache_key — AOT cache keying,
+#   * cli.py --pcg-variant choices and bench.py BENCH_PCG_VARIANT.
+# Lives here (not ops/) because this module is jax-free by contract and
+# every one of those consumers may import it before the accelerator
+# environment is configured.
+PCG_VARIANTS = ("classic", "fused", "pipelined")
+
 
 @dataclasses.dataclass
 class SolverConfig:
@@ -74,7 +90,23 @@ class SolverConfig:
     #               pipelined-CG tradeoff), so iteration counts differ
     #               from classic by O(1) and results are NOT bit-exact
     #               with the reference — see docs/RUNBOOK.md "Choosing
-    #               pcg_variant".  CLI: --pcg-variant; bench:
+    #               pcg_variant".
+    #   "pipelined" — Ghysels–Vanroose depth-1 pipelined CG
+    #               (arXiv:2105.06176 §3): still ONE fused psum per
+    #               iteration, but its operands are all PREVIOUS-
+    #               iteration recurrence state, so the psum carries no
+    #               data dependence on (and none from) the iteration's
+    #               stencil matvec — XLA is free to run the reduction
+    #               CONCURRENTLY with the matvec, hiding the last
+    #               collective's latency entirely (statically proven by
+    #               the analysis/ psum-overlap rule).  The price: four
+    #               extra recurrence vectors in the carry (u/w/s/z) and
+    #               faster residual-recurrence drift than fused
+    #               (arXiv:2501.03743 §4) — guarded by a LOWER drift
+    #               limit (solver/pcg.PIPELINED_DRIFT_LIMIT) feeding the
+    #               same recoverable flag 6.  Iteration counts differ
+    #               from classic by O(1); NOT bit-exact with the
+    #               reference.  CLI: --pcg-variant; bench:
     #               BENCH_PCG_VARIANT.
     pcg_variant: str = "classic"
     # Default RHS-block width for batched multi-RHS solves
@@ -167,6 +199,16 @@ class SolverConfig:
     # any backend (CI's way to exercise the real solver->kernel dispatch
     # on CPU; far slower than the XLA path — testing only).
     pallas: str = "auto"
+
+    def __post_init__(self):
+        # fail at CONSTRUCTION, with the same named set every other
+        # surface derives from (PCG_VARIANTS above) — a typo'd variant
+        # must never survive to a driver/cache/analysis layer that
+        # would each have its own idea of the valid names
+        if self.pcg_variant not in PCG_VARIANTS:
+            raise ValueError(
+                f"SolverConfig.pcg_variant must be one of "
+                f"{PCG_VARIANTS}, got {self.pcg_variant!r}")
 
 
 @dataclasses.dataclass

@@ -27,6 +27,8 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List
 
+from pcg_mpi_solver_tpu.config import PCG_VARIANTS
+
 # Bump the integer suffix on any BREAKING change (key removal/retyping);
 # additive fields do not bump.
 TELEMETRY_SCHEMA = "pcg-tpu-telemetry/1"
@@ -119,6 +121,13 @@ BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "time_to_tol_s", "iters")
 # ``setup_cache``: warm-path partition attribution (cache/ subsystem).
 BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
+# ``pcg_variant``: the engaged PCG loop formulation of the line's
+# numbers — the classic/fused/pipelined A/B axis (BENCH_PCG_VARIANT).
+# Derived from the canonical config.PCG_VARIANTS name table (config.py
+# is jax/numpy-free, so this module's import-light contract holds): a
+# line claiming a variant no loop builder knows is a schema error, on
+# measured AND insurance/salvage lines alike.
+BENCH_PCG_VARIANT_VALUES = PCG_VARIANTS
 
 
 def validate_event(ev: Any) -> List[str]:
@@ -168,6 +177,10 @@ def validate_bench_line(d: Any) -> List[str]:
         if sc is not None and sc not in BENCH_SETUP_CACHE_VALUES:
             errs.append(f"detail.setup_cache not in "
                         f"{BENCH_SETUP_CACHE_VALUES}: {sc!r}")
+        pv = detail.get("pcg_variant")
+        if pv is not None and pv not in BENCH_PCG_VARIANT_VALUES:
+            errs.append(f"detail.pcg_variant not in "
+                        f"{BENCH_PCG_VARIANT_VALUES}: {pv!r}")
     # schema-less lines are legacy (pre-schema artifacts) — still valid.
     return errs
 

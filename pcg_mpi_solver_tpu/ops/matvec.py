@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pcg_mpi_solver_tpu.config import PCG_VARIANTS
 from pcg_mpi_solver_tpu.parallel.partition import PartitionedModel
 from pcg_mpi_solver_tpu.utils.compat import ensure_shard_map
 
@@ -51,11 +52,27 @@ ensure_shard_map()
 #   per iteration (rho+inf-prec, p.q, the fused 3-norm).
 # * fused   — Chronopoulos–Gear recurrence: ONE fused psum carries all six
 #   reduced scalars plus the inf-prec flag.
+# * pipelined — Ghysels–Vanroose depth-1 pipelining: still ONE fused
+#   psum, but its operands are all previous-iteration recurrence state
+#   (r/u/w/p/x carry leaves), so the psum is data-INDEPENDENT of the
+#   body's stencil matvec in both directions and the scheduler may
+#   overlap them — the analysis/ psum-overlap rule proves that
+#   independence statically, on top of this count.
 #
-# Changing a loop body (e.g. adding pcg_variant="pipelined") REQUIRES a
-# row here: an unknown variant is a KeyError in both the gauges and the
-# budget — the lint fails loudly instead of silently re-serializing.
-PCG_SCALAR_PSUMS = {"classic": 3, "fused": 1}
+# Changing a loop body (adding a pcg_variant) REQUIRES a row here: an
+# unknown variant is a KeyError in both the gauges and the budget — the
+# lint fails loudly instead of silently re-serializing.  The key set is
+# pinned to the canonical config.PCG_VARIANTS name table (the single
+# source the CLI/config/cache layers validate against) by the assert
+# below: a variant added to one surface but not the other cannot import.
+PCG_SCALAR_PSUMS = {"classic": 3, "fused": 1, "pipelined": 1}
+
+if tuple(PCG_SCALAR_PSUMS) != PCG_VARIANTS:
+    # an explicit raise, not `assert` — the guard must survive -O
+    raise ImportError(
+        "ops/matvec.PCG_SCALAR_PSUMS keys must match config.PCG_VARIANTS "
+        "(the single-source variant name set): "
+        f"{tuple(PCG_SCALAR_PSUMS)} != {PCG_VARIANTS}")
 
 # The deferred mode-1 true-residual check lives INSIDE the traced while
 # body (both branches of the conditional are part of the body jaxpr), and

@@ -173,23 +173,26 @@ class ManyRecoveryHooks:
 
 
 def _upgrade_many_carry(carry: Dict[str, Any], nrhs: int,
-                        fused: bool) -> Dict[str, Any]:
+                        lagged: bool) -> Dict[str, Any]:
     """Back-compat shim for blocked snapshots written before the
-    per-column recovery state existed: fill the ``prec_sel`` (and fused
-    ``drift``) leaves with their cold values — zeros, i.e. exactly the
-    pre-upgrade behavior — so pre-existing ``many_*.npz`` resume points
-    still resume instead of failing a pytree mismatch (the
-    ``CheckpointManager.restore`` legacy-shim precedent)."""
+    per-column recovery state existed: fill the ``prec_sel`` (and the
+    recurrence variants' ``drift``) leaves with their cold values —
+    zeros, i.e. exactly the pre-upgrade behavior — so pre-existing
+    ``many_*.npz`` resume points still resume instead of failing a
+    pytree mismatch (the ``CheckpointManager.restore`` legacy-shim
+    precedent).  Only fused snapshots can actually predate the drift
+    leaf; pipelined carries always carried it, and their GV vector
+    leaves need no shim (the variant postdates every legacy format)."""
     carry = dict(carry)
     carry.setdefault("prec_sel", np.zeros(nrhs, np.int32))
-    if fused:
+    if lagged:
         carry.setdefault("drift", np.zeros(nrhs, np.int32))
     return carry
 
 
 def run_many_with_recovery(carry, *, scfg, nrhs: int, hooks, recorder,
                            resilience=None, resume: bool = False,
-                           fused: bool = False, total0: int = 0,
+                           lagged: bool = False, total0: int = 0,
                            iters_cols0=None):
     """Run a blocked (multi-RHS) chunked solve to termination with
     FAULT ISOLATION BETWEEN COLUMNS — the blocked twin of
@@ -233,7 +236,7 @@ def run_many_with_recovery(carry, *, scfg, nrhs: int, hooks, recorder,
     st = resilience.load_resume_state() if resilience is not None else None
     if st is not None and str(np.asarray(st.get("kind", ""))) == "many":
         carry = resilience.restore_device(
-            {"carry": _upgrade_many_carry(st["carry"], R, fused)})["carry"]
+            {"carry": _upgrade_many_carry(st["carry"], R, lagged)})["carry"]
         total = int(np.asarray(st["total"]))
         iters_cols = np.asarray(st["iters_cols"], np.int64).copy()
         note(f"resumed blocked solve (nrhs={R}) at {total} iterations")
@@ -271,7 +274,7 @@ def run_many_with_recovery(carry, *, scfg, nrhs: int, hooks, recorder,
             # snapshot is the one copy that cannot have been)
             carry = resilience.restore_device(
                 {"carry": _upgrade_many_carry(st["carry"], R,
-                                              fused)})["carry"]
+                                              lagged)})["carry"]
             total = int(np.asarray(st["total"]))
             iters_cols = np.asarray(st["iters_cols"], np.int64).copy()
             flags = np.asarray(carry["flag"])
@@ -282,14 +285,14 @@ def run_many_with_recovery(carry, *, scfg, nrhs: int, hooks, recorder,
             # forever in its restored poisoned state
             quarantined = {k for k in range(R)
                            if flags[k] == QUARANTINE_FLAG}
-            if fused and "drift" in carry:
+            if lagged and "drift" in carry:
                 drift_prev = np.asarray(carry["drift"], dtype=np.int64)
             continue
         if faults is not None:
             faults.on_dispatch_done()
         iters_cols += execv.astype(np.int64)
         total += int(execv.max()) if execv.size else 0
-        if fused and "drift" in carry:
+        if lagged and "drift" in carry:
             cur = np.asarray(carry["drift"], dtype=np.int64)
             drift_cols += np.maximum(cur - drift_prev, 0)
             drift_prev = cur
@@ -342,7 +345,7 @@ def run_many_with_recovery(carry, *, scfg, nrhs: int, hooks, recorder,
             carry = hooks.recover(carry, jnp.asarray(restart_m),
                                   jnp.asarray(fb_m), jnp.asarray(quar_m))
             flags = np.asarray(carry["flag"])
-            if fused and "drift" in carry:
+            if lagged and "drift" in carry:
                 # restarted columns come back with a zeroed drift leaf;
                 # re-baseline so the next dispatch's increment is honest
                 drift_prev = np.asarray(carry["drift"], dtype=np.int64)
@@ -360,7 +363,7 @@ def run_many_with_recovery(carry, *, scfg, nrhs: int, hooks, recorder,
                   relres=None, attempts=recoveries,
                   actions=actions_taken)
     if rec is not None and int(drift_cols.sum()) > 0:
-        # the fused residual-drift telemetry twin (obs/schema
+        # the recurrence-variant residual-drift telemetry twin (obs/schema
         # `resid_drift`): cumulative drifted true-residual checks per
         # column, surfaced once per blocked solve
         rec.event("resid_drift", drift=int(drift_cols.sum()),

@@ -28,7 +28,8 @@ import numpy as np
 
 from pcg_mpi_solver_tpu.obs.trace import trace_host_init, trace_specs
 from pcg_mpi_solver_tpu.solver.pcg import (
-    carry_part_specs, cold_carry, pcg, refine_tol, select_best)
+    LAGGED_VARIANTS, carry_part_specs, cold_carry, pcg, refine_tol,
+    select_best)
 
 
 def _state_kind(state) -> str:
@@ -89,11 +90,12 @@ class ChunkedEngine:
         self.donate = bool(donate)
         # Loop formulation (SolverConfig.pcg_variant): threads through
         # every resumable pcg() call below and sizes the carry schema —
-        # the fused (Chronopoulos–Gear) variant rides q/alpha/fresh
-        # recurrence state alongside the classic Krylov carry, so capped
-        # fused dispatches stay bit-identical to one long fused solve.
+        # the recurrence variants (fused, pipelined) ride their
+        # q/alpha/fresh (+ GV u/w/s/z/init) recurrence state alongside
+        # the classic Krylov carry, so capped dispatches stay
+        # bit-identical to one long solve of the same variant.
         variant = self.variant = getattr(scfg, "pcg_variant", "classic")
-        fused_v = variant == "fused"
+        lagged_v = variant in LAGGED_VARIANTS
         cap = int(cap)
         P, R = part_spec, rep_spec
         # preconditioner-operand spec: the plain part spec for the array
@@ -102,7 +104,7 @@ class ChunkedEngine:
         # driver/newmark pass {"mg_diag": P, "fb": R})
         prec_spec = P if prec_spec is None else prec_spec
         carry_specs = carry_part_specs(P, R, trace=self.trace_len > 0,
-                                       fused=fused_v)
+                                       variant=variant)
 
         def smap(f, in_specs, out_specs, donate_argnums=()):
             return jax.jit(jax.shard_map(
@@ -129,7 +131,7 @@ class ChunkedEngine:
                 one = jnp.asarray(1.0, ops32.dot_dtype)
                 carry0 = cold_carry(jnp.zeros_like(rhat32), rhat32, one,
                                     ops32.dot_dtype, trace=trace,
-                                    fused=fused_v)
+                                    variant=variant)
                 return rhat32, tol_cycle, carry0
 
             in_start = (data_specs, P, R, R) + (
@@ -201,11 +203,11 @@ class ChunkedEngine:
 
             def _final32(data, rhat32, carry32):
                 """f32 min-residual selection when an inner solve fails
-                (matches the one-shot pcg_mixed's finalize_bad; fused
-                carries never evaluated their last iterate, so they
-                take the min unconditionally)."""
+                (matches the one-shot pcg_mixed's finalize_bad;
+                recurrence-variant carries never evaluated their last
+                iterate, so they take the min unconditionally)."""
                 x, _ = select_best(ops32, data["f32"], rhat32, carry32,
-                                   always_min=fused_v)
+                                   always_min=lagged_v)
                 return x
 
             self._final32_fn = smap(
@@ -236,10 +238,10 @@ class ChunkedEngine:
 
             def _final(data, fext, carry):
                 """Min-residual selection at terminal failure (once/step);
-                fused carries never evaluated their last iterate, so
-                they take the min unconditionally."""
+                recurrence-variant carries never evaluated their last
+                iterate, so they take the min unconditionally."""
                 return select_best(ops, data, fext, carry,
-                                   always_min=fused_v)
+                                   always_min=lagged_v)
 
             self._final_fn = smap(
                 _final, (data_specs, P, carry_specs), (P, R))
@@ -611,14 +613,14 @@ class ChunkedEngine:
             # ever updated by committed finite iterations, so it stays
             # finite through NaN poisoning and flag-2/4 breakdowns)
             self.restart_x = carry["xmin"]
-            if self.variant == "fused" and self._rec is not None \
+            if self.variant in LAGGED_VARIANTS and self._rec is not None \
                     and "drift" in carry:
-                # fused residual-drift telemetry (obs/schema
-                # `resid_drift`): how many deferred true-residual checks
-                # disagreed with the recurrence norm this solve (flag 6
-                # routes sustained drift into the ladder; the count is
-                # the observability twin) — one scalar fetch, at
-                # termination only
+                # recurrence-variant residual-drift telemetry
+                # (obs/schema `resid_drift`): how many deferred
+                # true-residual checks disagreed with the recurrence
+                # norm this solve (flag 6 routes sustained drift into
+                # the ladder; the count is the observability twin) —
+                # one scalar fetch, at termination only
                 d = int(carry["drift"])
                 if d > 0:
                     self._rec.event("resid_drift", drift=d)

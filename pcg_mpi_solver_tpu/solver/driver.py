@@ -893,12 +893,12 @@ class Solver:
         P, R = self._part_spec, self._rep_spec
         # Direct mode threads the convergence ring through the dispatch
         # carry built here; in mixed mode the engine owns the ring (it
-        # rides the f32 inner carries instead).  The fused variant adds
-        # its recurrence leaves to the carry schema (pcg.cold_carry).
-        fused_v = scfg.pcg_variant == "fused"
+        # rides the f32 inner carries instead).  The recurrence variants
+        # add their extra leaves to the carry schema (pcg.cold_carry).
+        variant = scfg.pcg_variant
         trace_direct = self.trace_len > 0 and not mixed
         carry_specs = carry_part_specs(P, R, trace=trace_direct,
-                                       fused=fused_v)
+                                       variant=variant)
 
         # The ONE program holding the out-of-loop f64 stencil: Dirichlet
         # lifting, r0, and every refinement's true-residual matvec all
@@ -967,7 +967,7 @@ class Solver:
                 x0, r0, normr0, self.ops.dot_dtype,
                 trace=(trace_init(self.trace_len, self._trace_dtype)
                        if trace_direct else None),
-                fused=fused_v)
+                variant=variant)
             # preconditioner rebuild once per step (not per dispatch /
             # refinement cycle): f32 for the mixed inner solves.
             if mixed:
@@ -1147,11 +1147,11 @@ class Solver:
                 carry_part_specs, cold_carry)
 
             mixed = self.mixed
-            fused_v = self.config.solver.pcg_variant == "fused"
+            variant = self.config.solver.pcg_variant
             trace_direct = self.trace_len > 0 and not mixed
             P, R = self._part_spec, self._rep_spec
             carry_specs = carry_part_specs(P, R, trace=trace_direct,
-                                           fused=fused_v)
+                                           variant=variant)
             trace_len, trace_dtype = self.trace_len, self._trace_dtype
 
             def _restart(data, fext, x, kx):
@@ -1162,7 +1162,7 @@ class Solver:
                 tr = (trace_init(trace_len, trace_dtype)
                       if trace_direct else None)
                 return cold_carry(x, r, normr, self.ops.dot_dtype,
-                                  trace=tr, fused=fused_v), normr
+                                  trace=tr, variant=variant), normr
 
             self._restart_post_fn = jax.jit(jax.shard_map(
                 _restart, mesh=self.mesh,
@@ -1451,13 +1451,13 @@ class Solver:
         if R in self._many_progs:
             return self._many_progs[R]
         from pcg_mpi_solver_tpu.solver.pcg import (
-            carry_part_specs, cold_carry_many, pcg_many, pcg_mixed_many,
-            restart_carry_many, select_best_many)
+            LAGGED_VARIANTS, carry_part_specs, cold_carry_many, pcg_many,
+            pcg_mixed_many, restart_carry_many, select_best_many)
 
         scfg = self.config.solver
         mixed = self.mixed
         variant = scfg.pcg_variant
-        fused_v = variant == "fused"
+        lagged_v = variant in LAGGED_VARIANTS
         glob_n_eff = self.pm.glob_n_dof_eff
         P, Rsp = self._part_spec, self._rep_spec
         cap = self._dispatch_cap
@@ -1519,7 +1519,7 @@ class Solver:
                     fn = aot_fn
             progs["solve"] = fn
         else:
-            carry_specs = carry_part_specs(P, Rsp, fused=fused_v,
+            carry_specs = carry_part_specs(P, Rsp, variant=variant,
                                            many=True)
             # prec rides as ONE operand either way: the plain primary
             # inverse (array, or the mg prec dict), or the (primary,
@@ -1539,7 +1539,7 @@ class Solver:
                 normr0 = jnp.sqrt(self.ops.wdot_many(w, fext, fext))
                 carry0 = cold_carry_many(
                     jnp.zeros_like(fext), fext, normr0,
-                    self.ops.dot_dtype, fused=fused_v)
+                    self.ops.dot_dtype, variant=variant)
                 prec = self._make_prec(self.ops, data)
                 if use_fb:
                     from pcg_mpi_solver_tpu.ops.precond import (
@@ -1576,7 +1576,7 @@ class Solver:
                 # by jit — a healthy solve never pays for it.
                 return restart_carry_many(
                     self.ops, data, fext, carry, restart_m, fb_m,
-                    quar_m, fused=fused_v)
+                    quar_m, variant=variant)
 
             progs["recover"] = smap(
                 _recover,
@@ -1590,7 +1590,7 @@ class Solver:
                 # return zeros, failed columns take the MATLAB
                 # min-residual fallback
                 return select_best_many(self.ops, data, fext, carry,
-                                        always_min=fused_v,
+                                        always_min=lagged_v,
                                         respect_flags=True)
 
             progs["final"] = smap(_final, (self._specs, P, carry_specs),
@@ -1718,7 +1718,8 @@ class Solver:
 
         scfg = self.config.solver
         rec = self._rec
-        fused_v = scfg.pcg_variant == "fused"
+        from pcg_mpi_solver_tpu.solver.pcg import LAGGED_VARIANTS
+        lagged_v = scfg.pcg_variant in LAGGED_VARIANTS
         every = int(getattr(self.config, "snapshot_every", 0))
         store = (self._many_snap_store(R, rhs_hash)
                  if (every > 0 or resume) else None)
@@ -1748,7 +1749,7 @@ class Solver:
             hooks=ManyRecoveryHooks(cycle=_cycle, recover=_recover,
                                     has_fallback=bool(
                                         progs.get("has_fallback"))),
-            resilience=ctx, resume=resume, fused=fused_v)
+            resilience=ctx, resume=resume, lagged=lagged_v)
         with rec.dispatch("many_final"):
             x_fin, relres = progs["final"](self.data, fext, carry)
             relres = np.asarray(relres, dtype=np.float64)

@@ -391,10 +391,10 @@ class NewmarkSolver:
             from pcg_mpi_solver_tpu.solver.pcg import (
                 carry_part_specs, cold_carry)
 
-            fused_v = scfg.pcg_variant == "fused"
+            variant = scfg.pcg_variant
             trace_direct = self.trace_len > 0 and not self.mixed
             carry_specs = carry_part_specs(P_, R_, trace=trace_direct,
-                                           fused=fused_v)
+                                           variant=variant)
             trace_len, trace_dtype = self.trace_len, self._trace_dtype
 
             def _start_ch(data, u, v, w, delta_next):
@@ -410,7 +410,7 @@ class NewmarkSolver:
                     x0, r0, normr0, self.ops.dot_dtype,
                     trace=(trace_init(trace_len, trace_dtype)
                            if trace_direct else None),
-                    fused=fused_v)
+                    variant=variant)
                 return udi, fext, carry0, normr0, n2b
 
             self._start_ch_fn = jax.jit(jax.shard_map(
@@ -531,11 +531,11 @@ class NewmarkSolver:
         from pcg_mpi_solver_tpu.solver.pcg import carry_part_specs, cold_carry
 
         mixed = self.mixed
-        fused_v = self.config.solver.pcg_variant == "fused"
+        variant = self.config.solver.pcg_variant
         trace_direct = self.trace_len > 0 and not mixed
         P, R = self._part_spec, self._rep_spec
         carry_specs = carry_part_specs(P, R, trace=trace_direct,
-                                       fused=fused_v)
+                                       variant=variant)
         trace_len, trace_dtype = self.trace_len, self._trace_dtype
 
         def _amulA(data, v):
@@ -554,7 +554,7 @@ class NewmarkSolver:
             tr = (trace_init(trace_len, trace_dtype)
                   if trace_direct else None)
             return cold_carry(x, r, normr, self.ops.dot_dtype,
-                              trace=tr, fused=fused_v), normr
+                              trace=tr, variant=variant), normr
 
         self._restart_post_fn = jax.jit(jax.shard_map(
             _restart, mesh=self.mesh, in_specs=(self._specs, P, P, P),

@@ -30,6 +30,30 @@ earlier (the pipelined lag), so iteration counts differ from classic by
 O(1) and the variant is NOT bit-exact with the MATLAB reference (classic
 stays the parity default).  The deferred true-residual check (mode 1)
 and the flag taxonomy are shared between variants.
+
+``variant="pipelined"`` is Ghysels–Vanroose depth-1 pipelined CG
+(arXiv:2105.06176 §3, safeguarded per the communication-reduced survey
+arXiv:2501.03743): the fused variant's single psum still READS this
+iteration's matvec output (mu = <z, A.z>), so the reduction serializes
+after the stencil; pipelining removes that last dependency by keeping
+the preconditioned residual u = M^-1.r and its A-image w = A.u in the
+carry and advancing BOTH by recurrence.  Per trip the single fused psum
+(gamma = <r,u>, delta = <w,u>, the residual/stagnation norms, the
+inf-preconditioner flag) consumes ONLY previous-iteration carry leaves,
+and the trip's preconditioner apply m = M^-1.w plus stencil matvec
+n = A.m consume only carry leaves too — the psum and the matvec are
+data-independent in BOTH directions, so the scheduler may run the
+reduction concurrently with the stencil and the collective's latency
+disappears behind compute (the analysis/ ``psum-overlap`` rule proves
+that independence on the traced body jaxpr; classic and fused are its
+serialized negative controls).  The price: four more recurrence vectors
+(s = A.p, q = M^-1.s, z = A.q ride the carry next to u/w), one priming
+trip per cold start (u0/w0 through the body's own precond/matvec — no
+extra stencil instantiation), and a residual recurrence that drifts
+from truth FASTER than fused's (2501.03743 §4) — the same deferred
+true-residual drift guard applies with the LOWER
+``PIPELINED_DRIFT_LIMIT``.  Iteration counts differ from classic by
+O(1); NOT bit-exact with the reference.
 """
 
 from __future__ import annotations
@@ -41,6 +65,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pcg_mpi_solver_tpu.config import PCG_VARIANTS
 from pcg_mpi_solver_tpu.obs.trace import trace_record, trace_specs
 from pcg_mpi_solver_tpu.ops.matvec import Ops
 
@@ -83,10 +108,56 @@ DRIFT_FLAG = 6
 FUSED_DRIFT_FACTOR = 2.0
 FUSED_DRIFT_LIMIT = 3
 
+# The pipelined recurrence keeps FOUR derived vectors (u/w/s/z on top of
+# q) current by axpy instead of recomputation, so its residual
+# recurrence drifts from the true residual faster than fused's
+# (arXiv:2501.03743 §4 measures roughly one extra digit lost per depth)
+# — the same flag-6 guard applies with a LOWER limit: two drifted
+# deferred checks, not three, hand the solve to the ladder's
+# fresh-recurrence restart.
+PIPELINED_DRIFT_LIMIT = 2
+
+# Periodic true-residual replacement cadence (the second 2501.03743
+# safeguard, and the one that sets the variant's ATTAINABLE accuracy):
+# after this many committed iterations without a deferred check, a
+# check trip is FORCED — the true residual replaces the recurrence one
+# and the priming bit re-arms, re-synchronizing the u/w chain.  Without
+# it the f32 recurrence floors near 5e-3 and breaks down (flag 4: the
+# delta - beta*gamma/alpha denominator goes non-positive at ~35-80
+# iterations on the golden cube); at 25 the f32 inner solve reaches
+# tol 1e-5 in ~105 iterations vs classic's 101 (measured), while 50 is
+# already too coarse (breakdown before the first replacement).  Cost:
+# ~3 extra matvec-bearing trips per cadence — the pending trip whose
+# precond/matvec products are abandoned when forced candidacy fires,
+# the check (A.x), and the re-prime (M^-1.r + A.u) — ~12% at 25;
+# iteration COUNTS are unaffected (forced checks do not advance i,
+# count MoreSteps, touch stagnation, or tick the plateau/progress
+# windows).  A constant, not a knob: it
+# gates a numerical-safety mechanism, so it must not fork cache
+# keys/fingerprints.
+PIPELINED_REPLACE_EVERY = 25
+
 # Loop formulations (SolverConfig.pcg_variant): "classic" is the
 # MATLAB-compatible 3-reduction body, "fused" the Chronopoulos–Gear
-# single-reduction recurrence (see module docstring).
-VALID_PCG_VARIANTS = ("classic", "fused")
+# single-reduction recurrence, "pipelined" the Ghysels–Vanroose depth-1
+# overlap form (see module docstring).  Derived from the canonical
+# config.PCG_VARIANTS name table — the single source the CLI, config
+# validation, cache keys and the ops collective tables share.
+VALID_PCG_VARIANTS = PCG_VARIANTS
+
+# Variants whose convergence bookkeeping lags the committed iterate by
+# one trip (the recurrence forms): their carry ``x`` is an iterate whose
+# residual was never evaluated, so terminal selection must take the
+# tracked min-residual iterate unconditionally (``select_best
+# always_min``), and a warm resume must never flag-0 off the
+# predecessor's stale norm.
+LAGGED_VARIANTS = ("fused", "pipelined")
+
+
+def drift_limit_for(variant: str) -> int:
+    """Flag-6 drift budget of a recurrence variant's deferred checks."""
+    return (PIPELINED_DRIFT_LIMIT if variant == "pipelined"
+            else FUSED_DRIFT_LIMIT)
 
 
 class PCGResult(NamedTuple):
@@ -97,7 +168,7 @@ class PCGResult(NamedTuple):
 
 
 def cold_carry(x0, r0, normr0, dot_dtype, trace=None,
-               fused: bool = False) -> dict:
+               variant: str = "classic") -> dict:
     """Cold-start Krylov carry for resumable ``pcg`` calls: with p=0, rho=1
     the resumed beta/p recurrence reduces to the standard first iteration
     p = z.  The single schema shared by every chunked-dispatch call site.
@@ -124,10 +195,11 @@ def cold_carry(x0, r0, normr0, dot_dtype, trace=None,
         since_best=zero_i, best_at_reset=jnp.asarray(normr0, dd),
         win_start=jnp.asarray(normr0, dd), win_count=zero_i,
         normr_act=jnp.asarray(normr0, dd), exec=zero_i)
-    if fused:
-        # Chronopoulos–Gear recurrence state (pcg ``variant="fused"``):
-        # ``q`` tracks A.p alongside p and ``alpha`` is the previous step
-        # size.  The cold values make the first fused trip reduce to the
+    if variant in LAGGED_VARIANTS:
+        # Chronopoulos–Gear recurrence state (``variant="fused"``, and
+        # the base of the pipelined carry): ``q`` tracks an A-chain
+        # vector alongside p and ``alpha`` is the previous step size.
+        # The cold values make the first recurrence trip reduce to the
         # classic first iteration: with p = q = 0 the direction
         # recurrence collapses to p = z, q = w, and alpha = +inf zeroes
         # the denominator correction exactly (beta*rho/inf == 0 in
@@ -137,20 +209,36 @@ def cold_carry(x0, r0, normr0, dot_dtype, trace=None,
         out["q"] = jnp.zeros_like(x0)
         out["alpha"] = jnp.asarray(np.inf, dd)
         out["fresh"] = jnp.asarray(1, jnp.int32)
-        # drifted-true-residual-check count (FUSED_DRIFT_LIMIT guard);
+        # drifted-true-residual-check count (drift_limit_for guard);
         # rides the resumable carry so capped dispatches accumulate it
         out["drift"] = zero_i
+    if variant == "pipelined":
+        # Ghysels–Vanroose recurrence vectors: u = M^-1.r, w = A.u,
+        # s = A.p, z = A.q (q doubles as M^-1.s in GV notation).  All
+        # cold-zero; ``init`` = 1 arms the PRIMING trip — the first body
+        # trip computes u0 = M^-1.r0, w0 = A.u0 through the body's own
+        # preconditioner apply and stencil matvec (no pre-loop stencil
+        # instantiation, no budget consumed) and clears the bit.
+        out["u"] = jnp.zeros_like(x0)
+        out["w"] = jnp.zeros_like(x0)
+        out["s"] = jnp.zeros_like(x0)
+        out["z"] = jnp.zeros_like(x0)
+        out["init"] = jnp.asarray(1, jnp.int32)
+        # committed iterations since the last deferred check — the
+        # PIPELINED_REPLACE_EVERY forced-replacement cadence counter
+        out["sc"] = zero_i
     if trace is not None:
         out["trace"] = trace
     return out
 
 
 def carry_part_specs(part_spec, rep_spec, trace: bool = False,
-                     fused: bool = False, many: bool = False) -> dict:
+                     variant: str = "classic", many: bool = False) -> dict:
     """shard_map PartitionSpecs for the carry dict (vectors on the parts
     axis, bookkeeping scalars replicated; the optional trace ring is
-    replicated scalar streams; ``fused`` adds the Chronopoulos–Gear
-    leaves — the A.p vector and two replicated scalars).  ``many`` is
+    replicated scalar streams; the recurrence variants add their extra
+    leaves — fused the A.p vector and replicated scalars, pipelined the
+    four GV recurrence vectors plus the priming bit).  ``many`` is
     the RHS-blocked carry (:func:`pcg_many`): same keys with (R,)
     bookkeeping vectors (still replicated) plus the per-RHS ``flag``
     and ``prec_sel`` leaves — a blocked resume must keep
@@ -162,8 +250,10 @@ def carry_part_specs(part_spec, rep_spec, trace: bool = False,
                normrmin=R, xmin=P, imin=R, since_best=R, best_at_reset=R,
                win_start=R, win_count=R,
                normr_act=R, exec=R)
-    if fused:
+    if variant in LAGGED_VARIANTS:
         out.update(q=P, alpha=R, fresh=R, drift=R)
+    if variant == "pipelined":
+        out.update(u=P, w=P, s=P, z=P, init=R, sc=R)
     if many:
         out["flag"] = R
         out["prec_sel"] = R
@@ -241,12 +331,14 @@ def pcg(
 
     ``variant`` selects the loop formulation (``VALID_PCG_VARIANTS``):
     "classic" is the MATLAB-compatible 3-reduction body below; "fused"
-    the Chronopoulos–Gear single-reduction recurrence (module
-    docstring).  Both share the carry schema (``cold_carry`` /
-    ``carry_part_specs`` with the matching ``fused`` flag), the flag
-    taxonomy, the deferred true-residual check, the trace ring and the
-    resumable-dispatch contract — a sequence of capped fused calls is
-    bit-identical to one long fused solve, exactly like classic.
+    the Chronopoulos–Gear single-reduction recurrence; "pipelined" the
+    Ghysels–Vanroose depth-1 overlap form (module docstring).  All
+    share the carry schema (``cold_carry`` / ``carry_part_specs`` with
+    the matching ``variant``), the flag taxonomy, the deferred
+    true-residual check, the trace ring and the resumable-dispatch
+    contract — a sequence of capped recurrence-variant calls is
+    bit-identical to one long solve of that variant, exactly like
+    classic.
 
     ``trace_in`` (an ``obs/trace.py`` ring dict) enables in-graph
     convergence tracing: each committed iteration appends
@@ -302,6 +394,11 @@ def pcg(
         raise ValueError(f"pcg variant must be one of "
                          f"{VALID_PCG_VARIANTS}, got {variant!r}")
     fused = variant == "fused"
+    pipelined = variant == "pipelined"
+    lagged = variant in LAGGED_VARIANTS
+    # flag-6 drift budget of this variant's deferred checks (the ONE
+    # variant-to-limit dispatch point; trace-time constant)
+    drift_limit = drift_limit_for(variant)
     warm = carry_in is not None
     if warm and "trace" in carry_in:
         # resumable dispatch: the ring continues from the previous call
@@ -338,11 +435,12 @@ def pcg(
         normr0 = jnp.sqrt(ops.wdot(w, r0, r0))
 
     zero_rhs = n2b == 0
-    if fused and warm:
-        # the warm fused normr0 is the PREDECESSOR iterate's norm (the
-        # pipelined lag): never flag-0 the unevaluated resumed iterate
-        # off it — the first trip reduces the fresh norm and the
-        # deferred check gates flag 0 on a true residual as usual
+    if lagged and warm:
+        # the warm recurrence-variant normr0 is the PREDECESSOR
+        # iterate's norm (the pipelined lag): never flag-0 the
+        # unevaluated resumed iterate off it — the first trip reduces
+        # the fresh norm and the deferred check gates flag 0 on a true
+        # residual as usual
         initial_ok = jnp.asarray(False)
     else:
         initial_ok = normr0 <= tolb
@@ -376,10 +474,11 @@ def pcg(
         # exit, so it never rides the exported resume carry
         mode=jnp.asarray(0, jnp.int32),
     )
-    if fused:
-        # Chronopoulos–Gear state (see cold_carry): cold values make the
-        # first trip the textbook first CG step; warm values continue
-        # the recurrence exactly across dispatch boundaries.
+    if lagged:
+        # Chronopoulos–Gear / GV recurrence state (see cold_carry): cold
+        # values make the first trip the textbook first CG step; warm
+        # values continue the recurrence exactly across dispatch
+        # boundaries.
         carry0["q"] = carry_in["q"] if warm else jnp.zeros_like(x0)
         carry0["alpha"] = (carry_in["alpha"] if warm
                            else jnp.asarray(np.inf, ops.dot_dtype))
@@ -392,6 +491,22 @@ def pcg(
         carry0["drift"] = (carry_in["drift"] if warm
                            else jnp.asarray(0, jnp.int32))
         carry0["chk_normr"] = jnp.asarray(0.0, ops.dot_dtype)
+    if pipelined:
+        # GV recurrence vectors + the priming bit (see cold_carry): a
+        # warm resume continues all five recurrences exactly; a cold
+        # start (or a ladder restart that re-armed ``init``) primes
+        # u0/w0 on the first trip through the body's own precond/matvec.
+        for k in ("u", "w", "s", "z"):
+            carry0[k] = carry_in[k] if warm else jnp.zeros_like(x0)
+        carry0["init"] = (carry_in["init"] if warm
+                          else jnp.asarray(1, jnp.int32))
+        carry0["sc"] = (carry_in["sc"] if warm
+                        else jnp.asarray(0, jnp.int32))
+        # internal: whether the pending mode-1 check was FORCED by the
+        # replacement cadence alone (then it must not count MoreSteps /
+        # candidacy bookkeeping); mode is always 0 at loop exit, so it
+        # never rides the exported carry
+        carry0["chk_forced"] = jnp.asarray(0, jnp.int32)
     if traced:
         carry0["trace"] = trace0
 
@@ -399,7 +514,7 @@ def pcg(
         return (c["flag"] == 1) & (c["i"] < max_iter)
 
     def _resolve(c, x, r, p, rho, stag, normr_act, candidate, i,
-                 extra=None, record=None):
+                 extra=None, record=None, count_windows=None):
         """Shared iteration epilogue (reference pcg_solver.py:536-562):
         stag reset / MoreSteps / min-residual / plateau bookkeeping and
         the flag decision, with ``candidate`` marking a true-residual
@@ -412,7 +527,12 @@ def pcg(
         traced bool, default always-on) gates the trace-ring append:
         the fused trip after a FAILED true-residual check resolves the
         same iterate a second time and must not write a duplicate
-        slot."""
+        slot.  ``count_windows`` (a traced bool, default always-on)
+        gates the plateau/progress-window counters AND their flag-3
+        verdicts: a pipelined CADENCE-forced check resolves no new
+        committed iteration, so it must not advance the windows' clocks
+        (they would tick ~26x per 25 committed iterations — a silent
+        variant-dependent early flag-3 drift)."""
         converged = candidate & (normr_act <= tolb)
         # not converged on candidate: stag reset + MoreSteps bookkeeping
         # (reference pcg_solver.py:544-552)
@@ -461,6 +581,21 @@ def pcg(
         else:
             no_progress = jnp.asarray(False)
             win_start, win_count = c["win_start"], c["win_count"]
+
+        if count_windows is not None:
+            # frozen window clocks (forced checks): keep the carry
+            # values and suppress the verdicts those extra ticks alone
+            # could have fired — the next committed trip re-derives them
+            tick = count_windows
+            since_best = jnp.where(tick, since_best,
+                                   c["since_best"]).astype(jnp.int32)
+            best_at_reset = jnp.where(tick, best_at_reset,
+                                      c["best_at_reset"])
+            win_start = jnp.where(tick, win_start, c["win_start"])
+            win_count = jnp.where(tick, win_count,
+                                  c["win_count"]).astype(jnp.int32)
+            plateaued = plateaued & tick
+            no_progress = no_progress & tick
 
         flag = jnp.where(converged, 0,
                 jnp.where(toosmall | stagnated | plateaued | no_progress, 3,
@@ -766,7 +901,7 @@ def pcg(
             # sustained drift: exit recoverably (flag 6) instead of
             # grinding on a stale recurrence — the ladder restarts from
             # the min-residual iterate with a fresh recurrence
-            drift_exit = (out["flag"] == 1) & (drift >= FUSED_DRIFT_LIMIT)
+            drift_exit = (out["flag"] == 1) & (drift >= drift_limit)
             out["flag"] = jnp.where(drift_exit, DRIFT_FLAG,
                                     out["flag"]).astype(jnp.int32)
             return out
@@ -774,7 +909,198 @@ def pcg(
         return jax.lax.cond(is_check, post_check, post_iterate,
                             (c, operand, kop))
 
-    c = jax.lax.while_loop(cond, body_fused if fused else body, carry0)
+    def body_pipelined(c):
+        """One trip of the Ghysels–Vanroose depth-1 pipelined variant.
+
+        The single fused psum is issued FIRST, on previous-iteration
+        carry state only — gamma = <r,u>, delta = <w,u> (u = M^-1.r and
+        w = A.u ride the carry by recurrence), the residual/stagnation
+        norms and the inf-preconditioner flag (read off the carry ``u``,
+        where an Inf inverse lands at priming) — and the trip's
+        preconditioner apply m = M^-1.w plus stencil matvec n = A.m
+        consume only carry state too: neither the psum nor the matvec
+        transitively reads the other's output, so the lowered program
+        is free to overlap the collective with the stencil (the
+        analysis/ psum-overlap rule proves the independence; the psum
+        is NOT placed inside the mode conditional precisely so the
+        dependence structure stays first-order visible).
+
+        Trip kinds: mode 1 is the shared deferred true-residual check;
+        an armed ``init`` bit makes the trip a PRIMING trip (cold start
+        or ladder restart) that computes u0 = M^-1.r0, w0 = A.u0
+        through the same precond/matvec slots and commits nothing else;
+        otherwise the trip advances the x/r/u/w and p/s/q/z recurrences
+        (GV: p = u + beta*p, s = w + beta*s, q = m + beta*q,
+        z = n + beta*z, then x += alpha*p, r -= alpha*s, u -= alpha*q,
+        w -= alpha*z).  Epilogue semantics (pipelined lag, ``fresh``
+        gate, drift guard) mirror the fused body, with
+        PIPELINED_DRIFT_LIMIT as the flag-6 budget."""
+        i = c["i"]
+        is_check = c["mode"] == 1
+
+        # ---- the ONE fused psum: carry-state operands only ------------
+        inf_loc = jnp.any(jnp.isinf(c["u"])).astype(ops.dot_dtype)
+        red = ops.wdots(w, [(c["r"], c["u"]), (c["w"], c["u"]),
+                            (c["r"], c["r"]), (c["p"], c["p"]),
+                            (c["x"], c["x"])], extra=[inf_loc])
+        gamma, delta = red[0], red[1]
+        normr = jnp.sqrt(red[2])
+        normp, normx = jnp.sqrt(red[3]), jnp.sqrt(red[4])
+        flag2 = red[5] > 0
+
+        def pre_check(c):
+            return c["x"]
+
+        def pre_work(c):
+            # priming trips precondition the residual (u0 = M^-1.r0);
+            # iterate trips precondition w (m = M^-1.w — the GV overlap
+            # operand).  Both sources are carry leaves: the apply never
+            # waits on the psum above.
+            src = jnp.where(c["init"] > 0, c["r"], c["w"])
+            return ops.apply_prec(inv_diag, src, data=data)
+
+        m = jax.lax.cond(is_check, pre_check, pre_work, c)
+        km = amul(m)          # the ONE stencil instantiation in the body
+
+        def post_prime(args):
+            c, m, km = args
+            # commit u0 = M^-1.r0 and w0 = A.u0; no iteration advances,
+            # no budget is consumed — the next trip is the textbook
+            # first step (p = s = q = z = 0, alpha_prev = inf)
+            return dict(c, u=m, w=km, init=jnp.asarray(0, jnp.int32))
+
+        def post_iterate(args):
+            c, m, km = args
+            # lagged stagnation bookkeeping: identical contract to the
+            # fused body (the update committed LAST trip moved x by
+            # alpha_prev * p; cold p = 0 / alpha_prev = inf compare
+            # False — nothing to check yet)
+            already = c["fresh"] == 0
+            small = normp * jnp.abs(c["alpha"]) < eps * normx
+            stag = jnp.where(already, c["stag"],
+                             jnp.where(small, c["stag"] + 1,
+                                       0)).astype(jnp.int32)
+            natural = ((normr <= tolb) | (stag >= max_stag_steps)
+                       | (c["moresteps"] > 0))
+            # forced replacement cadence (PIPELINED_REPLACE_EVERY):
+            # a check trip fires even without natural candidacy, purely
+            # to re-synchronize the residual chain
+            forced = c["sc"] >= PIPELINED_REPLACE_EVERY
+            candidate = (natural | forced) & ~already
+
+            # GV scalars; breakdown taxonomy shared with fused (the
+            # denominator delta - beta*gamma/alpha_prev is <p,Ap> in
+            # exact arithmetic — SPD demands > 0)
+            bad_rho = (gamma == 0) | jnp.isinf(gamma)
+            beta = gamma / c["rho"]
+            bad_beta = (beta == 0) | jnp.isinf(beta)
+            pq = delta - beta * gamma / c["alpha"]
+            bad_pq = (pq <= 0) | jnp.isinf(pq)
+            alpha = gamma / pq
+            bad_alpha = jnp.isinf(alpha)
+            breakdown = bad_rho | bad_beta | bad_pq | bad_alpha
+            new_flag = jnp.where(flag2, 2,
+                                 jnp.where(breakdown, 4, 1)).astype(jnp.int32)
+
+            def on_break(c):
+                out = dict(c)
+                out["flag"] = new_flag
+                out["iter_out"] = i
+                out["rho"] = gamma
+                if traced:
+                    out["trace"] = trace_record(
+                        c["trace"], normr=normr, rho=gamma,
+                        stag=stag, flag=new_flag, scale=trace_scale)
+                return out
+
+            def on_continue(c):
+                beta_dt = beta.astype(dt)
+                alpha_dt = alpha.astype(dt)
+                p2 = c["u"] + beta_dt * c["p"]   # p = 0 cold => p2 = u
+                s2 = c["w"] + beta_dt * c["s"]   # A.p by recurrence
+                q2 = m + beta_dt * c["q"]        # M^-1.s by recurrence
+                z2 = km + beta_dt * c["z"]       # A.q by recurrence
+                x2 = c["x"] + alpha_dt * p2
+                r2 = c["r"] - alpha_dt * s2
+                u2 = c["u"] - alpha_dt * q2      # M^-1.r by recurrence
+                w2 = c["w"] - alpha_dt * z2      # A.u by recurrence
+                resolved = _resolve(
+                    c, x=c["x"], r=c["r"], p=c["p"], rho=gamma, stag=stag,
+                    normr_act=normr.astype(ops.dot_dtype),
+                    candidate=jnp.asarray(False), i=i,
+                    extra=dict(x=x2, r=r2, p=p2, u=u2, w=w2, s=s2, q=q2,
+                               z=z2, alpha=alpha.astype(ops.dot_dtype),
+                               fresh=jnp.asarray(1, jnp.int32),
+                               sc=(c["sc"] + 1).astype(jnp.int32)),
+                    record=~already)
+                pending = dict(c, stag=stag, iter_out=i,
+                               mode=jnp.asarray(1, jnp.int32),
+                               chk_normr=normr,
+                               chk_forced=(forced & ~natural
+                                           ).astype(jnp.int32))
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(candidate, a, b),
+                    pending, resolved)
+
+            return jax.lax.cond((flag2 | breakdown) & ~candidate,
+                                on_break, on_continue, c)
+
+        def post_work(args):
+            c = args[0]
+            return jax.lax.cond(c["init"] > 0, post_prime, post_iterate,
+                                args)
+
+        def post_check(args):
+            c, _x, kx = args
+            # kx = amul(x): the shared deferred true-residual check,
+            # plus the 2501.03743 TRUE-RESIDUAL REPLACEMENT the
+            # pipelined recurrence needs: the carry residual is replaced
+            # with the recomputed one (like classic/fused), and because
+            # u = M^-1.r / w = A.u advance by recurrence against the OLD
+            # r, the priming bit is RE-ARMED — the next trip rebuilds
+            # u/w from the honest residual through the body's own
+            # precond/matvec (one extra trip per check, no budget
+            # consumed), re-synchronizing the residual chain instead of
+            # letting f32 recurrence drift degrade the search.  The
+            # p/s and q/z direction chains are exact recurrence PAIRS
+            # (s mirrors p under A, z mirrors q), so they stay.
+            # Sustained disagreement still exits via flag 6 at the
+            # TIGHTER pipelined budget — replacement bounds drift per
+            # check; the counter catches a recurrence that keeps lying.
+            r_true = fext - kx
+            normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
+            disagree = ((normr_act > tolb)
+                        & (normr_act > jnp.asarray(
+                            FUSED_DRIFT_FACTOR, normr_act.dtype)
+                           * c["chk_normr"]))
+            drift = (c["drift"] + disagree).astype(jnp.int32)
+            # a CADENCE-forced check must not act as convergence
+            # candidacy (no MoreSteps/stagnation bookkeeping) nor tick
+            # the plateau/progress-window clocks (count_windows) — it
+            # only replaces the residual and re-primes; a natural check
+            # runs the full shared candidate epilogue
+            natural = c["chk_forced"] == 0
+            out = _resolve(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
+                           stag=c["stag"], normr_act=normr_act,
+                           candidate=natural, i=i,
+                           extra=dict(fresh=jnp.asarray(0, jnp.int32),
+                                      i=i, drift=drift,
+                                      init=jnp.asarray(1, jnp.int32),
+                                      sc=jnp.asarray(0, jnp.int32),
+                                      chk_forced=jnp.asarray(
+                                          0, jnp.int32)),
+                           count_windows=natural)
+            drift_exit = ((out["flag"] == 1)
+                          & (drift >= drift_limit))
+            out["flag"] = jnp.where(drift_exit, DRIFT_FLAG,
+                                    out["flag"]).astype(jnp.int32)
+            return out
+
+        return jax.lax.cond(is_check, post_check, post_work, (c, m, km))
+
+    loop_body = (body_pipelined if pipelined
+                 else body_fused if fused else body)
+    c = jax.lax.while_loop(cond, loop_body, carry0)
 
     # ---- finalize (reference pcg_solver.py:566-584): on any non-converged
     # exit return the minimal-residual iterate (MATLAB pcg semantics).
@@ -790,7 +1116,7 @@ def pcg(
         # we keep x consistent with the reported numbers instead.)
         r_min = fext - amul(c["xmin"])
         normr_min = jnp.sqrt(ops.wdot(w, r_min, r_min))
-        if fused:
+        if lagged:
             # pipelined lag: the carry x is the fresh iterate whose
             # residual was never evaluated, and normr_act belongs to its
             # predecessor — the min-residual iterate is the only
@@ -830,11 +1156,16 @@ def pcg(
         keys = ["x", "r", "p", "rho", "stag", "moresteps",
                 "normrmin", "xmin", "imin", "since_best",
                 "best_at_reset", "win_start", "win_count", "normr_act"]
-        if fused:
-            # the Chronopoulos–Gear recurrence state resumes like the
-            # rest of the Krylov carry (q = A.p, the previous alpha, the
+        if lagged:
+            # the recurrence state resumes like the rest of the Krylov
+            # carry (the A-chain vector q, the previous alpha, the
             # update-since-check gate, and the drift-guard count)
             keys += ["q", "alpha", "fresh", "drift"]
+        if pipelined:
+            # the GV vectors, the priming bit (a dispatch that exits
+            # before its priming trip ran must hand the armed bit to
+            # its successor) and the replacement-cadence counter
+            keys += ["u", "w", "s", "z", "init", "sc"]
         carry = {k: c[k] for k in keys}
         # Executed body-iteration count for host-side budget accounting
         # (result.iters reports the min-residual index on failure, which
@@ -1062,7 +1393,8 @@ def _colsel(mask, a, b):
     return jnp.where(mask[None, None, :], a, b)
 
 
-def cold_carry_many(x0, r0, normr0, dot_dtype, fused: bool = False) -> dict:
+def cold_carry_many(x0, r0, normr0, dot_dtype,
+                    variant: str = "classic") -> dict:
     """Blocked twin of :func:`cold_carry`: x0/r0 are (P, n_loc, R), the
     bookkeeping rides as (R,) vectors, and the per-RHS ``flag`` and
     ``prec_sel`` leaves (all-1 = running, all-0 = primary
@@ -1083,11 +1415,20 @@ def cold_carry_many(x0, r0, normr0, dot_dtype, fused: bool = False) -> dict:
         normr_act=n0, exec=zi,
         flag=jnp.ones((R,), jnp.int32),
         prec_sel=zi)
-    if fused:
+    if variant in LAGGED_VARIANTS:
         out["q"] = jnp.zeros_like(x0)
         out["alpha"] = jnp.full((R,), np.inf, dd)
         out["fresh"] = jnp.ones((R,), jnp.int32)
         out["drift"] = zi
+    if variant == "pipelined":
+        # GV recurrence vectors + per-COLUMN priming bits (a ladder-
+        # restarted column re-primes independently of its neighbors)
+        out["u"] = jnp.zeros_like(x0)
+        out["w"] = jnp.zeros_like(x0)
+        out["s"] = jnp.zeros_like(x0)
+        out["z"] = jnp.zeros_like(x0)
+        out["init"] = jnp.ones((R,), jnp.int32)
+        out["sc"] = zi
     return out
 
 
@@ -1133,7 +1474,7 @@ def select_best_many(ops: Ops, data: dict, fext: jnp.ndarray, carry: dict,
 
 def restart_carry_many(ops: Ops, data: dict, fext: jnp.ndarray,
                        carry: dict, restart_mask, fallback_mask,
-                       quarantine_mask, fused: bool = False) -> dict:
+                       quarantine_mask, variant: str = "classic") -> dict:
     """Per-column recovery surgery on a blocked resumable carry (the
     masked twin of the scalar ladder's min-residual restart,
     resilience/engine.run_many_with_recovery):
@@ -1177,12 +1518,21 @@ def restart_carry_many(ops: Ops, data: dict, fext: jnp.ndarray,
     out["flag"] = jnp.where(
         quarantine_mask, QUARANTINE_FLAG,
         jnp.where(m, 1, carry["flag"])).astype(jnp.int32)
-    if fused:
+    if variant in LAGGED_VARIANTS:
         out["q"] = _colsel(m, jnp.zeros_like(xmin), carry["q"])
         out["alpha"] = jnp.where(m, jnp.full((R,), np.inf, dd),
                                  carry["alpha"])
         out["fresh"] = jnp.where(m, 1, carry["fresh"]).astype(jnp.int32)
         out["drift"] = jnp.where(m, zi, carry["drift"]).astype(jnp.int32)
+    if variant == "pipelined":
+        # restarted columns drop their whole GV recurrence and re-ARM
+        # the priming bit: the column's next trip recomputes u/w from
+        # the restarted residual through the body's own precond/matvec
+        # (unmasked columns' chains pass through bitwise, as ever)
+        for k in ("u", "w", "s", "z"):
+            out[k] = _colsel(m, jnp.zeros_like(xmin), carry[k])
+        out["init"] = jnp.where(m, 1, carry["init"]).astype(jnp.int32)
+        out["sc"] = jnp.where(m, zi, carry["sc"]).astype(jnp.int32)
     return out
 
 
@@ -1229,6 +1579,9 @@ def pcg_many(
         raise ValueError(f"pcg variant must be one of "
                          f"{VALID_PCG_VARIANTS}, got {variant!r}")
     fused = variant == "fused"
+    pipelined = variant == "pipelined"
+    lagged = variant in LAGGED_VARIANTS
+    drift_limit = drift_limit_for(variant)
     warm = carry_in is not None
     eff = data["eff"]
     w = data["weight"] * eff
@@ -1261,9 +1614,10 @@ def pcg_many(
             normr0 = jnp.sqrt(ops.wdot_many(w, r0, r0))
 
     zero_rhs = n2b == 0
-    if fused and warm:
-        # warm fused normr0 is the predecessor iterate's norm (pipelined
-        # lag) — never flag-0 the unevaluated resumed column off it
+    if lagged and warm:
+        # warm recurrence-variant normr0 is the predecessor iterate's
+        # norm (pipelined lag) — never flag-0 the unevaluated resumed
+        # column off it
         initial_ok = jnp.zeros((R,), bool)
     else:
         initial_ok = normr0 <= tolb
@@ -1296,7 +1650,7 @@ def pcg_many(
         # recovery state that must resume with the rest of the carry
         prec_sel=(carry_in["prec_sel"] if warm else zi),
     )
-    if fused:
+    if lagged:
         carry0["q"] = carry_in["q"] if warm else jnp.zeros_like(x0)
         carry0["alpha"] = (carry_in["alpha"] if warm
                            else jnp.full((R,), np.inf, dd))
@@ -1304,27 +1658,42 @@ def pcg_many(
                            else jnp.ones((R,), jnp.int32))
         carry0["drift"] = carry_in["drift"] if warm else zi
         carry0["chk_normr"] = jnp.zeros((R,), dd)
+    if pipelined:
+        for k in ("u", "w", "s", "z"):
+            carry0[k] = carry_in[k] if warm else jnp.zeros_like(x0)
+        carry0["init"] = (carry_in["init"] if warm
+                          else jnp.ones((R,), jnp.int32))
+        carry0["sc"] = carry_in["sc"] if warm else zi
+        # internal forced-check marker (see the scalar carry0)
+        carry0["chk_forced"] = zi
 
-    def _prec_apply(c):
+    def _prec_apply(c, src=None):
         """Per-column preconditioner apply: the primary inverse, with
         ``prec_sel`` columns flipped to the fallback inverse when one is
-        wired (collective-free — the psum budget is untouched)."""
-        z = ops.apply_prec(inv_diag, c["r"], data=data)
+        wired (collective-free — the psum budget is untouched).
+        ``src`` overrides the preconditioned vector (default the carry
+        residual; the pipelined body passes its per-column r/w
+        select)."""
+        src = c["r"] if src is None else src
+        z = ops.apply_prec(inv_diag, src, data=data)
         if inv_diag_fb is not None:
             z = _colsel(c["prec_sel"] > 0,
-                        ops.apply_prec(inv_diag_fb, c["r"]), z)
+                        ops.apply_prec(inv_diag_fb, src), z)
         return z
 
     def cond(c):
         return jnp.any((c["flag"] == 1) & (c["i"] < max_iter))
 
     def _resolve_many(c, x, r, p, rho, stag, normr_act, candidate, i,
-                      extra=None):
+                      extra=None, count_windows=None):
         """Elementwise (per-column) twin of ``pcg``'s ``_resolve``: the
         shared iteration epilogue, with every scalar decision an (R,)
         vector.  ``extra`` overrides output entries AFTER the
         bookkeeping (the fused body commits fresh vectors while the
-        epilogue resolves the lagged iterate)."""
+        epilogue resolves the lagged iterate).  ``count_windows`` (an
+        (R,) bool, default always-on) gates the per-column plateau/
+        progress-window clocks exactly like the scalar ``_resolve``'s:
+        a cadence-forced pipelined column check must not tick them."""
         converged = candidate & (normr_act <= tolb)
         stag = jnp.where(candidate & ~converged
                          & (stag >= max_stag_steps) & (c["moresteps"] == 0),
@@ -1362,6 +1731,20 @@ def pcg_many(
         else:
             no_progress = jnp.zeros((R,), bool)
             win_start, win_count = c["win_start"], c["win_count"]
+
+        if count_windows is not None:
+            # frozen per-column window clocks (forced checks) — see the
+            # scalar _resolve
+            tick = count_windows
+            since_best = jnp.where(tick, since_best,
+                                   c["since_best"]).astype(jnp.int32)
+            best_at_reset = jnp.where(tick, best_at_reset,
+                                      c["best_at_reset"])
+            win_start = jnp.where(tick, win_start, c["win_start"])
+            win_count = jnp.where(tick, win_count,
+                                  c["win_count"]).astype(jnp.int32)
+            plateaued = plateaued & tick
+            no_progress = no_progress & tick
 
         flag = jnp.where(converged, 0,
                 jnp.where(toosmall | stagnated | plateaued | no_progress, 3,
@@ -1553,7 +1936,7 @@ def pcg_many(
                             extra=dict(q=c["q"], alpha=c["alpha"],
                                        fresh=jnp.zeros((R,), jnp.int32),
                                        i=i, drift=drift))
-        drift_exit = (chk["flag"] == 1) & (drift >= FUSED_DRIFT_LIMIT)
+        drift_exit = (chk["flag"] == 1) & (drift >= drift_limit)
         chk["flag"] = jnp.where(drift_exit, DRIFT_FLAG,
                                 chk["flag"]).astype(jnp.int32)
 
@@ -1563,7 +1946,133 @@ def pcg_many(
         return _merge_cases(c, [(is_check, chk), (m_brk, brk),
                                 (m_pend, pend), (m_res, res)])
 
-    c = jax.lax.while_loop(cond, body_fused if fused else body, carry0)
+    def body_pipelined(c):
+        """Ghysels–Vanroose depth-1 pipelined blocked body: the ONE
+        fused psum (a (6, R) payload) consumes ONLY previous-iteration
+        carry leaves — gamma = <r,u>, delta = <w,u>, the residual/
+        stagnation norms, the inf-prec flag off ``u`` — and the blocked
+        precond apply + stencil matvec consume only carry leaves too,
+        so the psum and the matvec are data-independent both ways
+        (the analysis/ psum-overlap rule's contract; see the scalar
+        ``body_pipelined``).  3 body psums (fused + iface + deferred
+        check), independent of nrhs.  Per-column trip kinds: mode-1
+        deferred check, per-column PRIMING (armed ``init`` bits:
+        u0 = M^-1.r0, w0 = A.u0 — a ladder-restarted column re-primes
+        alone), and the GV recurrence advance."""
+        i = c["i"]
+        active = (c["flag"] == 1) & (i < max_iter)
+        is_check = (c["mode"] == 1) & active
+        is_prime = (c["init"] > 0) & active & ~is_check
+        it_m = active & ~is_check & ~is_prime
+
+        # ---- the ONE fused psum: carry-state operands only ------------
+        inf_col = jnp.isinf(c["u"]).any(axis=(0, 1)).astype(dd)
+        red = ops.wdots_many(w, [(c["r"], c["u"]), (c["w"], c["u"]),
+                                 (c["r"], c["r"]), (c["p"], c["p"]),
+                                 (c["x"], c["x"])], extra=[inf_col])
+        gamma, delta = red[0], red[1]
+        normr = jnp.sqrt(red[2])
+        normp, normx = jnp.sqrt(red[3]), jnp.sqrt(red[4])
+        flag2 = red[5] > 0
+
+        # per-column precond source: priming columns precondition their
+        # residual, iterating columns their w; check columns' apply is
+        # discarded by the operand select below.  All carry leaves — the
+        # apply/matvec chain never waits on the psum above.
+        m = _prec_apply(c, src=_colsel(c["init"] > 0, c["r"], c["w"]))
+        operand = _colsel(is_check, c["x"], m)
+        kop = amul(operand)
+
+        already = c["fresh"] == 0
+        small = normp * jnp.abs(c["alpha"]) < eps * normx
+        stag = jnp.where(already, c["stag"],
+                         jnp.where(small, c["stag"] + 1,
+                                   0)).astype(jnp.int32)
+        natural = ((normr <= tolb) | (stag >= max_stag_steps)
+                   | (c["moresteps"] > 0))
+        # per-column forced replacement cadence (see the scalar body)
+        forced = c["sc"] >= PIPELINED_REPLACE_EVERY
+        candidate = (natural | forced) & ~already
+
+        bad_rho = (gamma == 0) | jnp.isinf(gamma)
+        beta = gamma / c["rho"]
+        bad_beta = (beta == 0) | jnp.isinf(beta)
+        pq = delta - beta * gamma / c["alpha"]
+        bad_pq = (pq <= 0) | jnp.isinf(pq)
+        alpha = gamma / pq
+        bad_alpha = jnp.isinf(alpha)
+        breakdown = bad_rho | bad_beta | bad_pq | bad_alpha
+        new_flag = jnp.where(flag2, 2,
+                             jnp.where(breakdown, 4, 1)).astype(jnp.int32)
+
+        beta_dt = beta.astype(dt)[None, None, :]
+        alpha_dt = alpha.astype(dt)[None, None, :]
+        p2 = c["u"] + beta_dt * c["p"]       # p = 0 cold => p2 = u
+        s2 = c["w"] + beta_dt * c["s"]       # A.p by recurrence
+        q2 = m + beta_dt * c["q"]            # M^-1.s by recurrence
+        z2 = kop + beta_dt * c["z"]          # A.q by recurrence
+        x2 = c["x"] + alpha_dt * p2
+        r2 = c["r"] - alpha_dt * s2
+        u2 = c["u"] - alpha_dt * q2          # M^-1.r by recurrence
+        w2 = c["w"] - alpha_dt * z2          # A.u by recurrence
+
+        res = _resolve_many(
+            c, x=c["x"], r=c["r"], p=c["p"], rho=gamma, stag=stag,
+            normr_act=normr.astype(dd),
+            candidate=jnp.zeros((R,), bool), i=i,
+            extra=dict(x=x2, r=r2, p=p2, u=u2, w=w2, s=s2, q=q2, z=z2,
+                       alpha=alpha.astype(dd),
+                       fresh=jnp.ones((R,), jnp.int32),
+                       sc=(c["sc"] + 1).astype(jnp.int32)))
+        pend = dict(c, stag=stag, iter_out=i,
+                    mode=jnp.ones((R,), jnp.int32),
+                    chk_normr=jnp.where(candidate, normr.astype(dd),
+                                        c["chk_normr"]),
+                    chk_forced=(forced & ~natural).astype(jnp.int32))
+        brk = dict(c, flag=new_flag, iter_out=i, rho=gamma)
+        # priming commit: u0/w0 land, the bit clears, nothing advances
+        prime = dict(c, u=m, w=kop,
+                     init=jnp.zeros((R,), jnp.int32))
+
+        # deferred check (kop = A.x for check columns) with per-column
+        # TRUE-RESIDUAL REPLACEMENT (see the scalar post_check): the
+        # column's residual is replaced with the honest one and its
+        # priming bit re-armed so u/w re-sync next trip; the TIGHTER
+        # pipelined drift budget still gates flag 6
+        r_true = fext - kop
+        normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
+        disagree = ((normr_chk > tolb)
+                    & (normr_chk > jnp.asarray(FUSED_DRIFT_FACTOR, dd)
+                       * c["chk_normr"]))
+        drift = (c["drift"] + disagree).astype(jnp.int32)
+        # a cadence-forced column check replaces/re-primes only — no
+        # MoreSteps/candidacy bookkeeping, no plateau/progress-window
+        # ticks (count_windows; see the scalar post_check)
+        chk_nat = c["chk_forced"] == 0
+        chk = _resolve_many(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
+                            stag=c["stag"], normr_act=normr_chk,
+                            candidate=chk_nat, i=i,
+                            extra=dict(fresh=jnp.zeros((R,), jnp.int32),
+                                       i=i, drift=drift,
+                                       init=jnp.ones((R,), jnp.int32),
+                                       sc=jnp.zeros((R,), jnp.int32),
+                                       chk_forced=jnp.zeros(
+                                           (R,), jnp.int32)),
+                            count_windows=chk_nat)
+        drift_exit = (chk["flag"] == 1) & (drift >= drift_limit)
+        chk["flag"] = jnp.where(drift_exit, DRIFT_FLAG,
+                                chk["flag"]).astype(jnp.int32)
+
+        m_brk = it_m & (flag2 | breakdown) & ~candidate
+        m_pend = it_m & candidate
+        m_res = it_m & ~candidate & ~(flag2 | breakdown)
+        return _merge_cases(c, [(is_check, chk), (is_prime, prime),
+                                (m_brk, brk), (m_pend, pend),
+                                (m_res, res)])
+
+    loop_body = (body_pipelined if pipelined
+                 else body_fused if fused else body)
+    c = jax.lax.while_loop(cond, loop_body, carry0)
 
     skip_mask = zero_rhs | initial_ok | frozen0
 
@@ -1574,7 +2083,7 @@ def pcg_many(
         # blocked matvec for the whole block
         r_min = fext - amul(c["xmin"])
         normr_min = jnp.sqrt(ops.wdot_many(w, r_min, r_min))
-        if fused:
+        if lagged:
             x_bad, relres_bad = c["xmin"], normr_min / n2b
             iters_bad = c["imin"]
         else:
@@ -1620,8 +2129,10 @@ def pcg_many(
                 "normrmin", "xmin", "imin", "since_best",
                 "best_at_reset", "win_start", "win_count", "normr_act",
                 "prec_sel"]
-        if fused:
+        if lagged:
             keys += ["q", "alpha", "fresh", "drift"]
+        if pipelined:
+            keys += ["u", "w", "s", "z", "init", "sc"]
         carry = {k: c[k] for k in keys}
         carry["flag"] = flag
         # executed body-iteration count per column; columns that never

@@ -33,7 +33,7 @@ from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS, make_mesh
 # ---------------------------------------------------------------------------
 
 def _toy_program(body_fn, budget, role="f64", dtype=jnp.float64,
-                 n_trips=3):
+                 n_trips=3, variant="classic", width=8):
     """A 2-part shard_map'd while-loop program, traced like the real
     canonical matrix entries."""
     mesh = make_mesh(2)
@@ -47,8 +47,8 @@ def _toy_program(body_fn, budget, role="f64", dtype=jnp.float64,
 
     fn = jax.jit(jax.shard_map(prog, mesh=mesh, in_specs=(P,),
                                out_specs=P, check_vma=False))
-    jx = jax.make_jaxpr(fn)(jnp.zeros((2, 8), dtype))
-    return Program(name="toy", backend="general", variant="classic",
+    jx = jax.make_jaxpr(fn)(jnp.zeros((2, width), dtype))
+    return Program(name="toy", backend="general", variant=variant,
                    nrhs=1, role=role, jaxpr=jx,
                    collective_budget=budget, n_iface=1)
 
@@ -108,13 +108,156 @@ def test_budget_table_matches_comm_estimate_gauges():
     from pcg_mpi_solver_tpu.ops.matvec import (
         Ops, PCG_DEFERRED_CHECK_PSUMS)
 
+    from pcg_mpi_solver_tpu.config import PCG_VARIANTS
+
     ops = Ops(n_loc=8, n_iface=4)
-    for variant in ("classic", "fused"):
+    for variant in PCG_VARIANTS:
         gauge = ops.comm_estimate(variant=variant)["psums_per_iter"]
         budget = ops.body_collective_budget(variant)["psum"]
         assert budget == gauge + PCG_DEFERRED_CHECK_PSUMS
+    # pipelined's contract: ONE scalar psum, same count as fused —
+    # the win is overlap (psum-overlap rule), not fewer collectives
+    assert ops.comm_estimate(variant="pipelined")["psums_per_iter"] == \
+        ops.comm_estimate(variant="fused")["psums_per_iter"]
     with pytest.raises(KeyError):
-        ops.body_collective_budget("pipelined")   # unknown variant: loud
+        ops.body_collective_budget("frobnicate")  # unknown variant: loud
+    with pytest.raises(KeyError):
+        ops.comm_estimate(variant="frobnicate")
+
+
+# ---------------------------------------------------------------------------
+# rule: psum-overlap (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def _overlapped_body(c):
+    """Pipelined-shaped toy: the scalar psum reads only carry state and
+    nothing downstream of it feeds the 'stencil' psum — independent in
+    both directions, exactly the GV overlap property.  The trailing
+    'deferred check' psum consumes the stencil output, like the real
+    body (so the stencil collective itself is NOT independent)."""
+    i, v = c
+    a = jax.lax.psum(jnp.sum(v), PARTS_AXIS)       # overlappable scalar
+    b = jax.lax.psum(v, PARTS_AXIS)                # 'stencil' collective
+    chk = jax.lax.psum(jnp.sum(b), PARTS_AXIS)     # check reads stencil
+    return i + 1, v + b + a + chk
+
+
+def _serialized_body(c):
+    """The regression the rule exists to catch: the scalar reduction
+    consumes the stencil collective's output (the fused variant's
+    serialization, reintroduced into a body claiming overlap)."""
+    i, v = c
+    b = jax.lax.psum(v, PARTS_AXIS)
+    a = jax.lax.psum(jnp.sum(b), PARTS_AXIS)
+    return i + 1, v + b + a
+
+
+def test_psum_overlap_clean_on_overlapped_pipelined_body():
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import check_psum_overlap
+
+    prog = _toy_program(_overlapped_body, {"psum": 3},
+                        variant="pipelined")
+    assert check_psum_overlap(prog) == []
+
+
+def test_psum_overlap_fires_on_serialized_pipelined_body():
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import check_psum_overlap
+
+    prog = _toy_program(_serialized_body, {"psum": 2},
+                        variant="pipelined")
+    findings = check_psum_overlap(prog)
+    assert len(findings) == 1 and findings[0].rule == "psum-overlap"
+    assert "serialized" in findings[0].message
+
+
+def test_psum_overlap_fires_on_feeding_direction_too():
+    """Serialization in the OTHER direction (the classic shape: the
+    reduction's output feeds the stencil collective's operand) must
+    fail a pipelined body as well — overlap demands independence both
+    ways."""
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import check_psum_overlap
+
+    def body(c):
+        i, v = c
+        a = jax.lax.psum(jnp.sum(v), PARTS_AXIS)
+        b = jax.lax.psum(v * a, PARTS_AXIS)        # stencil consumes a
+        return i + 1, v + b
+
+    prog = _toy_program(body, {"psum": 2}, variant="pipelined")
+    assert check_psum_overlap(prog) != []
+
+
+def test_psum_overlap_negative_control_guards_the_walker():
+    """An 'independent' psum showing up in a classic/fused body means
+    the dependency walker lost edges — the rule must fail loudly there
+    instead of letting the pipelined proof go vacuous."""
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import check_psum_overlap
+
+    clean = _toy_program(_serialized_body, {"psum": 2}, variant="fused")
+    assert check_psum_overlap(clean) == []
+    broken = _toy_program(_overlapped_body, {"psum": 3}, variant="fused")
+    findings = check_psum_overlap(broken)
+    assert findings and "walker" in findings[0].message
+
+
+def test_psum_overlap_rejects_vector_payload_as_the_independent_psum():
+    """The one independent psum must be the small stacked scalar
+    reduction; a stencil-sized payload that merely lost its consumers
+    is not the latency-hiding claim."""
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import check_psum_overlap
+
+    def body(c):
+        i, v = c
+        a = jax.lax.psum(v, PARTS_AXIS)            # vector, no consumers
+        b = jax.lax.psum(v * 2.0, PARTS_AXIS)
+        cden = jax.lax.psum(jnp.sum(b), PARTS_AXIS)
+        return i + 1, v + b + cden + jax.lax.stop_gradient(a) * 0.0
+
+    prog = _toy_program(body, {"psum": 3}, variant="pipelined", width=64)
+    findings = check_psum_overlap(prog)
+    assert findings and "payload" in findings[0].message
+
+
+def test_psum_overlap_conservative_on_nested_loops():
+    """Collectives inside a nested while/scan are marked mutually
+    dependent (loop feedback can wire anything to anything) — the safe
+    over-approximation: a pipelined body whose only psums live in a
+    nested loop proves NOTHING overlappable, rather than vacuously
+    passing."""
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import check_psum_overlap
+
+    def body(c):
+        i, v = c
+
+        def inner(j, acc):
+            return acc + jax.lax.psum(acc, PARTS_AXIS) \
+                + jax.lax.psum(jnp.sum(acc), PARTS_AXIS)
+
+        return i + 1, jax.lax.fori_loop(0, 2, inner, v)
+
+    prog = _toy_program(body, {"psum": 2}, variant="pipelined")
+    assert check_psum_overlap(prog) != []
+
+
+def test_psum_overlap_conservative_on_singleton_nested_loop_psum():
+    """The degenerate nested-loop case: a body whose ONLY scalar psum
+    sits inside a nested fori_loop.  Mutual marking between nested
+    collectives is vacuous for a singleton, so the walker must mark it
+    SELF-dependent (its prior trip feeds it through loop carry) — the
+    rule fails rather than certifying a serialized-inside-a-loop psum
+    as the overlappable reduction."""
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import check_psum_overlap
+
+    def body(c):
+        i, v = c
+
+        def inner(j, acc):
+            return acc + jax.lax.psum(jnp.sum(acc), PARTS_AXIS)
+
+        return i + 1, jax.lax.fori_loop(0, 2, inner, v)
+
+    prog = _toy_program(body, {"psum": 1}, variant="pipelined")
+    assert check_psum_overlap(prog) != []
 
 
 # ---------------------------------------------------------------------------

@@ -76,6 +76,99 @@ def test_run_step_ok_rcs_verdict_exits(tmp_path, monkeypatch):
     assert hw_session._last_step_ok is False
 
 
+def test_priority_queue_step_order_has_pipelined_after_fused(tmp_path,
+                                                             monkeypatch):
+    """ISSUE 11: the priority preset's variant A/B must run classic ->
+    fused -> pipelined as ADJACENT bench steps sharing one warm cache
+    dir (three adjacent lines = the 3-way ms/iter A/B), with the lint
+    gate still step 0 and Pallas v9 still in the queue.  Recorded by
+    monkeypatching run_step — no accelerator, no subprocesses."""
+    from tools import hw_session
+
+    steps = []
+
+    def fake_run_step(path, name, argv, env_extra=None, **kw):
+        steps.append((name, dict(env_extra or {})))
+        return "rc=0"
+
+    monkeypatch.setattr(hw_session, "run_step", fake_run_step)
+    hw_session.run_priority_queue(str(tmp_path / "log.txt"), quick=True)
+
+    names = [n for n, _ in steps]
+    assert names[0] == "contract lint (step 0)"
+    # the overlap lint gates the pipelined leg (the fast lint can't:
+    # psum-overlap is fast=False and --fast traces no pipelined
+    # programs) — it runs on CPU, before any hardware step
+    i_ov = names.index("overlap lint (step 0.2)")
+    i_c = names.index("flagship classic")
+    i_f = names.index("flagship fused")
+    i_p = names.index("flagship pipelined")
+    assert 0 < i_ov < i_c < i_f < i_p and i_p == i_f + 1, names
+    env = dict(steps)
+    assert env["overlap lint (step 0.2)"]["JAX_PLATFORMS"] == "cpu"
+    assert env["flagship pipelined"]["BENCH_PCG_VARIANT"] == "pipelined"
+    assert env["flagship fused"]["BENCH_PCG_VARIANT"] == "fused"
+    assert "BENCH_PCG_VARIANT" not in env["flagship classic"]
+    # the three variant legs share ONE warm cache dir (the A/B contract:
+    # steps 2-3 reuse step 1's caches) and one pinned size
+    for leg in ("flagship classic", "flagship fused", "flagship pipelined"):
+        assert env[leg].get("BENCH_CACHE_DIR") == \
+            env["flagship classic"]["BENCH_CACHE_DIR"]
+        assert env[leg].get("BENCH_NX") == env["flagship classic"]["BENCH_NX"]
+    # the rest of the queue survives the insertion
+    assert any(n.startswith("mg A/B") for n in names)
+    assert "matvec A/B v9" in names
+
+
+def test_priority_queue_aborts_on_lint_failure(tmp_path, monkeypatch):
+    """A FAILED step-0 lint must abort before any hardware step — the
+    pipelined leg's overlap claim is exactly what the lint proves, so
+    measuring after a FAIL would benchmark a disproven claim."""
+    from tools import hw_session
+
+    steps = []
+
+    def fake_run_step(path, name, argv, env_extra=None, **kw):
+        steps.append(name)
+        return "rc=1"
+
+    monkeypatch.setattr(hw_session, "run_step", fake_run_step)
+    hw_session.run_priority_queue(str(tmp_path / "log.txt"), quick=True)
+    assert steps == ["contract lint (step 0)"]
+
+
+def test_priority_queue_overlap_lint_failure_skips_pipelined_only(
+        tmp_path, monkeypatch):
+    """A FAILED step-0.2 overlap lint must skip ONLY the pipelined leg
+    (its ms/iter number would measure a disproven latency-hiding claim)
+    while the classic/fused/MG/nrhs/Pallas steps — none of which depend
+    on the overlap claim — still run and use the window."""
+    from tools import hw_session
+
+    steps = []
+
+    def fake_run_step(path, name, argv, env_extra=None, **kw):
+        steps.append((name, list(argv)))
+        if name == "overlap lint (step 0.2)":
+            return "rc=1"
+        return "rc=0"
+
+    monkeypatch.setattr(hw_session, "run_step", fake_run_step)
+    hw_session.run_priority_queue(str(tmp_path / "log.txt"), quick=True)
+
+    names = [n for n, _ in steps]
+    assert "flagship pipelined" not in names
+    for kept in ("flagship classic", "flagship fused",
+                 "mg A/B anchor (jacobi)", "matvec A/B v9"):
+        assert any(n.startswith(kept) for n in names), (kept, names)
+    # the step really invokes the psum-overlap rule alone (full tier)
+    argv = dict(steps)["overlap lint (step 0.2)"]
+    assert "--rules" in argv and "psum-overlap" in argv
+    assert "--fast" not in argv
+    log = (tmp_path / "log.txt").read_text()
+    assert "SKIPPING the flagship pipelined leg" in log
+
+
 def test_parse_ab_missing_marker_or_file_returns_none(tmp_path):
     """ADVICE r05 #3: a missing marker (step died before its section
     header) or an unreadable log must not raise out of _parse_ab — the

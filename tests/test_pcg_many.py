@@ -287,7 +287,7 @@ def _fast_backoff(monkeypatch):
     monkeypatch.setenv("PCG_TPU_RETRY_BACKOFF_S", "0.01")
 
 
-@pytest.mark.parametrize("variant", ["classic", "fused"])
+@pytest.mark.parametrize("variant", ["classic", "fused", "pipelined"])
 def test_chunked_column_fault_chaos_matrix(model, tmp_path, variant):
     """Chaos matrix, chunked blocked path: each of {nan, inf, rho0}
     injected into ONE column engages that column's recovery ladder

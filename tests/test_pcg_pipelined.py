@@ -1,0 +1,600 @@
+"""Pipelined (Ghysels–Vanroose depth-1) PCG variant
+(SolverConfig.pcg_variant="pipelined", ISSUE 11): convergence parity
+with classic on the golden model, chunked-dispatch and kill-and-resume
+bit-identity, cross-variant resume rejection, recovery-ladder
+compatibility under fault injection, the tighter flag-6 drift guard,
+MG composition, and the single-source variant-table plumbing (config /
+cache key / CLI / collective tables).  The overlap claim itself — the
+body's one fused psum is data-independent of the stencil matvec — is
+proven statically by the analysis/ psum-overlap rule
+(tests/test_analysis.py seeds its violations); here the same dependency
+walker is run once against the REAL traced pipelined loop so tier-1
+covers the claim without the full (slow) lint matrix."""
+
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.config import (
+    PCG_VARIANTS, RunConfig, SolverConfig, TimeHistoryConfig)
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+from pcg_mpi_solver_tpu.resilience import FaultPlan, SimulatedKill
+from pcg_mpi_solver_tpu.solver.driver import Solver
+
+
+@pytest.fixture(scope="module")
+def model():
+    # the golden cube (tests/test_goldens.py): 6x5x5 heterogeneous
+    return make_cube_model(6, 5, 5, h=0.5, nu=0.3, heterogeneous=True,
+                           seed=0)
+
+
+def _cfg(variant, tmp_path=None, run_id="1", **solver_kw):
+    solver_kw.setdefault("tol", 1e-8)
+    solver_kw.setdefault("max_iter", 2000)
+    cfg = RunConfig(
+        solver=SolverConfig(pcg_variant=variant, **solver_kw),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0],
+                                       export_flag=False),
+    )
+    cfg.run_id = run_id
+    if tmp_path is not None:
+        cfg.scratch_path = str(tmp_path)
+    return cfg
+
+
+def _iters_close(pipelined, classic):
+    """Acceptance bar (ISSUE 11): pipelined iteration count within 5%
+    of classic (+2 absolute slack for the one-trip lag on tiny
+    counts)."""
+    assert abs(pipelined - classic) <= max(2, int(0.05 * classic) + 1), \
+        (pipelined, classic)
+
+
+# ----------------------------------------------------------------------
+# Convergence parity (golden + scipy)
+# ----------------------------------------------------------------------
+
+def test_pipelined_parity_direct_golden(model):
+    """flag=0 on the golden heterogeneous cube, iteration count within
+    5% of classic, identical solution to ~tol — the ISSUE-11 acceptance
+    line."""
+    rs = {}
+    for variant in ("classic", "pipelined"):
+        s = Solver(model, _cfg(variant), mesh=make_mesh(4), n_parts=4)
+        rs[variant] = (s.step(1.0),
+                       float(np.abs(s.displacement_global()).sum()))
+    rc, cc = rs["classic"]
+    rp, cp = rs["pipelined"]
+    assert rc.flag == 0 and rp.flag == 0
+    assert rp.relres <= 1e-8 * 1.001
+    _iters_close(rp.iters, rc.iters)
+    assert np.isclose(cp, cc, rtol=1e-6)
+
+
+def test_pipelined_parity_mixed(model):
+    """Mixed precision with pipelined f32 inner cycles: converges to
+    the outer tolerance.  The f32 GV recurrence pays for its overlap
+    with a lower attainable accuracy per cycle even under the
+    PIPELINED_REPLACE_EVERY refresh (arXiv:2501.03743 §4), so the
+    documented bound is ~1.35x classic's total inner iterations, not
+    the direct path's 5% (docs/RUNBOOK.md: prefer classic/fused for
+    mixed unless reduction latency dominates the iteration)."""
+    rs = {}
+    for variant in ("classic", "pipelined"):
+        s = Solver(model, _cfg(variant, precision_mode="mixed"),
+                   mesh=make_mesh(4), n_parts=4)
+        rs[variant] = s.step(1.0)
+    assert rs["classic"].flag == 0 and rs["pipelined"].flag == 0
+    assert rs["pipelined"].relres <= 1e-8 * 1.001
+    assert rs["pipelined"].iters <= 1.35 * rs["classic"].iters, \
+        (rs["pipelined"].iters, rs["classic"].iters)
+
+
+def test_pipelined_matches_scipy():
+    from scipy.sparse.linalg import spsolve
+
+    model = make_cube_model(4, 3, 3, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    s = Solver(model, _cfg("pipelined"), mesh=make_mesh(1), n_parts=1)
+    res = s.step(1.0)
+    assert res.flag == 0
+    K = model.assemble_csr()
+    eff = model.dof_eff
+    rhs = (model.F - K @ model.Ud)[eff]
+    u_ref = np.array(model.Ud)
+    u_ref[eff] += spsolve(K[eff][:, eff].tocsc(), rhs)
+    np.testing.assert_allclose(s.displacement_global(), u_ref,
+                               rtol=1e-5, atol=1e-8 * np.abs(u_ref).max())
+
+
+def test_pipelined_trace_ring(model):
+    """The in-graph convergence ring works unchanged under the
+    pipelined body (one slot per resolved iteration; the priming trip
+    writes none)."""
+    s = Solver(model, _cfg("pipelined", trace_resid=64),
+               mesh=make_mesh(1), n_parts=1)
+    res = s.step(1.0)
+    assert res.flag == 0
+    tr = s.last_trace
+    assert tr is not None and tr.n_recorded > 0
+    assert tr.flag[-1] == 0
+    assert tr.normr[-1] < tr.normr[0]
+
+
+# ----------------------------------------------------------------------
+# The overlap property on the REAL traced loop (tier-1 twin of the
+# full-lint psum-overlap rule)
+# ----------------------------------------------------------------------
+
+_SETUP = {}
+
+
+def _direct_pcg_setup(nx=5):
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+    from pcg_mpi_solver_tpu.parallel.partition import partition_model
+
+    if nx in _SETUP:
+        return _SETUP[nx]
+    m = make_cube_model(nx, 4, 4, h=0.5, nu=0.3, load="traction",
+                        heterogeneous=True)
+    pm = partition_model(m, 1)
+    data = device_data(pm, jnp.float64)
+    ops = Ops.from_model(pm, dot_dtype=jnp.float64)
+    eff = data["eff"]
+    fext = eff * data["F"]
+    d = eff * ops.diag(data)
+    inv = jnp.where(d != 0, 1.0 / jnp.where(d != 0, d, 1.0), 0.0)
+    _SETUP[nx] = (m, pm, ops, data, fext, inv)
+    return _SETUP[nx]
+
+
+def test_pipelined_body_psum_is_independent_of_the_stencil():
+    """Trace the bare pipelined loop on a REAL 2-part partition and run
+    the psum-overlap dependency analysis: exactly one fully
+    data-independent psum (the (6,) fused reduction), while the same
+    analysis on the fused loop shows zero — the latency-hiding claim,
+    chipless, in tier-1."""
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.analysis import jaxpr_utils as ju
+    from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
+    from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS
+    from pcg_mpi_solver_tpu.parallel.partition import partition_model
+    from pcg_mpi_solver_tpu.solver.driver import _data_specs
+    from pcg_mpi_solver_tpu.solver.pcg import pcg
+
+    m = make_cube_model(3, 3, 3)
+    pm = partition_model(m, 2)
+    assert pm.n_iface > 0, "the claim needs the interface psum present"
+    data = device_data(pm, jnp.float64)
+    ops = Ops.from_model(pm, dot_dtype=jnp.float64, axis_name=PARTS_AXIS)
+    mesh = make_mesh(2)
+    P = jax.sharding.PartitionSpec(PARTS_AXIS)
+
+    def trace_variant(variant):
+        def step(data, fext, x0, inv_diag):
+            res = pcg(ops, data, fext, x0, inv_diag, tol=1e-8,
+                      max_iter=50, glob_n_dof_eff=pm.glob_n_dof_eff,
+                      variant=variant)
+            return res.x
+
+        fn = jax.shard_map(step, mesh=mesh,
+                           in_specs=(_data_specs(data), P, P, P),
+                           out_specs=P, check_vma=False)
+        z = jnp.zeros((pm.n_parts, pm.n_loc), jnp.float64)
+        jx = jax.make_jaxpr(fn)(data, z, z, z)
+        bodies = [ju.while_body(e) for e in ju.while_eqns(jx.jaxpr)
+                  if ju.collective_histogram(ju.while_body(e))]
+        assert len(bodies) == 1
+        return ju.independent_collectives(bodies[0])
+
+    indep_p = trace_variant("pipelined")
+    assert len(indep_p) == 1 and indep_p[0]["primitive"] == "psum"
+    assert indep_p[0]["out_size"] == 6          # the stacked reduction
+    assert trace_variant("fused") == []          # serialized, as documented
+
+
+# ----------------------------------------------------------------------
+# Resumable dispatch: chunked bit-identity, kill-and-resume
+# ----------------------------------------------------------------------
+
+def test_pipelined_chunked_bit_identical_to_oneshot(model):
+    """The GV recurrence state (u/w/s/q/z + init) rides the resumable
+    carry, so capped pipelined dispatches are bit-identical to one long
+    pipelined solve — including across the cold start's priming trip."""
+    s1 = Solver(model, _cfg("pipelined"), mesh=make_mesh(4), n_parts=4)
+    r1 = s1.step(1.0)
+    s2 = Solver(model, _cfg("pipelined", iters_per_dispatch=12),
+                mesh=make_mesh(4), n_parts=4)
+    r2 = s2.step(1.0)
+    assert r1.flag == r2.flag == 0
+    assert r1.iters == r2.iters
+    assert r1.relres == r2.relres
+    np.testing.assert_array_equal(s1.displacement_global(),
+                                  s2.displacement_global())
+
+
+def test_pipelined_snapshot_kill_resume_bit_identity(model, tmp_path):
+    """Mid-Krylov snapshot/resume round-trips the pipelined carry
+    (incl. the four GV vectors and the priming bit): a kill at a chunk
+    boundary plus --resume reproduces the uninterrupted solve
+    bit-identically."""
+    def cfg(run_id):
+        c = _cfg("pipelined", tmp_path, run_id=run_id,
+                 iters_per_dispatch=12)
+        c.checkpoint_every = 1
+        c.snapshot_every = 1
+        return c
+
+    sa = Solver(model, cfg("pa"), mesh=make_mesh(4), n_parts=4)
+    sa.solve()
+    ck = cfg("pk")
+    sk = Solver(model, ck, mesh=make_mesh(4), n_parts=4)
+    sk.fault_plan = FaultPlan("kill@2")
+    with pytest.raises(SimulatedKill):
+        sk.solve()
+    sk2 = Solver(model, ck, mesh=make_mesh(4), n_parts=4)
+    sk2.solve(resume=True)
+    assert sk2.flags == sa.flags and sk2.iters == sa.iters
+    assert sk2.relres == sa.relres
+    np.testing.assert_array_equal(sk2.displacement_global(),
+                                  sa.displacement_global())
+
+
+@pytest.mark.parametrize("other", ["classic", "fused"])
+def test_cross_variant_resume_rejected_by_fingerprint(model, tmp_path,
+                                                      other):
+    """A checkpoint written under pipelined must be rejected on resume
+    under classic OR fused with a clear named mismatch — the pipelined
+    carry rides five extra pytree leaves (u/w/s/z/init) beyond even
+    fused's, so without the guard the failure would be an obscure
+    shard_map structure error."""
+    cfg_p = _cfg("pipelined", tmp_path, run_id=f"xv{other}",
+                 iters_per_dispatch=12)
+    cfg_p.checkpoint_every = 1
+    s = Solver(model, cfg_p, mesh=make_mesh(1), n_parts=1)
+    s.solve()
+
+    cfg_o = _cfg(other, tmp_path, run_id=f"xv{other}",
+                 iters_per_dispatch=12)
+    cfg_o.checkpoint_every = 1
+    s2 = Solver(model, cfg_o, mesh=make_mesh(1), n_parts=1)
+    with pytest.raises(ValueError, match="pcg_variant"):
+        s2.solve(resume=True)
+
+
+# ----------------------------------------------------------------------
+# Recovery-ladder compatibility (chaos: scalar path; the blocked matrix
+# runs in test_pcg_many.test_chunked_column_fault_chaos_matrix)
+# ----------------------------------------------------------------------
+
+class _Capture:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, ev):
+        self.events.append(ev)
+
+    def close(self):
+        pass
+
+
+@pytest.mark.parametrize("fault,trigger", [
+    ("rho0@1", "flag4"),       # zeroed rho => breakdown
+    ("nan@1", "nan_carry"),    # NaN trips no in-graph flag; host detects
+    # Inf residual: gamma = <r,u> sums signed infinities to NaN, which
+    # (like every NaN) trips no in-graph flag — the host budget loop's
+    # nan_carry detection hands it to the ladder
+    ("inf@1", "nan_carry"),
+])
+def test_pipelined_fault_recovery(model, fault, trigger):
+    """Breakdowns and NaN/Inf poisoning climb the same recovery ladder
+    under the GV recurrence and still converge — the ladder's restart
+    re-arms the priming bit, so the restarted solve rebuilds u/w from
+    the restart residual."""
+    cap = _Capture()
+    s = Solver(model, _cfg("pipelined", iters_per_dispatch=12),
+               mesh=make_mesh(1), n_parts=1,
+               recorder=MetricsRecorder(sinks=[cap]))
+    s.fault_plan = FaultPlan(fault, recorder=s.recorder)
+    res = s.step(1.0)
+    assert res.flag == 0 and res.relres <= 1e-8
+    recs = [(e["action"], e["trigger"]) for e in cap.events
+            if e["kind"] == "recovery"]
+    assert ("restart_minres", trigger) in recs
+
+
+def test_pipelined_mixed_escalates_to_f64(model):
+    """Ladder rung 3 under pipelined: repeated mixed-path corruption
+    escalates to direct-f64 cycles (themselves pipelined) and
+    converges."""
+    cap = _Capture()
+    cfg = _cfg("pipelined", precision_mode="mixed", dtype="float32",
+               dot_dtype="float64", tol=1e-9, max_iter=4000,
+               inner_tol=0.1, max_recoveries=3, iters_per_dispatch=12)
+    s = Solver(model, cfg, mesh=make_mesh(1), n_parts=1,
+               recorder=MetricsRecorder(sinks=[cap]))
+    s.fault_plan = FaultPlan("inf@0,inf@1", recorder=s.recorder)
+    res = s.step(1.0)
+    assert res.flag == 0 and res.relres <= 1e-9
+    recs = [(e["action"], e["trigger"]) for e in cap.events
+            if e["kind"] == "recovery"]
+    assert ("escalate_f64", "nan_carry") in recs
+
+
+# ----------------------------------------------------------------------
+# Residual-drift guard: the TIGHTER pipelined budget (flag 6)
+# ----------------------------------------------------------------------
+
+def test_pipelined_drift_guard_exits_flag6_at_the_lower_limit():
+    """The pipelined recurrence drifts faster than fused
+    (arXiv:2501.03743), so its flag-6 budget is LOWER
+    (PIPELINED_DRIFT_LIMIT < FUSED_DRIFT_LIMIT): re-poisoning the carry
+    residual before each capped dispatch makes every deferred check
+    disagree, and the solve exits with the recoverable DRIFT_FLAG after
+    exactly PIPELINED_DRIFT_LIMIT drifted checks."""
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.resilience import breakdown_trigger
+    from pcg_mpi_solver_tpu.solver.pcg import (
+        DRIFT_FLAG, FUSED_DRIFT_LIMIT, PIPELINED_DRIFT_LIMIT,
+        drift_limit_for, pcg)
+
+    assert PIPELINED_DRIFT_LIMIT < FUSED_DRIFT_LIMIT
+    assert drift_limit_for("pipelined") == PIPELINED_DRIFT_LIMIT
+    assert drift_limit_for("fused") == FUSED_DRIFT_LIMIT
+
+    m, _pm, ops, data, fext, inv = _direct_pcg_setup()
+    kw = dict(tol=1e-8, max_iter=1, max_iter_nominal=200,
+              glob_n_dof_eff=int(np.asarray(m.dof_eff).sum()),
+              variant="pipelined", return_carry=True)
+    res, carry = pcg(ops, data, fext, jnp.zeros_like(fext), inv,
+                     **dict(kw, max_iter=5))
+    assert int(carry["drift"]) == 0, "healthy pipelined solve: no drift"
+    assert int(carry["init"]) == 0, "the cold start primed u/w"
+    step = jax.jit(lambda c: pcg(ops, data, fext, jnp.zeros_like(fext),
+                                 inv, carry_in=c, **kw))
+    for k in range(PIPELINED_DRIFT_LIMIT):
+        # the recurrence claims convergence; the true residual disagrees
+        carry = dict(carry)
+        carry["r"] = carry["r"] * 1e-14
+        res, carry = step(carry)
+        assert int(carry["drift"]) == k + 1
+    assert int(res.flag) == DRIFT_FLAG
+    assert breakdown_trigger(int(res.flag), float(res.relres)) == "flag6"
+
+
+def test_pipelined_drift_guard_per_column():
+    """Blocked twin at the lower limit: only the lying column exits
+    flag 6; the healthy column's drift count stays zero."""
+    import jax
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.solver.pcg import (
+        DRIFT_FLAG, PIPELINED_DRIFT_LIMIT, pcg_many)
+
+    m, _pm, ops, data, fext1, inv = _direct_pcg_setup()
+    fb = jnp.stack([fext1, 0.5 * fext1], axis=-1)
+    kw = dict(tol=1e-8, max_iter=1, max_iter_nominal=200,
+              glob_n_dof_eff=int(np.asarray(m.dof_eff).sum()),
+              variant="pipelined", return_carry=True)
+    res, carry = pcg_many(ops, data, fb, jnp.zeros_like(fb), inv,
+                          **dict(kw, max_iter=5))
+    lie = jnp.asarray([1e-14, 1.0])
+    step = jax.jit(lambda c: pcg_many(ops, data, fb,
+                                      jnp.zeros_like(fb), inv,
+                                      carry_in=c, **kw))
+    for _ in range(PIPELINED_DRIFT_LIMIT):
+        carry = dict(carry)
+        carry["r"] = carry["r"] * lie[None, None, :]
+        res, carry = step(carry)
+    assert int(res.flag[0]) == DRIFT_FLAG
+    assert int(carry["drift"][0]) >= PIPELINED_DRIFT_LIMIT
+    assert int(res.flag[1]) != DRIFT_FLAG
+    assert int(carry["drift"][1]) == 0
+
+
+def test_forced_checks_do_not_tick_the_progress_window():
+    """A cadence-forced replacement check resolves no new committed
+    iteration, so it must not advance the plateau/progress-window
+    clocks (count_windows): with a huge progress_window (no rollover,
+    no resets) the monotone win_count must equal the committed
+    iteration count EXACTLY after crossing PIPELINED_REPLACE_EVERY —
+    one tick per committed iteration, none for the forced check's
+    extra _resolve — matching what classic would have counted."""
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.solver.pcg import (
+        PIPELINED_REPLACE_EVERY, pcg)
+
+    m, _pm, ops, data, fext, inv = _direct_pcg_setup()
+    n_iter = PIPELINED_REPLACE_EVERY + 8    # crosses one forced cadence
+    kw = dict(tol=1e-30, max_iter=n_iter, max_iter_nominal=200,
+              glob_n_dof_eff=int(np.asarray(m.dof_eff).sum()),
+              progress_window=10_000, return_carry=True)
+    for variant in ("classic", "pipelined"):
+        # tol is unreachable, so the loop runs exactly max_iter
+        # committed iterations (cond: i < max_iter; checks/priming
+        # trips do not advance i)
+        _res, carry = pcg(ops, data, fext, jnp.zeros_like(fext), inv,
+                          variant=variant, **kw)
+        assert int(carry["win_count"]) == n_iter, \
+            (variant, int(carry["win_count"]), n_iter)
+
+
+# ----------------------------------------------------------------------
+# Newmark per-step solves (the shifted-operator dispatch surface)
+# ----------------------------------------------------------------------
+
+def test_pipelined_newmark_steps_match_classic():
+    from pcg_mpi_solver_tpu.solver.newmark import NewmarkSolver
+
+    model = make_cube_model(4, 3, 3, h=0.5, nu=0.3, load="traction",
+                            heterogeneous=True)
+    us = {}
+    for variant in ("classic", "pipelined"):
+        s = NewmarkSolver(model, _cfg(variant), mesh=make_mesh(1),
+                          n_parts=1, dt=1e-5)
+        res = s.run([1.0, 1.0, 1.0])
+        assert all(r.flag == 0 for r in res), variant
+        us[variant] = s.displacement_global()
+    np.testing.assert_allclose(us["pipelined"], us["classic"], rtol=1e-5,
+                               atol=1e-10 * np.abs(us["classic"]).max())
+
+
+# ----------------------------------------------------------------------
+# MG composition (ISSUE 11 acceptance: pipelined under precond="mg")
+# ----------------------------------------------------------------------
+
+def test_pipelined_composes_with_mg():
+    """precond='mg' under the pipelined loop: identical-tol convergence
+    with iteration count within 5% of classic+mg (the multiplicative
+    fewer-iterations x cheaper-iterations composition), and the
+    V-cycle's collectives all land on the carry side of the overlap —
+    the fused psum stays independent."""
+    m = make_cube_model(6, 4, 4, h=0.5, nu=0.3, heterogeneous=True,
+                        seed=0)
+    rs = {}
+    for variant in ("classic", "pipelined"):
+        s = Solver(m, _cfg(variant, precond="mg"),
+                   mesh=make_mesh(2), n_parts=2, backend="general")
+        rs[variant] = (s.step(1.0),
+                       np.asarray(s.displacement_global()))
+    rc, uc = rs["classic"]
+    rp, up = rs["pipelined"]
+    assert rc.flag == 0 and rp.flag == 0
+    assert rp.relres <= 1e-8 * 1.001
+    _iters_close(rp.iters, rc.iters)
+    np.testing.assert_allclose(up, uc, rtol=1e-6,
+                               atol=1e-10 * np.abs(uc).max())
+
+
+# ----------------------------------------------------------------------
+# Single-source variant table + plumbing surfaces (ISSUE 11 satellite)
+# ----------------------------------------------------------------------
+
+def test_variant_name_set_is_single_sourced():
+    """config.PCG_VARIANTS is THE name set: the solver's valid list,
+    the ops collective table and the CLI choices all derive from it."""
+    from pcg_mpi_solver_tpu.obs.schema import BENCH_PCG_VARIANT_VALUES
+    from pcg_mpi_solver_tpu.ops.matvec import PCG_SCALAR_PSUMS
+    from pcg_mpi_solver_tpu.solver.pcg import VALID_PCG_VARIANTS
+
+    assert VALID_PCG_VARIANTS == PCG_VARIANTS
+    assert tuple(PCG_SCALAR_PSUMS) == PCG_VARIANTS
+    assert BENCH_PCG_VARIANT_VALUES == PCG_VARIANTS
+    assert "pipelined" in PCG_VARIANTS
+
+
+def test_unknown_variant_fails_loudly_everywhere(model):
+    """The same unknown name is rejected by every surface: config
+    construction, the cache key, and the loop builders."""
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.cache.keys import step_cache_key
+    from pcg_mpi_solver_tpu.solver.pcg import pcg, pcg_many
+
+    with pytest.raises(ValueError, match="pcg_variant"):
+        SolverConfig(pcg_variant="frobnicate")
+    with pytest.raises(KeyError, match="pcg_variant"):
+        step_cache_key(abstract="a", mesh="m", backend="b", solver={},
+                       trace_len=0, glob_n_dof_eff=1, donate=True,
+                       jax_version="j", pcg_variant="frobnicate")
+    for fn in (pcg, pcg_many):
+        with pytest.raises(ValueError, match="variant"):
+            fn(None, None, jnp.zeros((1, 3)), jnp.zeros((1, 3)),
+               jnp.ones((1, 3)), tol=1e-8, max_iter=5,
+               glob_n_dof_eff=3, variant="frobnicate")
+
+
+def test_cache_key_separates_pipelined():
+    from pcg_mpi_solver_tpu.cache.keys import step_cache_key
+
+    kw = dict(abstract="sig", mesh=("m", "cpu"), backend="general",
+              solver={"tol": 1e-8}, trace_len=0, glob_n_dof_eff=100,
+              donate=True, jax_version="x")
+    keys = {v: step_cache_key(pcg_variant=v, **kw) for v in PCG_VARIANTS}
+    assert len(set(keys.values())) == len(PCG_VARIANTS)
+
+
+def test_cli_flag_accepts_pipelined():
+    import argparse
+
+    from pcg_mpi_solver_tpu.cli import _add_variant_flag, _load_settings
+    from types import SimpleNamespace
+
+    p = argparse.ArgumentParser()
+    _add_variant_flag(p)
+    args = p.parse_args(["--pcg-variant", "pipelined"])
+    assert args.pcg_variant == "pipelined"
+    with pytest.raises(SystemExit):
+        p.parse_args(["--pcg-variant", "frobnicate"])
+
+    ns = SimpleNamespace(settings=None, tol=None, max_iter=None,
+                         precision=None, precond=None,
+                         pcg_variant="pipelined")
+    assert _load_settings(None, ns).solver.pcg_variant == "pipelined"
+
+
+def test_comm_gauges_advertise_pipelined(model):
+    cap = _Capture()
+    s = Solver(model, _cfg("pipelined"), mesh=make_mesh(1), n_parts=1,
+               recorder=MetricsRecorder(sinks=[cap]))
+    s.step(1.0)
+    s.recorder.emit_run_summary()
+    summaries = [e for e in cap.events if e["kind"] == "run_summary"]
+    assert summaries
+    g = summaries[-1]["gauges"]
+    assert g["pcg_variant"] == "pipelined"
+    assert g["comm.pcg_variant"] == "pipelined"
+    # same psum COUNT as fused — the pipelined win is overlap, not count
+    assert g["comm.psums_per_iter"] == \
+        s.ops.comm_estimate(variant="fused")["psums_per_iter"]
+
+
+def test_bench_line_validates_pipelined_variant():
+    from pcg_mpi_solver_tpu.obs.schema import validate_bench_line
+
+    line = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+            "detail": {"pcg_variant": "pipelined", "time_to_tol_s": 0.5,
+                       "iters": 10}}
+    assert validate_bench_line(line) == []
+    line["detail"]["pcg_variant"] = "frobnicate"
+    errs = validate_bench_line(line)
+    assert errs and "pcg_variant" in errs[0]
+
+
+def test_pipelined_carry_exports_gv_leaves():
+    """The resumable carry of a pipelined call exports the recurrence
+    vectors and the priming bit (the contract every dispatch surface,
+    snapshot and restart program relies on)."""
+    import jax.numpy as jnp
+
+    from pcg_mpi_solver_tpu.solver.pcg import (
+        carry_part_specs, cold_carry, cold_carry_many, pcg)
+
+    m, _pm, ops, data, fext, inv = _direct_pcg_setup()
+    _res, carry = pcg(ops, data, fext, jnp.zeros_like(fext), inv,
+                      tol=1e-8, max_iter=3,
+                      glob_n_dof_eff=int(np.asarray(m.dof_eff).sum()),
+                      variant="pipelined", return_carry=True)
+    for k in ("u", "w", "s", "q", "z", "alpha", "fresh", "drift",
+              "init"):
+        assert k in carry, k
+    # cold_carry / specs agree with the exported schema
+    cold = cold_carry(jnp.zeros_like(fext), fext,
+                      jnp.asarray(1.0), jnp.float64, variant="pipelined")
+    assert set(cold) == set(carry)
+    specs = carry_part_specs("P", "R", variant="pipelined")
+    assert set(specs) == set(carry)
+    cold_m = cold_carry_many(jnp.zeros((1, 3, 2)), jnp.zeros((1, 3, 2)),
+                             jnp.ones((2,)), jnp.float64,
+                             variant="pipelined")
+    for k in ("u", "w", "s", "z", "init", "flag", "prec_sel"):
+        assert k in cold_m, k

@@ -27,21 +27,34 @@ Presets:
   1. flagship classic     — the 10.33M-dof ms/iter anchor (mixed)
   2. flagship fused       — PR-5's single-reduction loop, FIRST hardware
                             measurement (BENCH_PCG_VARIANT=fused)
-  3. MG A/B               — classic+jacobi vs classic+mg at a
+  3. flagship pipelined   — ISSUE-11's stencil-overlapped psum
+                            (BENCH_PCG_VARIANT=pipelined), directly
+                            after the fused leg so the 3-way
+                            classic/fused/pipelined ms/iter A/B reads
+                            off three adjacent lines (the overlap claim
+                            is lint-proven by step 0.2; this leg only
+                            has to confirm the ms/iter number)
+  4. MG A/B               — classic+jacobi vs classic+mg at a
                             multi-level-coarsenable size (BENCH_NX=144;
                             BENCH_PRECOND=mg): iters + ms/iter +
                             detail.time_to_tol_s — the ISSUE-10
                             iteration-count lever, first hardware
                             measurement
-  4. nrhs sweep 4, 16     — batched multi-RHS throughput A/B
+  5. nrhs sweep 4, 16     — batched multi-RHS throughput A/B
                             (BENCH_NRHS; detail.dof_iter_rhs_per_s)
-  5. Pallas v9 A/B        — first-ever hardware execution of the kernel
+  6. Pallas v9 A/B        — first-ever hardware execution of the kernel
                             family (the hw_v9_ab.py step)
-  Step 0.5 (between lint and the flagship) is the blocked-resilience
-  smoke: a tiny solve_many with an injected per-column fault, proving
-  the ISSUE-9 per-column recovery ladder + fault isolation live on the
-  accelerator for seconds of window time.
-  Steps 2-4 reuse step 1's warm caches (shared BENCH_CACHE_DIR), so a
+  Step 0.2 (after the fast lint, still on CPU) is the overlap lint:
+  the full-tier ``psum-overlap`` rule alone (~15 s — the fast tier
+  stays ~1 s and deliberately excludes it), proving the pipelined
+  psum really is data-independent of the stencil before the hardware
+  leg that measures the claim; a FAIL SKIPS the pipelined leg only
+  (classic/fused measurements do not depend on the overlap claim).
+  Step 0.5 (between the lints and the flagship) is the
+  blocked-resilience smoke: a tiny solve_many with an injected
+  per-column fault, proving the ISSUE-9 per-column recovery ladder +
+  fault isolation live on the accelerator for seconds of window time.
+  Steps 2-5 reuse step 1's warm caches (shared BENCH_CACHE_DIR), so a
   window that dies mid-queue still leaves each completed step's salvage
   line.
 """
@@ -198,10 +211,11 @@ def start_queue(name, deadline_min, log):
 def run_priority_queue(path, quick: bool):
     """The prioritized measurement queue (module docstring ``priority``
     preset): contract lint FIRST (step 0, on CPU — a broken structural
-    claim means the measurements would benchmark a lie), then
-    classic-vs-fused ms/iter at the flagship, then the batched-RHS
-    sweep, then the Pallas v9 A/B — ordered so the minutes a dying
-    window DOES deliver answer the most valuable open questions.
+    claim means the measurements would benchmark a lie), then the
+    3-way classic/fused/pipelined ms/iter A/B at the flagship, then the
+    batched-RHS sweep, then the Pallas v9 A/B — ordered so the minutes
+    a dying window DOES deliver answer the most valuable open
+    questions.
     A shared warm-path cache dir makes the bench steps near-zero-setup."""
     # Step 0: `pcg-tpu lint --fast` (analysis/) — statically prove the
     # collective budgets / hot-loop purity the queue is about to measure.
@@ -222,6 +236,21 @@ def run_priority_queue(path, quick: bool):
                        "priority queue before any hardware step (fix the "
                        "invariant or baseline it, then relaunch)")
         return
+    # Step 0.2: the psum-overlap rule ALONE, full tier, still on CPU
+    # (~15 s; registered fast=False and the pipelined programs are not
+    # in the --fast matrix, so step 0 deliberately never checks the
+    # overlap claim — this step does, right before the hardware leg
+    # that measures it).  A FAIL skips ONLY the pipelined leg: the
+    # classic/fused measurements do not depend on the overlap claim,
+    # so the window still answers them.
+    ov_status = run_step(path, "overlap lint (step 0.2)",
+                         ["-m", "pcg_mpi_solver_tpu.analysis",
+                          "--rules", "psum-overlap"],
+                         env_extra={"JAX_PLATFORMS": "cpu"}, timeout=900,
+                         gate_s=0)
+    overlap_ok = ov_status == "rc=0"
+    log_line(path, "overlap lint verdict: "
+                   + ("PASS" if overlap_ok else f"FAIL ({ov_status})"))
     # Step 0.5: blocked-resilience smoke (ISSUE 9) — a tiny solve_many
     # with an injected per-column fault, ON THE ACCELERATOR: proves the
     # per-column recovery ladder + fault isolation live (tier-1 only
@@ -239,6 +268,22 @@ def run_priority_queue(path, quick: bool):
     run_step(path, "flagship fused", ["bench.py"],
              env_extra=dict(cache, BENCH_PCG_VARIANT="fused", **size),
              timeout=3600)
+    # Pipelined leg (ISSUE 11): same size, same warm cache dir, directly
+    # after fused — the psum-overlap lint (step 0.2) already proved the
+    # reduction is concurrent with the stencil in the lowered program,
+    # so this step only has to confirm ms/iter; three adjacent lines =
+    # the 3-way variant A/B (detail.pcg_variant labels them).
+    if overlap_ok:
+        run_step(path, "flagship pipelined", ["bench.py"],
+                 env_extra=dict(cache, BENCH_PCG_VARIANT="pipelined",
+                                **size),
+                 timeout=3600)
+    else:
+        log_line(path, "SKIPPING the flagship pipelined leg: the "
+                       "psum-overlap lint (step 0.2) FAILED — measuring "
+                       "the variant would benchmark a disproven "
+                       "latency-hiding claim; the rest of the queue "
+                       "does not depend on it")
     # MG A/B (ISSUE 10): classic+jacobi anchor vs classic+mg at an
     # even, multi-level-coarsenable size (150 halves once to 75 and
     # stops; 144 = 16*9 gives the 72/36/18/9 coarse chain), sharing the
@@ -270,9 +315,10 @@ def main():
     ap.add_argument("--preset", choices=["full", "priority"],
                     default="full",
                     help="full = historical RUNBOOK checklist; priority "
-                         "= classic-vs-fused ms/iter, then the BENCH_NRHS "
-                         "sweep, then Pallas v9 (highest-value open "
-                         "questions first — see module docstring)")
+                         "= the classic/fused/pipelined ms/iter A/B, "
+                         "then the BENCH_NRHS sweep, then Pallas v9 "
+                         "(highest-value open questions first — see "
+                         "module docstring)")
     args = ap.parse_args()
     path = start_queue(f"hw_session (quick={args.quick}, "
                        f"preset={args.preset})",
