@@ -70,7 +70,7 @@ TRACE_NEUTRAL_RUNCONFIG = frozenset({
     "scratch_path", "model_name", "run_id", "n_parts",
     "partition_method", "speed_test", "checkpoint_every",
     "snapshot_every", "preflight", "cache_dir", "telemetry_path",
-    "telemetry_profile", "profile_dir", "comm_probe_iters",
+    "flight_path", "telemetry_profile", "profile_dir", "comm_probe_iters",
     "solver", "time_history",
 })
 
@@ -236,6 +236,104 @@ def check_runconfig_classified() -> List[Finding]:
                         "TRACE_NEUTRAL_RUNCONFIG (with thought) or wire "
                         "it through the sweep like a solver knob"))
     return out
+
+
+# ----------------------------------------------------------------------
+# cost-model-completeness (ISSUE 12): the analytic per-iteration cost
+# model (obs/perf.py) must cover EVERY canonical combination — and stay
+# loud about ones it does not know.
+# ----------------------------------------------------------------------
+
+#: the synthetic geometry the completeness sweep models: multi-part
+#: (so collective terms engage) with a plausible iface payload.
+_COST_MODEL_PROBE_SHAPE = dict(n_dof=30_000, n_parts=8, n_iface=2_000,
+                               elem_groups=((24, 9_000),),
+                               mg_coarse_dofs=4_000)
+
+
+def check_cost_model_completeness(variants=None, preconds=None,
+                                  model_fn=None, nrhs_set=(1, 8),
+                                  ) -> List[Finding]:
+    """Every ``config.PCG_VARIANTS`` x ``config.PRECONDS`` x nrhs
+    combination must produce a finite positive prediction with all four
+    attribution phases, and an UNKNOWN variant/precond must raise
+    ``KeyError`` (the single-source-table loudness contract) — a combo
+    the model silently defaults for would stamp fabricated
+    ``predicted_ms_per_iter`` numbers on bench lines.  ``variants`` /
+    ``preconds`` / ``model_fn`` are seeded-violation test hooks."""
+    from pcg_mpi_solver_tpu import config as _cfg
+    from pcg_mpi_solver_tpu.obs import perf as _perf
+
+    shape = _perf.ProblemShape(**_COST_MODEL_PROBE_SHAPE)
+    variants = tuple(variants if variants is not None
+                     else _cfg.PCG_VARIANTS)
+    preconds = tuple(preconds if preconds is not None else _cfg.PRECONDS)
+    if model_fn is None:
+        def model_fn(v, p, r):
+            return _perf.cost_model(shape, v, p, r)
+    out: List[Finding] = []
+    for v in variants:
+        for p in preconds:
+            for r in nrhs_set:
+                loc = f"combo:{v}/{p}/nrhs{r}"
+                try:
+                    cm = model_fn(v, p, r)
+                except Exception as e:                  # noqa: BLE001
+                    out.append(Finding(
+                        rule="cost-model-completeness", loc=loc,
+                        message=f"cost model has no entry for "
+                                f"(pcg_variant={v!r}, precond={p!r}, "
+                                f"nrhs={r}): {type(e).__name__}: {e} — "
+                                "every canonical combination must "
+                                "predict, or bench/telemetry lines for "
+                                "it carry no model verdict"))
+                    continue
+                phases = (cm or {}).get("phases", {})
+                missing = [ph for ph in _perf.PHASES if ph not in phases]
+                pred = (cm or {}).get("predicted_ms_per_iter", 0)
+                if missing or not (isinstance(pred, (int, float))
+                                   and pred > 0):
+                    out.append(Finding(
+                        rule="cost-model-completeness", loc=loc,
+                        message=f"cost model entry for ({v}, {p}, "
+                                f"nrhs={r}) is degenerate: "
+                                f"missing phases {missing}, "
+                                f"predicted_ms_per_iter={pred!r} — a "
+                                "zero/partial prediction reads as 'free' "
+                                "on the measured-vs-model table"))
+    # loudness probes: an unknown name must KeyError, never default
+    for probe_kw, loc in ((("__no_such_variant__", preconds[0]),
+                           "probe:unknown-variant"),
+                          ((variants[0], "__no_such_precond__"),
+                           "probe:unknown-precond")):
+        try:
+            model_fn(probe_kw[0], probe_kw[1], 1)
+        except KeyError:
+            continue
+        except Exception as e:                          # noqa: BLE001
+            out.append(Finding(
+                rule="cost-model-completeness", loc=loc,
+                message=f"unknown name raised {type(e).__name__} "
+                        "instead of KeyError — consumers catch KeyError "
+                        "as the 'table out of sync' signal and must not "
+                        "confuse it with an internal failure"))
+            continue
+        out.append(Finding(
+            rule="cost-model-completeness", loc=loc,
+            message="cost model silently accepted an unknown "
+                    f"{'variant' if 'variant' in loc else 'precond'} "
+                    "name — an out-of-sync name table would stamp "
+                    "fabricated predictions instead of failing loudly"))
+    return out
+
+
+@rule("cost-model-completeness", kind="config", fast=True,
+      doc="the analytic per-iteration cost model (obs/perf.py) covers "
+          "every config.PCG_VARIANTS x config.PRECONDS x nrhs "
+          "combination with a positive all-phase prediction, and "
+          "unknown names raise KeyError (never a silent default row)")
+def cost_model_completeness_rule(ctx) -> List[Finding]:
+    return check_cost_model_completeness()
 
 
 @rule("fingerprint-completeness", kind="config", fast=False,

@@ -93,7 +93,14 @@ formulation — the 3-way ms/iteration A/B knob: classic's 3 serialized
 reductions vs fused's single psum vs pipelined's stencil-overlapped
 psum; the engaged variant is reported in detail.pcg_variant on EVERY
 line, insurance/salvage included, and schema-validated against the
-canonical name set — obs/schema.BENCH_PCG_VARIANT_VALUES); plus the solver-level performance knobs
+canonical name set — obs/schema.BENCH_PCG_VARIANT_VALUES),
+BENCH_FLIGHT (crash-durable flight-recorder JSONL, default
+bench_flight.jsonl, 0 = off: fsync-per-event begin/end brackets around
+every rung and every solve dispatch, so a tunnel death mid-timed-solve
+leaves a parseable artifact — a previous run's artifact is ingested
+mechanically at startup, verdict logged, file rotated to .prev; every
+line also carries detail.predicted_ms_per_iter / detail.model_ratio,
+the obs/perf.py analytic cost model's verdict); plus the solver-level performance knobs
 PCG_TPU_MATVEC_FORM / PCG_TPU_PALLAS_V / PCG_TPU_PALLAS_PLANES /
 PCG_TPU_HYBRID_BLOCK (docs/RUNBOOK.md knob table) — the engaged form is
 reported in detail.matvec_form.
@@ -438,6 +445,34 @@ class _FirstDispatchSink:
         pass
 
 
+def _predict_ms_per_iter(detail):
+    """Roofline-predicted ms/iter (obs/perf.py) for a bench line, derived
+    from the line's OWN detail fields so it works on every leg — final,
+    warm insurance, failed-salvage — without a live solver in hand.
+    Returns None (-> null) when the model cannot be built; an UNKNOWN
+    variant/precond name still raises (the single-source-table loudness
+    contract — a mislabeled line must not get a fabricated prediction)."""
+    from pcg_mpi_solver_tpu.obs import perf as _perf
+
+    try:
+        shape = _perf.shape_from_detail(detail)
+        if shape is None:
+            return None
+        cm = _perf.cost_model(
+            shape,
+            str(detail.get("pcg_variant", "classic")),
+            str(detail.get("precond", "jacobi")),
+            int(detail.get("nrhs", 1) or 1),
+            _perf.resolve_profile(str(detail.get("platform", "cpu"))))
+        return cm["predicted_ms_per_iter"] or None
+    except KeyError:
+        raise
+    except Exception as e:                              # noqa: BLE001
+        _log(f"# cost model unavailable for this line "
+             f"({type(e).__name__}: {e}); predicted_ms_per_iter=null")
+        return None
+
+
 def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
     dof_iters_per_sec = model.n_dof * iters / r1.wall_s
     # idealized 8-rank reference: perfect 8x scaling of the measured hot loop
@@ -469,6 +504,19 @@ def _result_json(model, kind, r1, iters, ref_ns, ref_note, extra):
     nrhs = int(detail.get("nrhs", 1) or 1)
     detail["nrhs"] = nrhs
     detail["dof_iter_rhs_per_s"] = round(dof_iters_per_sec * nrhs, 1)
+    # Analytic cost-model verdict (ISSUE 12, obs/perf.py): the roofline-
+    # predicted ms/iter for THIS line's engaged (variant, precond, nrhs,
+    # platform) and measured/predicted — stamped on EVERY leg through
+    # this one shared function (final, insurance, failed-salvage), so an
+    # interrupted window still records how far off the model was.  Built
+    # from the line's own detail fields (a salvage line must be
+    # self-describing without a live solver); null when the model cannot
+    # be derived — never a fabricated number.
+    predicted = _predict_ms_per_iter(detail)
+    detail["predicted_ms_per_iter"] = predicted
+    detail["model_ratio"] = (
+        round(detail["tpu_ms_per_iter"] / predicted, 3)
+        if predicted else None)
     detail["phases"] = {k: round(v["total_s"], 3)
                        for k, v in _REC.span_stats().items()}
     return json.dumps({
@@ -1003,6 +1051,41 @@ def _read_salvage():
     return json.dumps(d)
 
 
+def _attach_flight():
+    """Crash-durable flight recorder around the bench run (obs/flight.py,
+    ISSUE 12).  Every Solver dispatch is bracketed by fsync'd begin/end
+    records (the Solver shares ``_REC``) and each ladder rung gets its
+    own bracket, so a tunnel death / SIGKILL mid-timed-dispatch — the
+    round-5 failure a human reconstructed from HW_SESSION.log by hand —
+    leaves a parseable artifact naming the in-flight program.
+
+    A LEFTOVER artifact from a previous invocation is ingested
+    MECHANICALLY first: its verdict (clean / failed / died + what was in
+    flight) is logged, then the file rotates to ``.prev`` so this run's
+    verdict cannot inherit the dead run's unclosed brackets.  Disable
+    with BENCH_FLIGHT=0 (the provisional/upgrade subprocesses do — they
+    share the parent's cwd and must not interleave with its stream)."""
+    path = os.environ.get("BENCH_FLIGHT", "bench_flight.jsonl")
+    if not path or path == "0":
+        return None
+    from pcg_mpi_solver_tpu.obs.flight import (
+        FlightRecorder, ingest_and_rotate)
+
+    path = ingest_and_rotate(path, _log,
+                             label="# previous bench flight record")
+    try:
+        _REC.flight = FlightRecorder(path, meta={
+            "component": "bench",
+            "model": os.environ.get("BENCH_MODEL", "cube"),
+            "pcg_variant": os.environ.get("BENCH_PCG_VARIANT", "classic"),
+            "precond": os.environ.get("BENCH_PRECOND", "jacobi"),
+            "nrhs": os.environ.get("BENCH_NRHS", "1")})
+    except (OSError, ValueError) as e:
+        _log(f"# flight recorder unavailable ({e}); continuing without")
+        _REC.flight = None
+    return _REC.flight
+
+
 def _error_line(why):
     """Last-ditch zero-value line: clearly labeled, parseable, and
     impossible to mistake for a measurement."""
@@ -1030,6 +1113,12 @@ class _ProvisionalRun:
         env = _cpu_only_env()
         env["BENCH_FORCE_CPU"] = "1"
         env["BENCH_MODEL"] = "cube"
+        # the fallback subprocess shares the parent's cwd: its flight
+        # records must not interleave with (or rotate) the parent's —
+        # neither through the bench recorder nor through a Solver
+        # picking up the operator's PCG_TPU_FLIGHT default
+        env["BENCH_FLIGHT"] = "0"
+        env["PCG_TPU_FLIGHT"] = ""
         if provisional:
             env["BENCH_PROVISIONAL"] = "1"
         else:
@@ -1151,6 +1240,7 @@ def main():
     wall = float(os.environ.get("BENCH_WALL_BUDGET_S", 1680))
     deadline = t0 + wall
     emitter = _Emitter(_error_line("bench still starting up"))
+    _attach_flight()
     prov = _ProvisionalRun()
 
     def watchdog():
@@ -1220,6 +1310,9 @@ def main():
         emitter.emit(line)
     finally:
         prov.kill()
+        fl = getattr(_REC, "flight", None)
+        if fl is not None:
+            fl.close()
 
 
 def _run_bench(cpu_fallback, provisional=False, deadline=None, emitter=None):
@@ -1262,12 +1355,27 @@ def _run_bench(cpu_fallback, provisional=False, deadline=None, emitter=None):
         last = rung_i == len(ladder) - 1
         rung = ladder[rung_i]
         failed = None
+        # flight bracket per rung (on top of the Solver's per-dispatch
+        # brackets): a killed run's artifact names which ladder size was
+        # in flight, not just which program
+        fl = getattr(_REC, "flight", None)
+        fl_seq = (fl.begin(f"rung:{rung_i}", nx=nx, ot_n=ot_n)
+                  if fl is not None else None)
         try:
             model, solver, r1, iters, t_part, pallas_on, setup_info = \
                 _solve_once(
                     kind, nx, ny, nz, ot_n, ot_level, backend, n_parts,
                     tol, mode, dtype, emitter=emitter)
+            if fl is not None:
+                fl.end(fl_seq, f"rung:{rung_i}", ok=True)
         except Exception as e:                      # noqa: BLE001
+            if fl is not None:
+                # descending to a smaller rung is the ladder working BY
+                # DESIGN — only the last rung's failure fails the run,
+                # so only that one may make the artifact read "failed"
+                fl.end(fl_seq, f"rung:{rung_i}", ok=False,
+                       error=f"{type(e).__name__}: {e}",
+                       expected=not last)
             if last:
                 raise
             failed = f"{type(e).__name__}: {e}"

@@ -15,6 +15,9 @@ orchestrated by examples/run_basic_script.bash) as one typed CLI.
     pcg-tpu warmup    <scratch> [options]              # pre-bake caches
     pcg-tpu cache-stats [--cache-dir D]                # warm-path cache table
     pcg-tpu lint      [--fast] [--json F]              # contract lint (analysis/)
+    pcg-tpu perf-report [--nx N | scratch]             # measured-vs-model phases
+    pcg-tpu summary   <run.jsonl> [...]                # offline telemetry summary
+    pcg-tpu telemetry-merge <run.jsonl> --out M.jsonl  # merge per-process shards
 
 Settings come from ``--settings settings.json`` (same shape as the
 reference's GlobSettings: TimeHistoryParam/SolverParam,
@@ -75,6 +78,7 @@ def _apply_telemetry_flags(cfg, args) -> None:
     --profile-spans (jax.profiler annotations), --cache-dir, and the
     validate/ --preflight policy override."""
     cfg.telemetry_path = getattr(args, "telemetry_out", None) or ""
+    cfg.flight_path = getattr(args, "flight_out", None) or ""
     cfg.solver.trace_resid = int(getattr(args, "trace_resid", None) or 0)
     if getattr(args, "profile_spans", False):
         cfg.telemetry_profile = True
@@ -135,6 +139,15 @@ def _finish_telemetry(solver, args) -> None:
     solver.recorder.close()
 
 
+def _precond_choices():
+    # derived from the canonical table (config.PRECONDS) like the
+    # variant flag below: a precond added to the table must be
+    # selectable from every CLI surface without six hand-edits
+    from pcg_mpi_solver_tpu.config import PRECONDS
+
+    return list(PRECONDS)
+
+
 def _add_variant_flag(p) -> None:
     from pcg_mpi_solver_tpu.config import PCG_VARIANTS
 
@@ -193,6 +206,13 @@ def _add_telemetry_flags(p) -> None:
                    help="record the last N per-iteration (normr, rho, "
                         "stag, flag) samples on device and surface them "
                         "once per solve (0 = off; clamped to max_iter)")
+    p.add_argument("--flight-out", default=None, metavar="FILE.jsonl",
+                   help="crash-durable flight recorder (obs/flight.py): "
+                        "fsync-per-event begin/end brackets + heartbeats "
+                        "around every solve dispatch, so a tunnel death "
+                        "or SIGKILL mid-solve leaves a parseable artifact "
+                        "(read it back with `pcg-tpu summary`; env "
+                        "default: PCG_TPU_FLIGHT)")
     p.add_argument("--summary", action="store_true",
                    help="print the per-step / per-dispatch telemetry "
                         "table after the run")
@@ -607,6 +627,155 @@ def cmd_lint(args):
         raise SystemExit(rc)
 
 
+def cmd_summary(args):
+    """Offline summary of an on-disk telemetry/flight JSONL artifact —
+    tolerant by design: the exact artifact a dead tunnel produces has a
+    truncated trailing line, which is SKIPPED and counted
+    (``truncated_lines``), never raised on.  Flight records present in
+    the stream add the mechanical verdict (clean / failed / died) with
+    the in-flight record names and last heartbeat.  A base path that a
+    multi-process run sharded away (run.jsonl -> run.p<idx>.jsonl)
+    falls back to its per-process shards, each summarized in turn."""
+    from pcg_mpi_solver_tpu.obs.flight import find_shards
+    from pcg_mpi_solver_tpu.obs.metrics import summarize_jsonl
+
+    first = True
+    for path in args.files:
+        if os.path.exists(path):
+            targets = [path]
+        else:
+            targets = find_shards(path)
+            if not targets:
+                raise SystemExit(f"summary: {path}: no such file (and "
+                                 "no .p<N>.jsonl shard siblings)")
+            if not first:
+                print()
+            print(f">summary: {path}: sharded by a multi-process run — "
+                  f"{len(targets)} per-process shard(s)")
+            first = False
+        for t in targets:
+            if not first:
+                print()
+            first = False
+            if len(targets) > 1:
+                print(f"--- {t}")
+            print(summarize_jsonl(t))
+
+
+def cmd_telemetry_merge(args):
+    """Aggregate per-process telemetry/flight shards (multi-process
+    jax.distributed writes run.p<idx>.jsonl per process) into ONE
+    time-ordered JSONL stream, each event tagged with its source shard.
+    Truncated lines — the dead-tunnel signature — are skipped and
+    counted, never raised on."""
+    from pcg_mpi_solver_tpu.obs.flight import find_shards, merge_shards
+
+    paths = []
+    for p in args.paths:
+        shards = find_shards(p)
+        for s in (shards or ([p] if os.path.exists(p) else [])):
+            if s not in paths:
+                paths.append(s)
+    if not paths:
+        raise SystemExit("telemetry-merge: no shards found for "
+                         f"{args.paths} (expected FILE.jsonl and/or "
+                         "FILE.p<N>.jsonl siblings)")
+    stats = merge_shards(paths, args.out)
+    for name in sorted(stats["shards"]):
+        st = stats["shards"][name]
+        print(f">shard {name}: {st['events']} event(s), "
+              f"{st['truncated']} truncated line(s) skipped")
+    print(f">merged {stats['events']} event(s) from "
+          f"{len(stats['shards'])} shard(s) -> {args.out}"
+          + (f" ({stats['truncated_lines']} truncated line(s) skipped)"
+             if stats["truncated_lines"] else ""))
+
+
+def cmd_perf_report(args):
+    """Measured-vs-model phase attribution (ISSUE 12): time the matvec /
+    precond / reduction / axpy sub-programs of a live solver individually
+    (obs/phases.py — compiled from the solver's own ops/data) next to the
+    analytic cost model's roofline prediction (obs/perf.py), anchored by
+    a real whole-iteration measurement.  Runs chiplessly on CPU, so the
+    attribution table exists BEFORE a hardware window opens."""
+    from pcg_mpi_solver_tpu.obs import perf as _perf
+    from pcg_mpi_solver_tpu.obs.phases import run_phase_probe
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+    from pcg_mpi_solver_tpu.solver.driver import Solver
+
+    cfg = _load_settings(args.settings, args)
+    if cfg.solver.precision_mode != "direct":
+        raise SystemExit(
+            "perf-report: phase probes need a direct-mode solver (one "
+            "dtype, one loop) — drop --precision mixed")
+    nrhs = max(1, int(args.nrhs))
+    cfg.solver.nrhs = nrhs
+    if args.scratch:
+        from pcg_mpi_solver_tpu.models.mdf import read_mdf
+
+        cfg.scratch_path = args.scratch
+        model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    else:
+        from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+
+        model = make_cube_model(args.nx, 0, 0, E=30e9, nu=0.2,
+                                load="traction", load_value=1e6,
+                                heterogeneous=True)
+    n_parts, elem_part, n_dev, n_dev_used = _resolve_partition_mesh(
+        args.n_parts, args.scratch)
+    print(f">perf-report: {model.n_elem} elems / {model.n_dof} dofs on "
+          f"{n_dev_used}/{n_dev} device(s), {n_parts} parts "
+          f"({cfg.solver.pcg_variant} variant, {cfg.solver.precond} "
+          f"precond, nrhs={nrhs})..")
+    s = Solver(model, cfg, mesh=make_mesh(n_dev_used), n_parts=n_parts,
+               elem_part=elem_part, backend=args.backend)
+    print(f">backend: {s.backend}")
+    cm = s._cost_model
+    if cm is None:
+        # The Solver degrades _cost_model to None (with a recorder note)
+        # when the derivation raises on an exotic model; the measured
+        # table must still print, so degrade the same way here.  Like
+        # the Solver, only the cost_model() table lookup stays loud.
+        try:
+            shp = _perf.shape_from_solver(s)
+            prof = _perf.resolve_profile(s.mesh.devices.flat[0].platform)
+            cm = _perf.cost_model(shp, cfg.solver.pcg_variant,
+                                  cfg.solver.precond, nrhs, prof)
+        except Exception as e:                          # noqa: BLE001
+            print(f">cost model unavailable ({type(e).__name__}: {e}) "
+                  "— measured-only table")
+            cm = None
+    probe = run_phase_probe(s, reps=args.reps, nrhs=nrhs,
+                            inner=args.inner)
+    print()
+    print(f"{'phase':<10} {'model_ms':>10} {'measured_ms':>12} "
+          f"{'share':>7}")
+    sum_ms = probe["sum_ms_per_iter"] or 0.0
+    model_sum = 0.0
+    for ph in _perf.PHASES:
+        mm = cm["phases"][ph]["model_ms"] if cm is not None else None
+        model_sum += mm or 0.0
+        meas = probe["phases"][ph]
+        share = (meas / sum_ms) if sum_ms else 0.0
+        mm_s = f"{mm:>10.4f}" if mm is not None else f"{'-':>10}"
+        print(f"{ph:<10} {mm_s} {meas:>12.4f} {share:>6.0%}")
+    msum_s = f"{model_sum:>10.4f}" if cm is not None else f"{'-':>10}"
+    print(f"{'sum':<10} {msum_s} {sum_ms:>12.4f}")
+    whole = probe.get("whole_ms_per_iter")
+    if whole:
+        print(f"\n>whole-iteration anchor: {whole:.4f} ms/iter "
+              f"({probe.get('whole_iters', '?')} iters, real solve "
+              "program)")
+        print(f">attribution (phase sum / whole): "
+              f"{probe['attribution']:.2f}")
+        if cm is not None and cm["predicted_ms_per_iter"]:
+            print(f">model ratio (measured whole / predicted): "
+                  f"{whole / cm['predicted_ms_per_iter']:.2f} "
+                  f"(predicted {cm['predicted_ms_per_iter']:.4f} ms/iter, "
+                  f"profile={cm['profile']})")
+    _finish_telemetry(s, args)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="pcg-tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -632,7 +801,7 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
-    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None,
+    p.add_argument("--precond", choices=_precond_choices(), default=None,
                    help="preconditioner: scalar Jacobi (reference "
                         "parity), 3x3 node-block Jacobi (stronger on "
                         "heterogeneous elasticity), or mg — geometric "
@@ -678,7 +847,7 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
-    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None)
+    p.add_argument("--precond", choices=_precond_choices(), default=None)
     _add_variant_flag(p)
     p.add_argument("--backend",
                    choices=["auto", "structured", "hybrid", "general"],
@@ -749,7 +918,7 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
-    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None)
+    p.add_argument("--precond", choices=_precond_choices(), default=None)
     _add_variant_flag(p)
     p.add_argument("--backend", choices=["auto", "hybrid", "general"],
                    default="auto")
@@ -774,7 +943,7 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default="mixed")
-    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None)
+    p.add_argument("--precond", choices=_precond_choices(), default=None)
     _add_variant_flag(p)
     p.add_argument("--octree", action="store_true",
                    help="graded octree model with transition pattern types "
@@ -804,7 +973,7 @@ def main(argv=None):
     p.add_argument("--tol", type=float, default=None)
     p.add_argument("--max-iter", type=int, default=None)
     p.add_argument("--precision", choices=["direct", "mixed"], default=None)
-    p.add_argument("--precond", choices=["jacobi", "block3", "mg"], default=None)
+    p.add_argument("--precond", choices=_precond_choices(), default=None)
     _add_variant_flag(p)
     p.add_argument("--backend",
                    choices=["auto", "structured", "hybrid", "general"],
@@ -834,6 +1003,69 @@ def main(argv=None):
 
     add_lint_args(p)
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("perf-report",
+                       help="measured-vs-model phase attribution: time "
+                            "the matvec/precond/reduction/axpy "
+                            "sub-programs of a live solver against the "
+                            "analytic cost model's prediction "
+                            "(obs/perf.py + obs/phases.py; runs "
+                            "chiplessly on CPU)")
+    p.add_argument("scratch", nargs="?", default=None,
+                   help="scratch dir with an ingested MDF model "
+                        "(default: a synthetic --nx cube)")
+    p.add_argument("--nx", type=int, default=12,
+                   help="synthetic heterogeneous cube size when no "
+                        "scratch dir is given (default 12 — below ~10 "
+                        "the while-loop carry machinery the four phases "
+                        "deliberately exclude dominates the anchor and "
+                        "the attribution ratio goes soft)")
+    p.add_argument("--settings", default=None)
+    p.add_argument("--n-parts", type=int, default=None)
+    p.add_argument("--tol", type=float, default=None)
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--precond", choices=_precond_choices(),
+                   default=None)
+    _add_variant_flag(p)
+    p.add_argument("--nrhs", type=int, default=1,
+                   help="probe the blocked (multi-RHS) programs at this "
+                        "block width")
+    p.add_argument("--inner", type=int, default=16,
+                   help="inner applications per timed dispatch "
+                        "(amortizes host dispatch overhead)")
+    p.add_argument("--reps", type=int, default=5,
+                   help="interleaved measurement rounds (each times "
+                        "every phase plus one whole-iteration anchor; "
+                        "per-quantity best-of across rounds)")
+    p.add_argument("--backend",
+                   choices=["auto", "structured", "hybrid", "general"],
+                   default="general",
+                   help="matvec backend for the probed solver (default "
+                        "general — the probe works on any, general is "
+                        "the portable reference)")
+    _add_telemetry_flags(p)
+    _add_cache_flag(p)
+    _add_preflight_flag(p)
+    p.set_defaults(fn=cmd_perf_report, precision=None)
+
+    p = sub.add_parser("summary",
+                       help="offline summary of a telemetry/flight JSONL "
+                            "artifact — tolerant of the truncated "
+                            "trailing line a dead tunnel produces "
+                            "(skipped + counted, never raised on)")
+    p.add_argument("files", nargs="+", metavar="FILE.jsonl")
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("telemetry-merge",
+                       help="aggregate per-process telemetry shards "
+                            "(FILE.p<N>.jsonl, written under "
+                            "multi-process jax.distributed) into one "
+                            "time-ordered stream")
+    p.add_argument("paths", nargs="+", metavar="FILE.jsonl",
+                   help="base path(s); on-disk .p<N> siblings are "
+                        "discovered automatically")
+    p.add_argument("--out", required=True, metavar="MERGED.jsonl")
+    p.set_defaults(fn=cmd_telemetry_merge)
 
     args = ap.parse_args(argv)
     args.fn(args)

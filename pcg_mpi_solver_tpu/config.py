@@ -27,6 +27,20 @@ from typing import Sequence
 # environment is configured.
 PCG_VARIANTS = ("classic", "fused", "pipelined")
 
+# Canonical preconditioner name set (SolverConfig.precond) — the same
+# single-source discipline as PCG_VARIANTS above.  Derived consumers:
+#   * ops/precond.py VALID_PRECONDS — the prec builders (an import-time
+#     guard pins its value to this tuple),
+#   * obs/perf.py — the analytic per-iteration cost model enumerates
+#     PCG_VARIANTS x PRECONDS; an unknown name is a loud KeyError,
+#   * analysis/ cost-model-completeness rule — proves that enumeration
+#     is total,
+#   * cli.py --precond choices.
+# Lives here because this module is jax-free by contract and obs/ and
+# cli.py may consume it before the accelerator environment is
+# configured.
+PRECONDS = ("jacobi", "block3", "mg")
+
 
 @dataclasses.dataclass
 class SolverConfig:
@@ -281,7 +295,19 @@ class RunConfig:
     # Telemetry (obs/): when set, every structured event (steps, dispatch
     # timings, residual traces, run summary) is appended to this JSONL
     # file, one schema-versioned object per line.  CLI: --telemetry-out.
+    # Under multi-process jax.distributed each process writes its OWN
+    # shard (path.p<process_index>.jsonl); `pcg-tpu telemetry-merge`
+    # aggregates the shards into one time-ordered stream.
     telemetry_path: str = ""
+    # Flight recorder (obs/flight.py): when set, every solve dispatch is
+    # bracketed by fsync-per-event begin/end records (plus periodic
+    # monotonic+wall heartbeats) appended to this JSONL file — a tunnel
+    # death or SIGKILL mid-solve leaves a parseable artifact naming the
+    # in-flight dispatch and its last heartbeat, instead of a log to
+    # hand-reconstruct.  Sharded per process like telemetry_path.
+    # "" = environment default (PCG_TPU_FLIGHT), ultimately off.
+    # CLI: --flight-out.
+    flight_path: str = ""
     # Opt-in jax.profiler.TraceAnnotation around each device dispatch so
     # profiler traces show named pcg-tpu/<dispatch> regions (also
     # PCG_TPU_PROFILE_SPANS=1).  Independent of profile_dir below, which

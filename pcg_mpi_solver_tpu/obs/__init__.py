@@ -7,16 +7,21 @@ metrics registry (``obs.metrics``) and the versioned artifact schemas
 ``obs.trace`` imports jax and is loaded lazily here.
 """
 
+from pcg_mpi_solver_tpu.obs.flight import (
+    FlightRecorder, flight_verdict, merge_shards, read_jsonl_tolerant,
+    shard_jsonl_path)
 from pcg_mpi_solver_tpu.obs.metrics import (
-    JsonlSink, MetricsRecorder, StderrSink)
+    JsonlSink, MetricsRecorder, StderrSink, summarize_jsonl)
 from pcg_mpi_solver_tpu.obs.schema import BENCH_SCHEMA, TELEMETRY_SCHEMA
 
 _TRACE_NAMES = ("ConvergenceTrace", "clamp_trace_len", "empty_trace",
                 "trace_host_init", "trace_init", "trace_record",
                 "trace_specs", "unpack_trace")
 
-__all__ = ["BENCH_SCHEMA", "TELEMETRY_SCHEMA", "JsonlSink",
-           "MetricsRecorder", "StderrSink", *_TRACE_NAMES]
+__all__ = ["BENCH_SCHEMA", "TELEMETRY_SCHEMA", "FlightRecorder",
+           "JsonlSink", "MetricsRecorder", "StderrSink",
+           "flight_verdict", "merge_shards", "read_jsonl_tolerant",
+           "shard_jsonl_path", "summarize_jsonl", *_TRACE_NAMES]
 
 
 def __getattr__(name):

@@ -124,6 +124,11 @@ class MetricsRecorder:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, Any] = {}
         self.sinks: List[Any] = list(sinks)
+        # Optional crash-durable flight recorder (obs/flight.py): when
+        # attached, every dispatch span is bracketed by fsync'd
+        # begin/end flight records so a SIGKILL mid-dispatch leaves a
+        # parseable artifact naming the in-flight program.
+        self.flight = None
         self.profile_spans = bool(profile_spans)
         self._clock = clock
         self._spans: Dict[str, List[float]] = {}    # name -> [count, total_s]
@@ -145,7 +150,16 @@ class MetricsRecorder:
         requested (or ``PCG_TPU_PROFILE_SPANS=1``)."""
         sinks: List[Any] = [EnvGatedStderrSink()]
         if jsonl_path:
-            sinks.append(JsonlSink(jsonl_path))
+            # Multi-process jax.distributed: each process appends to its
+            # OWN shard (run.jsonl -> run.p<idx>.jsonl) — interleaved
+            # appends from N processes would corrupt a shared file.
+            # Single-process paths are untouched; `pcg-tpu
+            # telemetry-merge` reassembles the shards.  Lazy import, no
+            # jax side effects (shard_jsonl_path only consults an
+            # already-imported jax).
+            from pcg_mpi_solver_tpu.obs.flight import shard_jsonl_path
+
+            sinks.append(JsonlSink(shard_jsonl_path(jsonl_path)))
         if profile is None:
             profile = os.environ.get("PCG_TPU_PROFILE_SPANS") == "1"
         return cls(sinks=sinks, profile_spans=bool(profile))
@@ -165,6 +179,9 @@ class MetricsRecorder:
                 pass
 
     def close(self) -> None:
+        fl = self.flight
+        if fl is not None:
+            fl.close()
         for s in self.sinks:
             close = getattr(s, "close", None)
             if close:
@@ -234,19 +251,36 @@ class MetricsRecorder:
             ann = jax.profiler.TraceAnnotation(f"pcg-tpu/{name}")
         else:
             ann = None
+        # Flight bracket (obs/flight.py): the begin record is fsync'd
+        # BEFORE the dispatch runs, so a tunnel death / SIGKILL inside
+        # the device call leaves "dispatch:<name> in flight" on disk —
+        # the round-5 artifact an operator used to reconstruct by hand.
+        flight = self.flight
+        seq = (flight.begin(f"dispatch:{name}", cold=cold)
+               if flight is not None else None)
         t0 = self._clock()
+        ok = True
+        err = None
         try:
             if ann is not None:
                 with ann:
                     yield
             else:
                 yield
+        except BaseException as e:
+            ok = False
+            err = f"{type(e).__name__}: {e}"
+            raise
         finally:
             dt = self._clock() - t0
             with self._lock:
                 st = self._dispatch[name]
                 st[1 if cold else 2] += dt
             self.inc(f"dispatch.{name}.calls")
+            if flight is not None:
+                flight.end(seq, f"dispatch:{name}", ok=ok,
+                           wall_s=round(dt, 6),
+                           **({"error": err} if err else {}))
             if emit:
                 self.event("dispatch", name=name, wall_s=round(dt, 6),
                            cold=cold)
@@ -320,3 +354,89 @@ class MetricsRecorder:
         if extra:
             lines.extend(f"counter {k} = {extra[k]}" for k in sorted(extra))
         return "\n".join(lines) if lines else "(no telemetry recorded)"
+
+
+def summarize_jsonl(path: str) -> str:
+    """Offline ``--summary`` of an on-disk telemetry/flight JSONL
+    artifact — INCLUDING the exact artifact a dead tunnel produces: a
+    truncated trailing line is skipped and counted (``truncated_lines``),
+    never raised on (obs/flight.read_jsonl_tolerant).
+
+    Rebuilds the live summary's tables from the event stream: the
+    per-step table, per-dispatch cold/warm aggregation, per-kind event
+    counts, the last run_summary's gauges, and — when flight records are
+    present — the mechanical verdict (clean / failed / died-in-flight
+    with the unclosed record names and last heartbeat)."""
+    from pcg_mpi_solver_tpu.obs.flight import (
+        flight_verdict, read_jsonl_tolerant)
+
+    events, truncated = read_jsonl_tolerant(path)
+    lines = [f"{path}: {len(events)} event(s), "
+             f"truncated_lines = {truncated}"]
+    kinds: Dict[str, int] = {}
+    for ev in events:
+        k = str(ev.get("kind", "?"))
+        kinds[k] = kinds.get(k, 0) + 1
+    if kinds:
+        lines.append("  " + "  ".join(f"{k}={kinds[k]}"
+                                      for k in sorted(kinds)))
+    steps = [ev for ev in events if ev.get("kind") == "step"]
+    if steps:
+        lines.append("")
+        lines.append(f"{'step':>5} {'flag':>4} {'iters':>7} "
+                     f"{'relres':>10} {'wall_s':>9}")
+        for ev in steps:
+            try:
+                relres = float(ev.get("relres", float("nan")))
+                wall = float(ev.get("wall_s", float("nan")))
+            except (TypeError, ValueError):
+                relres = wall = float("nan")
+            lines.append(
+                f"{ev.get('step', '?'):>5} {ev.get('flag', '?'):>4} "
+                f"{ev.get('iters', '?'):>7} {relres:>10.3e} "
+                f"{wall:>9.3f}")
+    disp: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("kind") != "dispatch":
+            continue
+        st = disp.setdefault(str(ev.get("name", "?")), [0, 0.0, 0.0])
+        st[0] += 1
+        try:
+            w = float(ev.get("wall_s", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            w = 0.0
+        st[1 if ev.get("cold") else 2] += w
+    if disp:
+        lines.append("")
+        lines.append(f"{'dispatch':<24} {'calls':>6} {'cold_s':>9} "
+                     f"{'warm_s':>9}")
+        for name in sorted(disp):
+            d = disp[name]
+            lines.append(f"{name:<24} {int(d[0]):>6} {d[1]:>9.3f} "
+                         f"{d[2]:>9.3f}")
+    summaries = [ev for ev in events if ev.get("kind") == "run_summary"]
+    if summaries:
+        gauges = summaries[-1].get("gauges") or {}
+        if isinstance(gauges, dict) and gauges:
+            lines.append("")
+            lines.extend(f"gauge {k} = {gauges[k]}"
+                         for k in sorted(gauges))
+    if any(ev.get("kind") == "flight" for ev in events):
+        v = flight_verdict(events)
+        lines.append("")
+        lines.append(f"flight verdict: {v['verdict']} "
+                     f"({v['records']} record(s))")
+        if v["in_flight"]:
+            lines.append("  in flight at death: "
+                         + ", ".join(v["in_flight"]))
+        for msg in v["fails"]:
+            lines.append(f"  fail: {msg}")
+        for msg in v.get("expected_fails", []):
+            lines.append(f"  expected descent: {msg}")
+        if v["last_wall"] is not None:
+            lines.append(f"  last record at t={v['last_wall']:.3f} "
+                         f"(mono {v['last_mono']})")
+    if truncated:
+        lines.append(f"({truncated} truncated line(s) skipped — the "
+                     "partial write of a killed process)")
+    return "\n".join(lines)

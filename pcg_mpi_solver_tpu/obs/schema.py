@@ -94,6 +94,25 @@ EVENT_KINDS: Dict[str, tuple] = {
     # bounds, whether the fine bound came from the partition cache, and
     # the setup wall — the cost side of the iteration-count win
     "mg_setup": ("levels", "degree", "wall_s"),
+    # analytic per-iteration cost model (obs/perf.py): per-phase
+    # FLOPs/HBM-bytes/collective resources + roofline-predicted ms/iter
+    # for the engaged (pcg_variant, precond, nrhs, backend) — emitted at
+    # solver construction so every telemetry stream carries the number
+    # its measured ms/iter should be judged against
+    "cost_model": ("pcg_variant", "precond", "nrhs", "backend", "phases",
+                   "predicted_ms_per_iter"),
+    # one measured phase-attribution probe run (obs/phases.py /
+    # `pcg-tpu perf-report`): per-phase measured ms/iter (matvec /
+    # precond / reduction / axpy), their sum, and the whole-iteration
+    # anchor from the real solve program
+    "phase_probe": ("pcg_variant", "precond", "phases",
+                    "sum_ms_per_iter", "whole_ms_per_iter"),
+    # one crash-durable flight record (obs/flight.py — fsync-per-event):
+    # op = meta | begin | heartbeat | end | fail; begin/end/fail carry
+    # name+seq, every record carries the monotonic clock next to the
+    # base wall `t` so a dead run's artifact says what was in flight and
+    # when it last breathed, across host clock jumps
+    "flight": ("op", "mono"),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }
@@ -115,10 +134,18 @@ BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 #  tol — with ``iters`` it makes a preconditioner A/B (BENCH_PRECOND)
 #  read as time-to-solution, not just dof*iter/s.  Both are emitted on
 #  every leg, insurance/salvage lines included.
+#  ``predicted_ms_per_iter`` / ``model_ratio`` (ISSUE 12) are the
+#  analytic cost model's verdict on the line (obs/perf.py): the
+#  roofline-predicted ms/iter for the line's engaged
+#  (variant, precond, nrhs, platform) and measured/predicted — emitted
+#  on EVERY leg, insurance/salvage included, so an interrupted window
+#  still records how far off the model was.  Null when the model could
+#  not be built (e.g. the zero-value error sentinel).
 BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "nrhs_planned", "dof_iter_rhs_per_s",
                         "nrhs_quarantined", "nrhs_recoveries",
-                        "time_to_tol_s", "iters")
+                        "time_to_tol_s", "iters",
+                        "predicted_ms_per_iter", "model_ratio")
 # ``setup_cache``: warm-path partition attribution (cache/ subsystem).
 BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
 # ``pcg_variant``: the engaged PCG loop formulation of the line's

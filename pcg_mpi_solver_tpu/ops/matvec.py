@@ -81,6 +81,25 @@ if tuple(PCG_SCALAR_PSUMS) != PCG_VARIANTS:
 # from the per-iteration gauges.
 PCG_DEFERRED_CHECK_PSUMS = 1
 
+# Full-length vector UPDATES per committed iteration of each loop
+# formulation (solver/pcg.py bodies) — the memory-bound axpy side of the
+# per-iteration cost, consumed by the analytic cost model (obs/perf.py)
+# next to the collective table above.  Counted from the loop bodies:
+#
+# * classic   — p = z + beta*p, x += alpha*p, r -= alpha*q        -> 3
+# * fused     — p/q recurrences + x/r updates                     -> 4
+# * pipelined — GV p/s/q/z recurrences + x/r/u/w updates          -> 8
+#
+# Same key-set pin as PCG_SCALAR_PSUMS: a new variant cannot land in one
+# table without the other.
+PCG_VECTOR_AXPYS = {"classic": 3, "fused": 4, "pipelined": 8}
+
+if tuple(PCG_VECTOR_AXPYS) != PCG_VARIANTS:
+    raise ImportError(
+        "ops/matvec.PCG_VECTOR_AXPYS keys must match config.PCG_VARIANTS "
+        "(the single-source variant name set): "
+        f"{tuple(PCG_VECTOR_AXPYS)} != {PCG_VARIANTS}")
+
 # ---------------------------------------------------------------------------
 # Declared per-APPLY collective contract of the preconditioners
 # (SolverConfig.precond), the same one-table discipline as

@@ -111,7 +111,18 @@ def invert_node_blocks(B: jnp.ndarray, eff3: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(ok[..., None, None], inv, scalar).astype(out_dt)
 
 
-VALID_PRECONDS = ("jacobi", "block3", "mg")
+from pcg_mpi_solver_tpu.config import PRECONDS as VALID_PRECONDS
+
+if VALID_PRECONDS != ("jacobi", "block3", "mg"):
+    # an explicit raise, not `assert` — the guard must survive -O.  The
+    # builders below dispatch on exactly these three names; a name added
+    # to the canonical config.PRECONDS table without a builder here (or
+    # vice versa) must fail at import, loudly, before any layer can
+    # disagree about the valid set.
+    raise ImportError(
+        "ops/precond builders cover ('jacobi', 'block3', 'mg') but the "
+        f"canonical config.PRECONDS table says {VALID_PRECONDS}: add the "
+        "builder (make_prec/fallback_kind) alongside the table row")
 
 
 def fallback_kind(kind: str) -> "str | None":

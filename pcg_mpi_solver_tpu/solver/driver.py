@@ -187,6 +187,16 @@ class Solver:
                 jsonl_path=self.config.telemetry_path or None,
                 profile=True if self.config.telemetry_profile else None))
         self._rec = self.recorder
+        # ---- flight recorder (obs/flight.py): crash-durable begin/end
+        # brackets + heartbeats around every solve dispatch, so a tunnel
+        # death / SIGKILL mid-solve leaves a parseable artifact instead
+        # of a log to hand-reconstruct (the BENCH_r05 provenance mode).
+        from pcg_mpi_solver_tpu.obs.flight import attach_flight
+
+        self._flight = attach_flight(
+            self._rec, self.config.flight_path, "solver",
+            pcg_variant=self.config.solver.pcg_variant,
+            precond=self.config.solver.precond)
         # ---- preflight gate (validate/): reject a pathological model or
         # config BEFORE any partition build or XLA compile is paid (the
         # flagship pays minutes of both).  Policy: config.preflight >
@@ -536,6 +546,47 @@ class Solver:
                 variant=solver_cfg.pcg_variant,
                 precond=solver_cfg.precond).items():
             self._rec.gauge(f"comm.{k}", v)
+
+        # Analytic per-iteration cost model (obs/perf.py, ISSUE 12):
+        # roofline-predicted ms/iter per phase for the engaged
+        # (variant, precond, nrhs, backend), emitted as a schema-
+        # versioned `cost_model` event + perf.* gauges so every
+        # telemetry stream carries the number its measured ms/iter
+        # should be judged against.  An unknown variant/precond is a
+        # loud KeyError (the single-source-table contract) — kept loud
+        # ONLY for the cost_model() table lookup itself; any hiccup in
+        # shape derivation, profile resolution or event emission on an
+        # exotic model degrades to a note — the model is observability,
+        # not a solve dependency.
+        from pcg_mpi_solver_tpu.obs import perf as _perf
+
+        self._perf_shape = None
+        self._cost_model = None
+        try:
+            shape = _perf.shape_from_solver(self)
+            profile = _perf.resolve_profile(
+                self.mesh.devices.flat[0].platform)
+        except Exception as e:                          # noqa: BLE001
+            self._rec.note(f"cost_model unavailable: "
+                           f"{type(e).__name__}: {e}")
+        else:
+            try:
+                cm = _perf.cost_model(
+                    shape, solver_cfg.pcg_variant, solver_cfg.precond,
+                    max(1, int(solver_cfg.nrhs)), profile)
+            except KeyError:
+                raise       # unknown variant/precond stays loud
+            except Exception as e:                      # noqa: BLE001
+                self._rec.note(f"cost_model unavailable: "
+                               f"{type(e).__name__}: {e}")
+            else:
+                self._perf_shape = shape
+                self._cost_model = cm
+                try:
+                    _perf.emit_cost_model(self._rec, cm)
+                except Exception as e:                  # noqa: BLE001
+                    self._rec.note(f"cost_model emission failed: "
+                                   f"{type(e).__name__}: {e}")
 
         # In-graph convergence trace: ring length (0 = off) and its float
         # dtype — the dot dtype of whatever runs the Krylov iterations

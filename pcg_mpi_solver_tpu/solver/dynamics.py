@@ -81,6 +81,13 @@ class DynamicsSolver:
                 jsonl_path=self.config.telemetry_path or None,
                 profile=True if self.config.telemetry_profile else None))
         self._rec = self.recorder
+        # Flight recorder (obs/flight.py): the same crash-durable
+        # dispatch brackets the quasi-static Solver gets — a long
+        # explicit time history is exactly the run a tunnel death
+        # orphans mid-chunk.
+        from pcg_mpi_solver_tpu.obs.flight import attach_flight
+
+        attach_flight(self._rec, self.config.flight_path, "dynamics")
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
         n_parts = n_parts or max(self.config.n_parts, n_dev)

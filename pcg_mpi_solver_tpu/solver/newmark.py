@@ -146,6 +146,12 @@ class NewmarkSolver:
                 jsonl_path=self.config.telemetry_path or None,
                 profile=True if self.config.telemetry_profile else None))
         self._rec = self.recorder
+        # Flight recorder: same crash-durable dispatch brackets as the
+        # quasi-static and explicit-dynamics drivers (obs/flight.py).
+        from pcg_mpi_solver_tpu.obs.flight import attach_flight
+
+        attach_flight(self._rec, self.config.flight_path, "newmark",
+                      pcg_variant=scfg.pcg_variant, precond=scfg.precond)
         from pcg_mpi_solver_tpu.ops.precond import VALID_PRECONDS
         from pcg_mpi_solver_tpu.solver.pcg import VALID_PCG_VARIANTS
 

@@ -491,13 +491,15 @@ def test_rule_catalog_complete():
     rules = {r.id: r for r in engine.list_rules()}
     expected = {"collective-budget", "hot-loop-purity", "dtype-discipline",
                 "donation-integrity", "fingerprint-completeness",
-                "recovery-paths", "recovery-coverage", "telemetry-schema"}
+                "recovery-paths", "recovery-coverage", "telemetry-schema",
+                "cost-model-completeness"}
     assert expected <= set(rules)
     assert len(expected) >= 5
     # the pre-hardware-window gate covers the structural claims
     assert rules["collective-budget"].fast
     assert rules["recovery-paths"].fast
     assert rules["recovery-coverage"].fast
+    assert rules["cost-model-completeness"].fast
     assert not rules["fingerprint-completeness"].fast
 
 
@@ -557,6 +559,90 @@ def test_recovery_coverage_seeded_violations():
     # (4) stale registry entry: the registered function vanished
     errs4 = check_recovery_coverage({rel: "x = 1\n"})
     assert any("no such function" in e for e in errs4), errs4
+
+
+# ----------------------------------------------------------------------
+# cost-model-completeness (ISSUE 12): the analytic per-iteration cost
+# model covers every canonical variant x precond combination, loudly
+# ----------------------------------------------------------------------
+
+def test_cost_model_completeness_clean_on_real_tree():
+    from pcg_mpi_solver_tpu.analysis.rules_config import (
+        cost_model_completeness_rule)
+
+    assert cost_model_completeness_rule(None) == []
+
+
+def test_cost_model_completeness_seeded_violations():
+    """Every failure class fires on seeded model functions: a combo the
+    model cannot produce, a degenerate (zero/partial-phase) entry, an
+    unknown name silently accepted, and the wrong exception type for
+    an unknown name."""
+    from pcg_mpi_solver_tpu.analysis.rules_config import (
+        check_cost_model_completeness)
+    from pcg_mpi_solver_tpu.obs import perf as _perf
+
+    shape = _perf.ProblemShape(n_dof=10_000, n_parts=4, n_iface=500,
+                               elem_groups=((24, 3_000),))
+
+    def real(v, p, r):
+        return _perf.cost_model(shape, v, p, r)
+
+    # (0) the real model over the real tables: no findings
+    assert check_cost_model_completeness(model_fn=real) == []
+
+    # (1) a canonical combo the model has no entry for (the new-variant-
+    # landed-in-one-table-only failure): loud finding naming the combo
+    def missing_combo(v, p, r):
+        if (v, p) == ("pipelined", "mg"):
+            raise KeyError(p)
+        return real(v, p, r)
+
+    errs = check_cost_model_completeness(model_fn=missing_combo)
+    assert any("pipelined" in f.loc and "mg" in f.loc and
+               "no entry" in f.message for f in errs), errs
+
+    # (2) a degenerate entry: a dropped phase or a zero prediction must
+    # read as a finding, not as "this phase is free"
+    def dropped_phase(v, p, r):
+        cm = dict(real(v, p, r))
+        cm["phases"] = {k: val for k, val in cm["phases"].items()
+                        if k != "axpy"}
+        return cm
+
+    errs2 = check_cost_model_completeness(model_fn=dropped_phase)
+    assert any("degenerate" in f.message and "axpy" in f.message
+               for f in errs2), errs2
+
+    def zero_pred(v, p, r):
+        return {**real(v, p, r), "predicted_ms_per_iter": 0.0}
+
+    errs3 = check_cost_model_completeness(model_fn=zero_pred)
+    assert any("degenerate" in f.message for f in errs3), errs3
+
+    # (3) unknown names silently accepted: the fabricated-prediction
+    # failure the loudness probes exist for
+    def silent_default(v, p, r):
+        try:
+            return real(v, p, r)
+        except KeyError:
+            return real("classic", "jacobi", r)
+
+    errs4 = check_cost_model_completeness(model_fn=silent_default)
+    assert any(f.loc == "probe:unknown-variant" and "silently" in
+               f.message for f in errs4), errs4
+    assert any(f.loc == "probe:unknown-precond" for f in errs4), errs4
+
+    # (4) the wrong exception type: consumers catch KeyError as the
+    # table-out-of-sync signal, anything else is an internal failure
+    def wrong_exc(v, p, r):
+        try:
+            return real(v, p, r)
+        except KeyError:
+            raise ValueError(f"{v}/{p}")
+
+    errs5 = check_cost_model_completeness(model_fn=wrong_exc)
+    assert any("instead of KeyError" in f.message for f in errs5), errs5
 
 
 def test_baseline_suppression_and_undocumented_entry():

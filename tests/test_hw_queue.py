@@ -187,3 +187,127 @@ def test_parse_ab_missing_marker_or_file_returns_none(tmp_path):
 
     # downstream: no v9 number => no engaged flagship run (and no crash)
     assert maybe_engage_flagship(str(log), None, None) is False
+
+
+# ----------------------------------------------------------------------
+# flight records around queue steps (ISSUE 12, obs/flight.py): the
+# crash-durable twin of the session log.
+# ----------------------------------------------------------------------
+
+def test_run_step_writes_flight_brackets_in_order(tmp_path, monkeypatch):
+    """Every run_step is bracketed by begin/end flight records in the
+    session log's .flight.jsonl twin — ordered, seq-matched, and
+    verdict=clean after a clean queue."""
+    from pcg_mpi_solver_tpu.obs.flight import (
+        flight_verdict_path, read_jsonl_tolerant)
+    from tools import hw_session
+
+    ok = tmp_path / "ok.py"
+    ok.write_text("print('fine')\n")
+    log = tmp_path / "log.txt"
+    monkeypatch.setattr(hw_session, "_last_step_ok", True)
+    hw_session.run_step(str(log), "first step", [str(ok)],
+                        timeout=60, gate_s=0)
+    hw_session.run_step(str(log), "second step", [str(ok)],
+                        timeout=60, gate_s=0)
+    fpath = str(log) + ".flight.jsonl"
+    assert os.path.exists(fpath)
+    events, truncated = read_jsonl_tolerant(fpath)
+    assert truncated == 0
+    ops = [(e["op"], e.get("name")) for e in events
+           if e["op"] != "heartbeat"]
+    assert ops == [("meta", None),
+                   ("begin", "step:first step"),
+                   ("end", "step:first step"),
+                   ("begin", "step:second step"),
+                   ("end", "step:second step")]
+    # the begin record is written BEFORE the subprocess result exists:
+    # it must carry the argv for the post-mortem
+    begins = [e for e in events if e["op"] == "begin"]
+    assert begins[0]["argv"] == [str(ok)]
+    assert flight_verdict_path(fpath)["verdict"] == "clean"
+
+
+def test_run_step_failure_logs_flight_verdict(tmp_path, monkeypatch):
+    """A failed step closes its bracket with op=fail AND prints the
+    mechanical post-mortem pointer — flight-record path + verdict —
+    into the session log itself."""
+    from pcg_mpi_solver_tpu.obs.flight import flight_verdict_path
+    from tools import hw_session
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys\nsys.exit(3)\n")
+    log = tmp_path / "log.txt"
+    monkeypatch.setattr(hw_session, "_last_step_ok", True)
+    hw_session.run_step(str(log), "doomed step", [str(bad)],
+                        timeout=60, gate_s=0)
+    assert hw_session._last_step_ok is False
+    fpath = str(log) + ".flight.jsonl"
+    v = flight_verdict_path(fpath)
+    assert v["verdict"] == "failed"
+    assert any("doomed step" in f for f in v["fails"])
+    text = log.read_text()
+    assert f"flight record: {fpath} verdict=failed" in text
+    # ...and an ok_rcs-listed verdict exit stays a CLEAN bracket (the
+    # cache_key_check rc=4 MISMATCH is an answer, not a failure)
+    log2 = tmp_path / "log2.txt"
+    hw_session.run_step(str(log2), "verdict step", [str(bad)],
+                        timeout=60, gate_s=0, ok_rcs=(0, 3))
+    v2 = flight_verdict_path(str(log2) + ".flight.jsonl")
+    assert v2["verdict"] == "clean", v2
+    assert "flight record:" not in log2.read_text()
+
+
+def test_stale_flight_artifact_rotated_not_inherited(tmp_path,
+                                                     monkeypatch):
+    """A leftover flight file from a DEAD previous session on the same
+    log path is ingested (verdict logged) and rotated to .prev before
+    this session records — otherwise this session's reused seq numbers
+    would close the dead session's brackets (its death reads clean) and
+    its stale unclosed brackets would poison this session's verdict."""
+    from pcg_mpi_solver_tpu.obs.flight import (
+        FlightRecorder, flight_verdict_path)
+    from tools import hw_session
+
+    log = tmp_path / "log.txt"
+    fpath = str(log) + ".flight.jsonl"
+    dead = FlightRecorder(fpath, heartbeat_s=30)
+    dead.begin("step:killed by tunnel death")     # never closed
+    dead.close()
+    assert flight_verdict_path(fpath)["verdict"] == "died"
+
+    ok = tmp_path / "ok.py"
+    ok.write_text("print('fine')\n")
+    monkeypatch.setattr(hw_session, "_last_step_ok", True)
+    hw_session.run_step(str(log), "fresh step", [str(ok)],
+                        timeout=60, gate_s=0)
+    # the dead artifact moved aside intact; the fresh stream is clean
+    prev = flight_verdict_path(fpath + ".prev")
+    assert prev["verdict"] == "died"
+    assert prev["in_flight"] == ["step:killed by tunnel death"]
+    v = flight_verdict_path(fpath)
+    assert v["verdict"] == "clean", v
+    text = log.read_text()
+    assert "verdict=died" in text
+    assert "in flight at death: step:killed by tunnel death" in text
+
+
+def test_run_step_survives_flight_recorder_trouble(tmp_path, monkeypatch):
+    """Recorder trouble must never cost a hardware window a step:
+    run_step logs the problem and runs the subprocess anyway."""
+    from tools import hw_session
+
+    def boom(path):
+        raise OSError("read-only scratch")
+
+    monkeypatch.setattr(hw_session, "_flight", boom)
+    monkeypatch.setattr(hw_session, "_last_step_ok", True)
+    ok = tmp_path / "ok.py"
+    ok.write_text("print('fine')\n")
+    log = tmp_path / "log.txt"
+    hw_session.run_step(str(log), "unflighted step", [str(ok)],
+                        timeout=60, gate_s=0)
+    text = log.read_text()
+    assert "flight recorder unavailable" in text
+    assert "=== unflighted step done: rc=0" in text
+    assert hw_session._last_step_ok is True

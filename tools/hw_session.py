@@ -62,6 +62,7 @@ Presets:
 from __future__ import annotations
 
 import argparse
+import atexit
 import datetime
 import os
 import subprocess
@@ -116,6 +117,41 @@ def log_line(path, msg):
 
 _last_step_ok = True
 
+# one flight recorder per session log (lazy): the crash-durable twin of
+# the log itself.  The HW_SESSION.log stream dies with the tunnel; the
+# flight file's fsync-per-event begin/end brackets + heartbeats survive
+# it, so "which step was in flight when the window died, and when did it
+# last breathe" is a mechanical read (pcg-tpu summary <log>.flight.jsonl)
+# instead of log archaeology (the BENCH_r05 provenance mode).
+_FLIGHTS = {}
+
+
+@atexit.register
+def _close_flights():
+    # clean interpreter exit only — a SIGKILL skips this, which is the
+    # point: every record is already fsync'd, close is bookkeeping
+    for fl in _FLIGHTS.values():
+        fl.close()
+
+
+def _flight(path):
+    if path not in _FLIGHTS:
+        try:
+            from pcg_mpi_solver_tpu.obs.flight import (
+                FlightRecorder, ingest_and_rotate)
+        except ImportError:
+            sys.path.insert(0, REPO)
+            from pcg_mpi_solver_tpu.obs.flight import (
+                FlightRecorder, ingest_and_rotate)
+        fpath = path + ".flight.jsonl"
+        # a leftover artifact from a previous session on the same log is
+        # ingested + rotated first (the shared startup discipline —
+        # obs/flight.ingest_and_rotate documents why)
+        fpath = ingest_and_rotate(fpath, lambda msg: log_line(path, msg))
+        _FLIGHTS[path] = FlightRecorder(
+            fpath, meta={"component": "hw_session"})
+    return _FLIGHTS[path]
+
 
 def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
              force_gate=False, ok_rcs=(0,)):
@@ -152,6 +188,18 @@ def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
     env.update(env_extra or {})
     log_line(path, f"=== {name}: {' '.join(argv)} "
                    + (f"env={env_extra} " if env_extra else ""))
+    # crash-durable flight bracket around the whole step (begin fsync'd
+    # BEFORE the subprocess launches; heartbeats while it runs): a
+    # tunnel death mid-step leaves "step:<name> in flight" on disk even
+    # when the log stream itself is lost.  Best-effort — recorder
+    # trouble must never cost a hardware window a step.
+    fl = fl_seq = None
+    try:
+        fl = _flight(path)
+        fl_seq = fl.begin(f"step:{name}", argv=list(argv))
+    except Exception as e:                              # noqa: BLE001
+        log_line(path, f"flight recorder unavailable ({e}); continuing")
+        fl = None
     t0 = time.monotonic()
     # own process GROUP so a timeout kills the step's whole tree —
     # bench.py spawns its own subprocesses (reference baseline, CPU
@@ -184,6 +232,28 @@ def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
     # (cache_key_check exits 4 for a successfully-determined MISMATCH) —
     # those must not trip the next step's wedged-grant gate
     _last_step_ok = status in tuple(f"rc={rc}" for rc in ok_rcs)
+    if fl is not None:
+        try:
+            fail_extra = {} if _last_step_ok else {"error": status}
+            fl.end(fl_seq, f"step:{name}", ok=_last_step_ok,
+                   status=status, wall_s=round(wall, 1), **fail_extra)
+            if not _last_step_ok:
+                # the mechanical post-mortem pointer, IN the session log:
+                # where the durable artifact is and what it says
+                from pcg_mpi_solver_tpu.obs.flight import (
+                    flight_verdict_path)
+
+                v = flight_verdict_path(fl.path)
+                log_line(path, f"flight record: {fl.path} "
+                               f"verdict={v['verdict']} "
+                               f"({v['records']} record(s)"
+                               + (", in flight: "
+                                  + ", ".join(v["in_flight"])
+                                  if v["in_flight"] else "")
+                               + ")")
+        except Exception as e:                          # noqa: BLE001
+            log_line(path, f"flight record close failed ({e}); "
+                           "continuing")
     log_line(path, f"=== {name} done: {status} ({wall:.0f}s)")
     return status
 
