@@ -72,6 +72,11 @@ TRACE_NEUTRAL_RUNCONFIG = frozenset({
     "snapshot_every", "preflight", "cache_dir", "telemetry_path",
     "flight_path", "telemetry_profile", "profile_dir", "comm_probe_iters",
     "solver", "time_history",
+    # ISSUE 14: sharded setup builds the SAME partition rows this
+    # process would otherwise slice out of a full build — device data,
+    # traced programs and cache keys are unchanged (bit-identity
+    # asserted in tests/test_setup_shard.py)
+    "setup_shard",
 })
 
 
@@ -325,6 +330,84 @@ def check_cost_model_completeness(variants=None, preconds=None,
                     "name — an out-of-sync name table would stamp "
                     "fabricated predictions instead of failing loudly"))
     return out
+
+
+# ----------------------------------------------------------------------
+# partition-key-components (ISSUE 14): the shard-addressed partition
+# cache's structural key components must each move the digest alone.
+# ----------------------------------------------------------------------
+
+def check_partition_key_components(shard_key_fn=None,
+                                   glue_key_fn=None) -> List[Finding]:
+    """Every structural component of the shard-addressed partition keys
+    (cache/keys.py) must bite on its own — above all ``part_idx``: two
+    parts of one partition colliding on one entry would hand a process
+    another process's rows on warm start.  The glue key must differ
+    from every part key (distinct ``kind``), and an out-of-range
+    part_idx must KeyError (a key for a part that cannot exist would
+    cache an unreachable entry).  ``shard_key_fn``/``glue_key_fn`` are
+    seeded-violation test hooks."""
+    from pcg_mpi_solver_tpu.cache import keys as ckeys
+
+    shard_key_fn = shard_key_fn or ckeys.partition_shard_key
+    glue_key_fn = glue_key_fn or ckeys.partition_glue_key
+
+    def k(**over):
+        kw = dict(n_parts=8, part_idx=0, backend="general",
+                  dtype="float64", method="rcb", elem_part_hash=None,
+                  pad_multiple=8, extra={})
+        kw.update(over)
+        return shard_key_fn("<model_fp>", **kw)
+
+    base = k()
+    out: List[Finding] = []
+    for name, over in (("part_idx", {"part_idx": 3}),
+                       ("n_parts", {"n_parts": 4, "part_idx": 0}),
+                       ("backend", {"backend": "structured"}),
+                       ("dtype", {"dtype": "float32"}),
+                       ("method", {"method": "slab2"}),
+                       ("elem_part_hash", {"elem_part_hash": "abc"}),
+                       ("pad_multiple", {"pad_multiple": 16}),
+                       ("extra", {"extra": {"slab2_slabs": 4}})):
+        if k(**over) == base:
+            out.append(Finding(
+                rule="partition-key-components",
+                loc=f"field:partition_shard_key.{name}",
+                message=f"structural component {name!r} does not change "
+                        "the partition shard key — entries of different "
+                        "shape would collide; a warm start could hand a "
+                        "process another shard's rows"))
+    glue = glue_key_fn("<model_fp>", n_parts=8, backend="general",
+                       dtype="float64", method="rcb")
+    if glue == base:
+        out.append(Finding(
+            rule="partition-key-components",
+            loc="field:partition_glue_key.kind",
+            message="the glue key collides with a part entry key — the "
+                    "glue must carry its own structural kind"))
+    try:
+        k(part_idx=99)
+    except KeyError:
+        pass
+    else:
+        out.append(Finding(
+            rule="partition-key-components",
+            loc="probe:part_idx-range",
+            message="partition_shard_key accepted part_idx outside "
+                    "[0, n_parts) — a key for a part that cannot exist "
+                    "caches an unreachable entry instead of failing "
+                    "loudly"))
+    return out
+
+
+@rule("partition-key-components", kind="config", fast=True,
+      doc="every structural component of the shard-addressed partition "
+          "cache keys (part_idx/n_parts/backend/dtype/method/"
+          "elem_part_hash/pad_multiple/extra) moves the digest alone, "
+          "the glue key is kind-distinct, and out-of-range part_idx "
+          "raises KeyError")
+def partition_key_components_rule(ctx) -> List[Finding]:
+    return check_partition_key_components()
 
 
 @rule("cost-model-completeness", kind="config", fast=True,

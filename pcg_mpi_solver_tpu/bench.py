@@ -1221,6 +1221,14 @@ def _fallback_chain(emitter, prov, deadline, why):
 
 def main():
     t0 = time.monotonic()
+    if os.environ.get("BENCH_SETUP_LADDER"):
+        # ISSUE 14: the weak-scaling SETUP ladder leg — CPU-only by
+        # design (it measures partition build / ingest / warm-cache
+        # walls across jax.distributed process counts, never the
+        # accelerator), so it runs before any probe/orchestration
+        from pcg_mpi_solver_tpu.setup_ladder import main as ladder_main
+
+        sys.exit(ladder_main())
     # a stale provisional file from a previous crashed run must not be
     # salvageable as THIS run's number
     try:

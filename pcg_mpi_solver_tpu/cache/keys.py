@@ -31,7 +31,11 @@ from pcg_mpi_solver_tpu.config import PCG_VARIANTS
 #    per-column recovery / drift-guard carry leaves and the
 #    quarantine-flag finalize; AOT entries exported from the old
 #    programs must not be deserialized into the new semantics.
-CACHE_SCHEMA = 2
+# 3: ISSUE 14 — PartitionedModel gained the layout/part_range fields and
+#    the partition cache became shard-addressed (glue + per-part
+#    entries, cache/shards.py); monolithic entries pickled by older code
+#    lack the new fields and must re-key rather than deserialize.
+CACHE_SCHEMA = 3
 
 # Monkeypatchable in tests to simulate a package-version bump without
 # editing the package.
@@ -47,6 +51,15 @@ def _hash_update(h, obj: Any) -> None:
         a = np.ascontiguousarray(obj)
         h.update(f"nd:{a.shape}:{a.dtype}".encode())
         h.update(a.tobytes())
+    elif hasattr(obj, "ids") and hasattr(obj, "vals") \
+            and hasattr(obj, "fill"):
+        # models/model_data.SparseVec (slab-ingest nodal restriction):
+        # its CONTENT must hash — falling through to repr() would hash
+        # only n/nnz/dtype, making models that differ solely in nodal
+        # data (loads, coordinates) collide in the partition cache
+        h.update(f"sparsevec:{len(obj)}:{obj.fill!r}".encode())
+        _hash_update(h, np.asarray(obj.ids))
+        _hash_update(h, np.asarray(obj.vals))
     elif isinstance(obj, (bool, int, float, str, bytes, complex,
                           np.integer, np.floating, np.bool_)):
         h.update(f"{type(obj).__name__}:{obj!r}".encode())
@@ -115,6 +128,86 @@ def partition_cache_key(model_fp: str, *, n_parts: int, backend: str,
         "pad_multiple": int(pad_multiple),
         "extra": extra or {},
     })
+
+
+def partition_shard_key(model_fp: str, *, n_parts: int, part_idx: int,
+                        backend: str, dtype: str, method: str = "n/a",
+                        elem_part_hash: Optional[str] = None,
+                        pad_multiple: int = 8,
+                        extra: Optional[Dict[str, Any]] = None) -> str:
+    """Key for ONE part's rows of a shard-addressed partition entry
+    (ISSUE 14): the monolithic :func:`partition_cache_key` payload plus
+    the STRUCTURAL ``part_idx`` component, so N hosts each read only
+    their own parts' entries on a warm start.  ``part_idx`` must bite on
+    its own (proven by the analysis/ partition-key-components rule):
+    two parts of one partition must never collide on one entry."""
+    if not (0 <= int(part_idx) < int(n_parts)):
+        raise KeyError(
+            f"partition_shard_key: part_idx {part_idx} outside "
+            f"[0, {n_parts})")
+    return _digest({
+        "kind": "partition-shard",
+        "model": model_fp,
+        "n_parts": int(n_parts),
+        "part_idx": int(part_idx),
+        "backend": backend,
+        "dtype": dtype,
+        "method": method,
+        "elem_part": elem_part_hash,
+        "pad_multiple": int(pad_multiple),
+        "extra": extra or {},
+    })
+
+
+def partition_glue_key(model_fp: str, *, n_parts: int, backend: str,
+                       dtype: str, method: str = "n/a",
+                       elem_part_hash: Optional[str] = None,
+                       pad_multiple: int = 8,
+                       extra: Optional[Dict[str, Any]] = None) -> str:
+    """Key for the GLUE entry of a shard-addressed partition: the global
+    layout (PartitionLayout, scalars, shared element matrices) every
+    process loads alongside its own part entries.  Same payload as the
+    per-part keys minus ``part_idx`` — distinct ``kind`` so glue can
+    never collide with a part entry or a legacy monolithic one."""
+    return _digest({
+        "kind": "partition-glue",
+        "model": model_fp,
+        "n_parts": int(n_parts),
+        "backend": backend,
+        "dtype": dtype,
+        "method": method,
+        "elem_part": elem_part_hash,
+        "pad_multiple": int(pad_multiple),
+        "extra": extra or {},
+    })
+
+
+def mdf_fingerprint(mdf_path: str, chunk_bytes: int = 1 << 24) -> str:
+    """Content hash of an on-disk MDF bundle, STREAMED file-by-file in
+    bounded chunks — the slab-ingest twin of :func:`model_fingerprint`:
+    a process that never materializes the full model (models/mdf.
+    read_mdf_slab) still needs the one content hash every shard key
+    shares, and every process must derive the identical hash from the
+    identical bundle."""
+    import os
+
+    h = hashlib.sha256()
+    try:
+        names = sorted(os.listdir(mdf_path))
+    except OSError as e:
+        raise FileNotFoundError(f"mdf_fingerprint: {mdf_path}: {e}")
+    for name in names:
+        p = os.path.join(mdf_path, name)
+        if not os.path.isfile(p):
+            continue
+        h.update(f"file:{name}:{os.path.getsize(p)}".encode())
+        with open(p, "rb") as f:
+            while True:
+                chunk = f.read(chunk_bytes)
+                if not chunk:
+                    break
+                h.update(chunk)
+    return h.hexdigest()
 
 
 def step_cache_key(*, abstract: Any, mesh: Any, backend: str,

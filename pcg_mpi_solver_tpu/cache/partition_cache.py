@@ -84,19 +84,32 @@ def evict_lru(entry_dir: str, keep: str,
         entries = []
         for fn in os.listdir(entry_dir):
             p = os.path.join(entry_dir, fn)
-            if fn.startswith(prefix) and fn.endswith(suffix):
-                st = os.stat(p)
-                entries.append((st.st_mtime, st.st_size, p))
-            elif fn.endswith(".tmp") and \
-                    time.time() - os.stat(p).st_mtime > 3600:
-                os.remove(p)            # SIGKILL-orphaned half-write
+            # Per-file tolerance (ISSUE 14 bugfix): with N processes
+            # writing shard entries into ONE cache dir, another
+            # process's eviction can delete a file between our listdir
+            # and stat/remove — that is a fait accompli, not a reason to
+            # abort THIS process's whole eviction pass (the old
+            # dir-level try/except did exactly that, leaving the cache
+            # over cap whenever evictions raced).
+            try:
+                if fn.startswith(prefix) and fn.endswith(suffix):
+                    st = os.stat(p)
+                    entries.append((st.st_mtime, st.st_size, p))
+                elif fn.endswith(".tmp") and \
+                        time.time() - os.stat(p).st_mtime > 3600:
+                    os.remove(p)        # SIGKILL-orphaned half-write
+            except OSError:
+                continue                # concurrently deleted: move on
         total = sum(s for _, s, _ in entries)
         for _, size, p in sorted(entries):              # oldest first
             if total <= cap_bytes:
                 break
             if os.path.abspath(p) == os.path.abspath(keep):
                 continue                                # never the new entry
-            os.remove(p)
+            try:
+                os.remove(p)
+            except OSError:
+                pass        # another process evicted it first — same goal
             total -= size
     except OSError:
         pass                                            # best-effort
@@ -123,6 +136,94 @@ def cached_partition(cache_dir: str, key: str, builder: Callable[[], Any],
         recorder.inc("cache.partition.miss")
         recorder.event("cache", name=f"partition.{label}", hit=False,
                        key=key, stored=stored,
+                       wall_s=round(time.perf_counter() - t0, 6))
+    return pm
+
+
+def cached_partition_shards(cache_dir: str, *, glue_key: str,
+                            part_keys: Dict[int, str], builder,
+                            split, join,
+                            legacy_key: Optional[str] = None,
+                            comm=None, recorder=None,
+                            label: str = "partition"):
+    """Shard-addressed load-or-build (ISSUE 14).
+
+    Warm path: load the glue entry + ONLY the entries named in
+    ``part_keys`` (this process's parts) and ``join`` them — zero build
+    work, and the bytes read scale with parts-per-process, not model
+    size.  Legacy shim: when any shard entry misses but ``legacy_key``
+    (the monolithic :func:`partition_cache_key`) hits, the monolithic
+    object is served as-is — pre-shard caches stay warm.  Cold path:
+    ``builder()`` builds (possibly only this process's part range), then
+    ``split`` publishes the glue + one entry per key in ``part_keys``
+    (each process persists exactly the parts it built; under a
+    multi-process cold start the processes collectively tile the whole
+    partition).
+
+    ``comm`` (a SetupComm under multi-process jax.distributed): the
+    warm-vs-cold decision GATES a collective code path (the cold
+    builder runs the layout exchange), so it must be AGREED across the
+    group — a process whose entries were concurrently evicted (or whose
+    store failed on a full disk) must not build-and-exchange while its
+    peers skip ahead to later collectives (mispaired allgathers hang
+    the group).  With ``comm`` set, one small reduce decides: warm only
+    if EVERY process can serve warm (shard entries or the legacy
+    monolithic); otherwise every process builds.
+
+    Emits ONE ``cache`` event (hit = fully-warm) with the per-entry read
+    accounting the sharded-warm-start tests assert on; counters follow
+    :func:`cached_partition` (`cache.partition.hit`/`miss`)."""
+    import numpy as np
+
+    t0 = time.perf_counter()
+    glue = load_partition(cache_dir, glue_key)
+    shards, missing = {}, []
+    if glue is not None:
+        for p, key in part_keys.items():
+            sh = load_partition(cache_dir, key)
+            if sh is None:
+                missing.append(p)
+                break
+            shards[p] = sh
+    shard_warm = glue is not None and not missing
+    legacy_pm = None
+    if not shard_warm and legacy_key is not None:
+        legacy_pm = load_partition(cache_dir, legacy_key)
+    can_serve = shard_warm or legacy_pm is not None
+    if comm is not None and getattr(comm, "n_procs", 1) > 1:
+        (agreed,), = comm.allreduce_groups(
+            [([np.asarray([int(can_serve)], dtype=np.int64)], "min")])
+        can_serve = bool(int(agreed[0]))
+    if can_serve and shard_warm:
+        pm = join(glue, shards)
+        if recorder is not None:
+            recorder.inc("cache.partition.hit")
+            recorder.event("cache", name=f"partition.{label}", hit=True,
+                           key=glue_key, shard=True,
+                           entries=1 + len(shards),
+                           parts=sorted(part_keys),
+                           wall_s=round(time.perf_counter() - t0, 6))
+        return pm
+    if can_serve and legacy_pm is not None:
+        if recorder is not None:
+            recorder.inc("cache.partition.hit")
+            recorder.event("cache", name=f"partition.{label}",
+                           hit=True, key=legacy_key, shard=False,
+                           legacy=True,
+                           wall_s=round(time.perf_counter() - t0, 6))
+        return legacy_pm
+    pm = builder()
+    glue, built = split(pm)
+    keys = dict(part_keys)
+    stored = store_partition(cache_dir, glue_key, glue)
+    for p, key in keys.items():
+        if p in built:
+            stored = store_partition(cache_dir, key, built[p]) and stored
+    if recorder is not None:
+        recorder.inc("cache.partition.miss")
+        recorder.event("cache", name=f"partition.{label}", hit=False,
+                       key=glue_key, shard=True, stored=stored,
+                       parts=sorted(keys),
                        wall_s=round(time.perf_counter() - t0, 6))
     return pm
 

@@ -537,7 +537,10 @@ def cmd_demo(args):
                                 E=30e9, nu=0.2, load="traction",
                                 load_value=1e6, heterogeneous=True)
         print(f">demo model: {model.n_elem} elems / {model.n_dof} dofs")
-    s = Solver(model, cfg)
+    # the octree demo EXPLICITLY showcases the hybrid level-grid path
+    # (auto-selection is deprecation-gated behind PCG_TPU_ENABLE_HYBRID,
+    # ISSUE 14 — an explicit request stays honored)
+    s = Solver(model, cfg, backend="hybrid" if args.octree else "auto")
     store = RunStore(cfg.result_path, cfg.model_name)
     res = s.solve(store=store)
     for t, r in enumerate(res, 1):

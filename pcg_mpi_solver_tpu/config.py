@@ -167,6 +167,21 @@ class SolverConfig:
     #                      per V-cycle (ops/matvec.precond_cycle_cost).
     mg_levels: int = 0
     mg_smooth_degree: int = 2
+    # MG replication scale audit (ISSUE 14): cap on the CUMULATIVE
+    # replicated coarse-level dof count.  PR 9 replicates every coarse
+    # level on every device (that is what makes the coarse cycle
+    # collective-free), but at 1B fine dofs the first coarse level alone
+    # is ~125M dofs PER DEVICE — replication becomes the memory ceiling
+    # long before the fine level does.  The builder truncates auto-depth
+    # hierarchies at the cutoff and REJECTS (named reason,
+    # ops/mg.apply_replication_cutoff) configs whose first coarse level
+    # cannot fit, or whose explicit mg_levels request would have to be
+    # silently truncated; validate/ preflights the same arithmetic.
+    # Default 32M dofs ~= 256 MB/level-vector f64 — comfortably inside
+    # one device at today's scales, loud long before 1B.  0 = no cutoff.
+    # Structural when it bites (it reshapes the hierarchy): rides the
+    # solver dict into step_cache_key and the mg_shape fingerprint.
+    mg_max_replicated_dofs: int = 32_000_000
     # Split the solve into several device dispatches of at most this many
     # Krylov iterations each (-1 = auto: engage on large problems, sized so
     # one dispatch stays well under a minute; 0 = single dispatch).  Long
@@ -278,6 +293,18 @@ class RunConfig:
     #   MID-TIME-HISTORY, and NaN/Inf rollback restores the last one.
     # 0 = off.
     snapshot_every: int = 0
+    # Sharded setup path (ISSUE 14): under multi-process jax.distributed
+    # the general/structured partition builders construct ONLY this
+    # process's parts (the global layout merges via host allreduce) and
+    # the warm cache reads only this process's per-part entries.
+    #   "auto" — engage when multi-process with an eligible mesh/backend;
+    #   "on"   — like auto, but raise when the mesh layout prevents it;
+    #   "off"  — every process builds/loads the full partition (the
+    #            historical behavior).
+    # Trace-neutral: the engaged sharded build produces bit-identical
+    # partition content for this process's rows, so the compiled program
+    # and all cache keys are unchanged.
+    setup_shard: str = "auto"
     # Preflight gate (validate/ subsystem): sanity-check the ModelData
     # and config cross-constraints BEFORE any partition build or XLA
     # compile.  "" = environment default (PCG_TPU_PREFLIGHT, ultimately

@@ -21,6 +21,76 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+class SparseVec:
+    """Sparse view of a global ``(n,[k])`` nodal array restricted to a
+    sorted id subset — the slab-ingest representation of F/Ud/diag_M/
+    node_coords (models/mdf.read_mdf_slab): a process holding only its
+    slab's referenced dofs still serves the ``model.F[global_ids]``
+    gathers the partition build performs, without ever materializing the
+    full vector.  Lookups outside the restriction return ``fill``
+    (never legitimately read by a build restricted to the same slab —
+    asserted in tests via ``strict=True``)."""
+
+    __slots__ = ("ids", "vals", "n", "fill", "strict")
+
+    def __init__(self, ids: np.ndarray, vals: np.ndarray, n: int,
+                 fill: float = 0.0, strict: bool = False):
+        self.ids = np.asarray(ids)
+        self.vals = np.asarray(vals)
+        if len(self.ids) != len(self.vals):
+            raise ValueError("SparseVec: ids/vals length mismatch")
+        if len(self.ids) > 1 and not bool(np.all(np.diff(self.ids) > 0)):
+            raise ValueError("SparseVec: ids must be strictly increasing")
+        self.n = int(n)
+        self.fill = fill
+        self.strict = bool(strict)
+
+    def __len__(self) -> int:
+        return self.n
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    @property
+    def shape(self):
+        return (self.n,) + self.vals.shape[1:]
+
+    def __getitem__(self, idx):
+        idx = np.asarray(idx)
+        scalar = idx.ndim == 0
+        flat = np.atleast_1d(idx).astype(np.int64).ravel()
+        if len(self.ids) == 0:
+            hit = np.zeros(len(flat), dtype=bool)
+            posc = np.zeros(len(flat), dtype=np.int64)
+        else:
+            pos = np.searchsorted(self.ids, flat)
+            posc = np.minimum(pos, len(self.ids) - 1)
+            hit = self.ids[posc] == flat
+        if self.strict and not hit.all():
+            missing = flat[~hit][:5]
+            raise IndexError(
+                f"SparseVec: lookup outside the slab restriction "
+                f"(ids {missing.tolist()}...)")
+        out = self.vals[posc].copy()
+        out[~hit] = self.fill
+        # idx.shape (not atleast_1d) so a scalar lookup returns a scalar
+        # (0-d -> [()]), matching the dense-array contract exactly
+        out = out.reshape(idx.shape + self.vals.shape[1:])
+        return out[()] if scalar else out
+
+    def materialize(self) -> np.ndarray:
+        """Dense global array (testing/small models only)."""
+        out = np.full((self.n,) + self.vals.shape[1:], self.fill,
+                      dtype=self.vals.dtype)
+        out[self.ids] = self.vals
+        return out
+
+    def __repr__(self) -> str:       # deterministic (hash-friendly)
+        return (f"SparseVec(n={self.n}, nnz={len(self.ids)}, "
+                f"dtype={self.vals.dtype})")
+
+
 @dataclasses.dataclass
 class ModelData:
     # Counts
@@ -97,6 +167,17 @@ class ModelData:
     #    'area': float,            — interface element area
     #    'normal_axis': int}       — 0/1/2 (octree interfaces are axis-aligned)
     intfc_elems: Optional[List[dict]] = None
+
+    # Slab-ingest view (ISSUE 14, models/mdf.read_mdf_slab): when set,
+    # the per-element arrays above cover ONLY the slab's elements (in
+    # this order) and ``elem_ids[i]`` is element i's GLOBAL id; nodal
+    # arrays are SparseVec restrictions to the slab's referenced ids.
+    # ``n_elem`` is then the SLAB count (the global count is
+    # ``glob_n_elem``); node/dof ids and counts stay global throughout,
+    # so partitioning and the interface reduction are unchanged.
+    # None = a full dense model (every existing producer).
+    elem_ids: Optional[np.ndarray] = None
+    glob_n_elem: Optional[int] = None
 
     def elem_nodes(self, e: int) -> np.ndarray:
         return self.elem_nodes_flat[self.elem_nodes_offset[e]:self.elem_nodes_offset[e + 1]]

@@ -113,6 +113,12 @@ EVENT_KINDS: Dict[str, tuple] = {
     # base wall `t` so a dead run's artifact says what was in flight and
     # when it last breathed, across host clock jumps
     "flight": ("op", "mono"),
+    # sharded setup attribution (ISSUE 14): which contiguous part range
+    # THIS process built/loaded (`parts` = [lo, hi)), whether the
+    # partition came cold (built) or warm (shard cache), and the
+    # partition-build wall — the per-process record the setup ladder
+    # aggregates and the sharded-warm-start tests assert on
+    "setup_shard": ("parts", "n_parts", "cold", "partition_build_s"),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }
@@ -141,11 +147,22 @@ BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 #  on EVERY leg, insurance/salvage included, so an interrupted window
 #  still records how far off the model was.  Null when the model could
 #  not be built (e.g. the zero-value error sentinel).
+#  The ``setup_ladder`` leg (ISSUE 14, BENCH_SETUP_LADDER) stamps the
+#  weak-scaling setup fields: ``procs`` (rung process count),
+#  ``partition_build_s`` (max per-process sharded build wall),
+#  ``partition_serial_s`` (the monolithic full build of the SAME model —
+#  what every process would pay without the sharded path; the ratio is
+#  the acceptance number), ``cold_setup_s``/``warm_setup_s`` (solver
+#  setup wall on the cold vs shard-cache-warm start), and
+#  ``ingest_peak_bytes`` (streamed slab ingest's peak host memory).
 BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "nrhs_planned", "dof_iter_rhs_per_s",
                         "nrhs_quarantined", "nrhs_recoveries",
                         "time_to_tol_s", "iters",
-                        "predicted_ms_per_iter", "model_ratio")
+                        "predicted_ms_per_iter", "model_ratio",
+                        "procs", "partition_build_s",
+                        "partition_serial_s", "cold_setup_s",
+                        "warm_setup_s", "ingest_peak_bytes")
 # ``setup_cache``: warm-path partition attribution (cache/ subsystem).
 BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
 # ``pcg_variant``: the engaged PCG loop formulation of the line's

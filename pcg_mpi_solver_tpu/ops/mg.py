@@ -279,8 +279,57 @@ class MGSetup:
     lam_min_coarse: float
 
 
+def level_replicated_dofs(level_dims) -> List[int]:
+    """Per-coarse-level REPLICATED dof counts (3 dofs/node on a full
+    node grid) — the memory-audit quantity behind
+    ``SolverConfig.mg_max_replicated_dofs``: every level below the fine
+    one is replicated on EVERY device, so at 1B fine dofs the first
+    coarse level alone is ~125M dofs per device.  Shared by the builder
+    cutoff and the validate/ preflight warning."""
+    return [3 * (cx + 1) * (cy + 1) * (cz + 1)
+            for cx, cy, cz in level_dims]
+
+
+def apply_replication_cutoff(level_dims, n_levels: int,
+                             max_replicated_dofs: int):
+    """Truncate the planned hierarchy before the CUMULATIVE replicated
+    coarse-level dofs exceed ``max_replicated_dofs`` (0 = no cutoff).
+    Raises :class:`MGSetupError` (named reason) when not even the first
+    coarse level fits — replication would become the memory ceiling —
+    or when an EXPLICIT ``mg_levels`` request cannot be honored under
+    the cutoff (truncating a stated request silently would change the
+    traced program behind the user's back)."""
+    if max_replicated_dofs <= 0:
+        return level_dims
+    sizes = level_replicated_dofs(level_dims)
+    keep, cum = [], 0
+    for dims, sz in zip(level_dims, sizes):
+        if cum + sz > max_replicated_dofs:
+            break
+        cum += sz
+        keep.append(dims)
+    if not keep:
+        raise MGSetupError(
+            f"precond='mg': the first coarse level ({level_dims[0]} "
+            f"cells, {sizes[0]} replicated dofs) already exceeds "
+            f"SolverConfig.mg_max_replicated_dofs="
+            f"{max_replicated_dofs} — every coarse level is replicated "
+            "on every device, so this hierarchy would make replication "
+            "the memory ceiling; raise the cutoff or use "
+            "precond='jacobi'|'block3'")
+    if n_levels and len(keep) < n_levels:
+        raise MGSetupError(
+            f"SolverConfig.mg_levels={n_levels} needs "
+            f"{sum(sizes[:n_levels])} replicated coarse dofs, over the "
+            f"mg_max_replicated_dofs={max_replicated_dofs} cutoff "
+            f"(only {len(keep)} level(s) fit); lower mg_levels or raise "
+            "the cutoff")
+    return keep
+
+
 def build_mg_host(model, pm, n_levels: int = 0,
-                  degree: int = 2) -> MGSetup:
+                  degree: int = 2,
+                  max_replicated_dofs: int = 0) -> MGSetup:
     """Build the whole MG hierarchy on host from the model lattice and
     the partition's node map.
 
@@ -288,7 +337,11 @@ def build_mg_host(model, pm, n_levels: int = 0,
     arrays are laid out in the SAME node order as ``ops._as_node3``
     (asserted equal on both supported backends by tests/test_mg.py).
     The fine level's lambda_max slot in ``tree["lam"]`` is a placeholder
-    until :func:`estimate_fine_lam` fills it (device matvec required)."""
+    until :func:`estimate_fine_lam` fills it (device matvec required).
+    ``max_replicated_dofs`` (SolverConfig.mg_max_replicated_dofs) caps
+    the cumulative replicated coarse-level size — the ISSUE-14 scale
+    audit of PR 9's replicate-everything design; see
+    :func:`apply_replication_cutoff`."""
     if int(model.n_dof) != 3 * int(model.n_node):
         raise MGSetupError(
             "precond='mg' needs the vector (3-dof/node) problem class; "
@@ -303,7 +356,8 @@ def build_mg_host(model, pm, n_levels: int = 0,
         raise MGSetupError(
             "precond='mg' needs lattice metadata (ModelData.grid or "
             ".octree); this model has neither — use precond='jacobi'")
-    level_dims = plan_levels(dims, n_levels)
+    level_dims = apply_replication_cutoff(
+        plan_levels(dims, n_levels), n_levels, max_replicated_dofs)
 
     # ---- unit-lattice stiffness-density field E(x) --------------------
     X, Y, Z = dims

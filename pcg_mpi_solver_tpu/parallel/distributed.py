@@ -54,6 +54,19 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if not _initialized:
         # NOTE: must run before anything touches the XLA backend — do not
         # query jax.process_count() here.
+        if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+            # Multi-process CPU groups (the weak-scaling setup ladder,
+            # the 2/4-process tests) need a cross-process collectives
+            # implementation — the default CPU client rejects
+            # multiprocess computations outright ("Multiprocess
+            # computations aren't implemented on the CPU backend").
+            # Gloo ships with jaxlib; best-effort for jax versions
+            # without the knob.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:               # noqa: BLE001
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -153,6 +166,160 @@ def fetch_addressable(x) -> tuple:
                 "(use make_global_mesh, or export via fetch_global)")
         pos = b
     return rows, p0, p1
+
+
+class HostComm:
+    """Host-side reduction group over the processes of a jax.distributed
+    run — the multi-process implementation of the sharded-setup exchange
+    protocol (``parallel/partition.SerialComm`` is the 1-process twin).
+    Built on ``multihost_utils.process_allgather`` + a numpy reduce, so
+    arbitrary host arrays (the partition layout's count/owner vectors)
+    ride the existing collective fabric; every process must call
+    ``allreduce`` in the same order with same-shaped arrays."""
+
+    _OPS = {"sum": np.sum, "min": np.min, "max": np.max}
+
+    def __init__(self):
+        self.n_procs = jax.process_count()
+
+    def allreduce(self, arr: np.ndarray, op: str) -> np.ndarray:
+        arr = np.asarray(arr)
+        if self.n_procs == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(arr)
+        return self._OPS[op](np.asarray(gathered), axis=0).astype(arr.dtype)
+
+    def warmup(self, sizes=(1,)) -> None:
+        """Pay the one-time collective-fabric costs (gloo/ICI channel
+        setup, the per-shape allgather program compile) BEFORE any timed
+        partition span — connection establishment and program compile
+        are not partition work and must not pollute
+        ``partition_build_s``.  ``sizes``: the exact 1-D payload sizes
+        the exchange will use (``parallel/partition.
+        layout_exchange_sizes``); every process must call with the same
+        sequence (each warmup is itself a collective).  Routed through
+        ``allreduce_groups`` so the warmed program matches the packed
+        (int32) path the real exchange takes."""
+        for n in sizes:
+            self.allreduce_groups([([np.zeros(int(n), np.int64)], "max")])
+
+    def allreduce_many(self, arrs, op: str):
+        """Several same-op reductions in ONE collective (see
+        ``allreduce_groups`` — this is the single-group case)."""
+        return self.allreduce_groups([(arrs, op)])[0]
+
+    def allreduce_groups(self, groups):
+        """Differently-reduced array groups in ONE collective: an
+        allreduce is an allgather + a local reduce, so every group
+        shares a single packed buffer — one dispatch, one per-shape
+        program, one gloo/DCN round for the whole layout exchange.
+        ALWAYS packed as int32 (halves the wire payload; every
+        layout-exchange value — counts, owners, per-part sizes — fits
+        by design): the dtype choice must be identical on every process
+        (a per-process int64 fallback would enter the collective with
+        mismatched byte-widths), so an out-of-range value raises LOUDLY
+        here instead."""
+        from jax.experimental import multihost_utils
+
+        groups = [([np.asarray(a) for a in arrs], op)
+                  for arrs, op in groups]
+        if self.n_procs == 1:
+            return [arrs for arrs, _ in groups]
+        flats = [a.astype(np.int64).ravel()
+                 for arrs, _ in groups for a in arrs]
+        flat = (np.concatenate(flats) if flats
+                else np.zeros(0, np.int64))
+        if flat.size and (int(flat.max()) > 2 ** 31 - 1
+                          or int(flat.min()) < -(2 ** 31)):
+            raise OverflowError(
+                "HostComm.allreduce_groups: a layout-exchange value "
+                "exceeds int32 — the packed exchange protocol assumes "
+                "counts/owners/per-part sizes below 2^31 (a single part "
+                "beyond that is outside the design envelope); widen the "
+                "protocol deliberately rather than per-process")
+        flat = flat.astype(np.int32)
+        if flat.size <= self.CHUNK:
+            gathered = np.asarray(
+                multihost_utils.process_allgather(flat)).astype(np.int64)
+            red_flat = None
+        else:
+            # Chunked gather-reduce: one (n_procs, N) copy of an
+            # O(n_dof) payload would multiply the very memory bound the
+            # sharded setup exists to hold — reduce chunk by chunk so
+            # the transient stays n_procs * CHUNK regardless of model
+            # size.  Every chunk is padded to the SAME length, so the
+            # whole loop reuses one compiled allgather program (padding
+            # is sliced off before the reduce; all processes iterate
+            # the identical chunk sequence).
+            red_flat = np.empty(flat.size, np.int64)
+            pos_c = 0
+            while pos_c < flat.size:
+                n = min(self.CHUNK, flat.size - pos_c)
+                buf = np.zeros(self.CHUNK, np.int32)
+                buf[:n] = flat[pos_c:pos_c + n]
+                g = np.asarray(multihost_utils.process_allgather(buf))
+                # per-position op: resolve below per group segment —
+                # store BOTH reductions? No: segments are contiguous,
+                # so reduce lazily per segment from the gathered chunk.
+                # To keep one pass, stash the raw chunk reductions for
+                # both ops only when a segment boundary crosses the
+                # chunk; simpler and still bounded: keep the gathered
+                # chunk and reduce the overlapping segments now.
+                for seg_pos, seg_n, op in self._segments(groups):
+                    lo = max(seg_pos, pos_c)
+                    hi = min(seg_pos + seg_n, pos_c + n)
+                    if lo < hi:
+                        red_flat[lo:hi] = self._OPS[op](
+                            g[:, lo - pos_c:hi - pos_c], axis=0)
+                pos_c += n
+        out, pos = [], 0
+        for arrs, op in groups:
+            red_arrs = []
+            for a in arrs:
+                n = int(a.size)
+                if red_flat is not None:
+                    red = red_flat[pos:pos + n]
+                else:
+                    red = self._OPS[op](gathered[:, pos:pos + n], axis=0)
+                red_arrs.append(red.reshape(a.shape).astype(a.dtype))
+                pos += n
+            out.append(red_arrs)
+        return out
+
+    #: chunk length (int32 entries) of the chunked gather-reduce path:
+    #: 4M entries = 16 MB per process-copy per chunk
+    CHUNK = 1 << 22
+
+    @staticmethod
+    def _segments(groups):
+        """(pos, size, op) spans of the packed buffer, one per array."""
+        pos = 0
+        for arrs, op in groups:
+            for a in arrs:
+                n = int(np.asarray(a).size)
+                yield pos, n, op
+                pos += n
+
+
+def local_part_range(mesh: jax.sharding.Mesh, n_parts: int):
+    """The contiguous [lo, hi) part range whose rows are addressable by
+    THIS process on a parts-sharded (P, ...) array over ``mesh``, or
+    None when this process's parts are not one contiguous block (an
+    exotic device order — the sharded setup path then falls back to the
+    full build).  Single process: the full range."""
+    if jax.process_count() == 1:
+        return (0, n_parts)
+    devices = list(mesh.devices.flat)
+    if n_parts % len(devices) != 0:
+        return None
+    ppd = n_parts // len(devices)
+    pid = jax.process_index()
+    mine = [p for p, d in enumerate(devices) if d.process_index == pid]
+    if not mine or mine != list(range(mine[0], mine[-1] + 1)):
+        return None
+    return (mine[0] * ppd, (mine[-1] + 1) * ppd)
 
 
 def put_tree(tree, mesh: jax.sharding.Mesh, specs):

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import warnings
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -85,15 +85,19 @@ def graph_partition(model: ModelData, n_parts: int, ncommon: int = 1,
 
 
 def make_elem_part(model: ModelData, n_parts: int, method: str = "rcb",
-                   seed: int = 0) -> np.ndarray:
+                   seed: int = 0, n_slabs: int = 1) -> np.ndarray:
     """Element->part map by method: 'rcb' (coordinate bisection), 'graph'
-    (native dual-graph, raises if the native lib is missing), or 'auto'
-    (graph when the native lib is present, else RCB)."""
+    (native dual-graph, raises if the native lib is missing), 'auto'
+    (graph when the native lib is present, else RCB), or 'slab2' (the
+    two-level split for sharded setup — see :func:`two_level_partition`;
+    ``n_slabs`` is the coarse slab count, 1 == plain RCB)."""
     BUILD_CALLS["make_elem_part"] += 1
     if n_parts <= 1:
         return np.zeros(model.n_elem, dtype=np.int32)
     if method == "rcb":
         return rcb_partition(model.sctrs, n_parts)
+    if method == "slab2":
+        return two_level_partition(model.sctrs, n_parts, n_slabs)
     if method == "graph":
         return graph_partition(model, n_parts, seed=seed, strict=True)
     if method == "auto":
@@ -101,6 +105,78 @@ def make_elem_part(model: ModelData, n_parts: int, method: str = "rcb",
             return graph_partition(model, n_parts, seed=seed, strict=False)
         return rcb_partition(model.sctrs, n_parts)
     raise ValueError(f"unknown partition method {method!r}")
+
+
+def coarse_slab_cut(centroids: np.ndarray, n_slabs: int) -> np.ndarray:
+    """The CHEAP coarse cut of the two-level split: one stable argsort of
+    ONE coordinate axis (the longest global extent), cut into ``n_slabs``
+    balanced contiguous chunks.  Returns the (n_elem,) slab id map.
+    Deterministic — every process of a sharded build computes the same
+    cut from the same centroids (or each process computes only its own
+    slab membership from the global axis order during slab ingest)."""
+    n = len(centroids)
+    slab = np.zeros(n, dtype=np.int32)
+    if n_slabs <= 1:
+        return slab
+    axis = int(np.argmax(centroids.max(axis=0) - centroids.min(axis=0)))
+    order = np.argsort(centroids[:, axis], kind="stable")
+    bounds = [int(round(n * s / n_slabs)) for s in range(n_slabs + 1)]
+    for s in range(n_slabs):
+        slab[order[bounds[s]:bounds[s + 1]]] = s
+    return slab
+
+
+def two_level_partition(centroids: np.ndarray, n_parts: int,
+                        n_slabs: int = 1, refine=None) -> np.ndarray:
+    """Two-level METIS-style element partition (the sharded-setup path,
+    ISSUE 14): a cheap coarse slab cut (:func:`coarse_slab_cut`) into
+    ``n_slabs`` contiguous slabs along the dominant axis, then an
+    INDEPENDENT per-slab RCB refinement into ``n_parts // n_slabs``
+    parts each — so under a multi-process build each process only has to
+    refine (and renumber, and block-build) its own slab.  ``n_slabs=1``
+    degenerates to plain RCB.  Deterministic for fixed inputs; the slab
+    count is a cache-key component (the resulting partition differs
+    between slab counts).
+
+    ``refine`` (iterable of slab ids, None = all): slabs NOT listed keep
+    their coarse label ``slab_id * parts_per_slab`` instead of the RCB
+    refinement — the sharded-build fast path refines only its own
+    slab(s); unrefined labels are exact at slab granularity, so any
+    consumer restricted to the refined slabs' parts sees the identical
+    map the full refinement would give."""
+    if n_parts % max(n_slabs, 1) != 0:
+        raise ValueError(
+            f"two_level_partition: n_parts={n_parts} must be divisible "
+            f"by n_slabs={n_slabs}")
+    n_slabs = max(n_slabs, 1)
+    pps = n_parts // n_slabs
+    slab = coarse_slab_cut(centroids, n_slabs)
+    refine_set = set(range(n_slabs)) if refine is None else set(refine)
+    part = np.zeros(len(centroids), dtype=np.int32)
+    for s in range(n_slabs):
+        idx = np.where(slab == s)[0]
+        if s in refine_set:
+            part[idx] = s * pps + rcb_partition(centroids[idx], pps)
+        else:
+            part[idx] = s * pps
+    return part
+
+
+def slab_local_parts(slab_centroids: np.ndarray, n_parts: int,
+                     n_slabs: int, slab_idx: int):
+    """Per-slab refinement half of the two-level split, for a process
+    that holds ONLY its slab (models/mdf.read_mdf_slab): returns the
+    slab-positional element->part map and this slab's ``part_range``.
+    Identical assignment to :func:`two_level_partition` run on the full
+    model (the slab's elements arrive in ascending global id order from
+    ``slab_elem_ids``, matching ``np.where(slab == s)`` order)."""
+    if n_parts % max(n_slabs, 1) != 0:
+        raise ValueError(
+            f"slab_local_parts: n_parts={n_parts} not divisible by "
+            f"n_slabs={n_slabs}")
+    pps = n_parts // max(n_slabs, 1)
+    part = slab_idx * pps + rcb_partition(slab_centroids, pps)
+    return part.astype(np.int32), (slab_idx * pps, (slab_idx + 1) * pps)
 
 def rcb_partition(centroids: np.ndarray, n_parts: int) -> np.ndarray:
     """Recursive coordinate bisection on element centroids.
@@ -226,11 +302,201 @@ class PartitionedModel:
     spr_b: Optional[np.ndarray] = None   # (P, NS) int32
     spr_k: Optional[np.ndarray] = None   # (P, NS) float
 
+    # Sharded setup (ISSUE 14): the global layout glue this partition was
+    # built against (cache/shards.py persists it as the glue entry), and
+    # the part range whose rows are actually populated — (0, n_parts) for
+    # a full monolithic build.
+    layout: Optional["PartitionLayout"] = None
+    part_range: Optional[Tuple[int, int]] = None
+
 
 def _pad_to(x: np.ndarray, n: int, fill) -> np.ndarray:
     out = np.full((n,) + x.shape[1:], fill, dtype=x.dtype)
     out[: len(x)] = x
     return out
+
+
+class SerialComm:
+    """No-op reduction group: the single-process degenerate of the
+    sharded-build exchange protocol (every reduction input already IS
+    the global value).  The multi-process twin is
+    ``parallel/distributed.HostComm`` (jax.distributed allgather)."""
+
+    n_procs = 1
+
+    def allreduce(self, arr: np.ndarray, op: str) -> np.ndarray:
+        return np.asarray(arr)
+
+    def allreduce_many(self, arrs, op: str):
+        """Reduce several same-op arrays in ONE exchange round (the
+        multi-process impl packs them into a single collective — each
+        round-trip costs a dispatch, so the layout exchange batches its
+        sums/mins into one call each)."""
+        return [self.allreduce(a, op) for a in arrs]
+
+    def allreduce_groups(self, groups):
+        """Several (arrays, op) groups in ONE exchange round: an
+        allreduce is an allgather + a local reduce, so differently-
+        reduced groups can still share a single collective payload (the
+        multi-process impl packs everything into one int32 buffer).
+        ``groups``: list of ``(list_of_arrays, op)``; returns the
+        reduced array lists in order."""
+        return [self.allreduce_many(arrs, op) for arrs, op in groups]
+
+
+@dataclasses.dataclass
+class PartitionLayout:
+    """Global layout 'glue' of a partition build: everything a per-part
+    build phase needs beyond its own parts — padded local sizes, the
+    interface (shared-dof) set + owners, per-type padding, spring/ELL
+    pad widths.  Under a sharded build this is the ONLY globally
+    assembled state (counts/owners exchanged via ``SetupComm``
+    reductions); the heavy per-part structures never leave their
+    process.  Also the content of the shard cache's 'glue' entry
+    (cache/shards.py), so a warm shard load skips the exchange too."""
+
+    n_parts: int
+    n_loc: int
+    n_node_loc: int
+    node_layout: bool
+    ndof_p: np.ndarray             # (P,) true local dof counts
+    nnode_p: np.ndarray            # (P,)
+    iface_gid: np.ndarray          # global dof ids present in >= 2 parts
+    iface_owner: np.ndarray
+    niface_gid: np.ndarray
+    niface_owner: np.ndarray
+    type_N: Dict[int, int]         # type id -> padded per-part width (0=skip)
+    NS: int                        # padded spring width (0 = no springs)
+    have_springs: bool
+    NI: Optional[int] = None       # padded iface map width (resolved lazily)
+    NNI: Optional[int] = None
+    K: Optional[int] = None        # ELL width (resolved lazily)
+
+
+def _node_layout_local(model, dof_gids: dict, node_gids: dict,
+                       elems_ok: bool) -> bool:
+    """The node-interleaved-dof condition evaluated on THIS process's
+    parts (see the comment at the n_loc computation); AND-reduced across
+    processes under a sharded build.  ``elems_ok`` is the per-element
+    interleave check, evaluated on the local parts' CSR slices during
+    the renumbering loop (the parts of all processes tile every element,
+    so the AND-reduction covers the model without any process paying an
+    O(total-connectivity) pass)."""
+    return bool(
+        elems_ok
+        and len(model.elem_dofs_flat) == 3 * len(model.elem_nodes_flat)
+        and np.array_equal(np.asarray(model.elem_dofs_offset),
+                           3 * np.asarray(model.elem_nodes_offset))
+        and all(
+            len(dof_gids[p]) == 3 * len(node_gids[p])
+            and np.array_equal(
+                dof_gids[p],
+                (3 * node_gids[p][:, None] + np.arange(3)).ravel())
+            for p in dof_gids)
+    )
+
+
+def layout_exchange_sizes(n_dof: int, n_node: int, n_types: int,
+                          n_parts: int):
+    """The DETERMINISTIC 1-D payload sizes of the sharded-build exchange
+    rounds — the packed counts+layout-flag round (``_compute_layout``)
+    and the 3-wide pad-width round in ``partition_model`` — so
+    ``HostComm.warmup`` can pre-pay their per-shape setup (program
+    compile, channel warmup) OUTSIDE the timed partition span.  The
+    third round (sparse shared-dof owners, ``_compute_layout``) has a
+    data-dependent payload unknowable before the counts reduce; its
+    power-of-two padding bounds it to a handful of program shapes whose
+    one-time compile amortizes across builds.  Must stay in sync with
+    the exchange call sites."""
+    P, T = int(n_parts), int(n_types)
+    return (3 * P + T * P + int(n_dof) + int(n_node) + 1, 3)
+
+
+def _compute_layout(model, P: int, local, type_elems, dof_gids, node_gids,
+                    type_ids, spr_part, n_springs: int,
+                    pad_multiple: int, comm,
+                    nl_elems_ok: bool = True) -> PartitionLayout:
+    """Phase-A merge: per-part counts + shared-dof counts/owners from the
+    local parts, reduced across the group into the global layout."""
+    I32MAX = np.iinfo(np.int32).max
+    ndof_p = np.zeros(P, dtype=np.int64)
+    nnode_p = np.zeros(P, dtype=np.int64)
+    dof_count = np.zeros(model.n_dof, dtype=np.int32)
+    dof_owner = np.full(model.n_dof, I32MAX, dtype=np.int32)
+    node_count = np.zeros(model.n_node, dtype=np.int32)
+    node_owner = np.full(model.n_node, I32MAX, dtype=np.int32)
+    type_counts = np.zeros((len(type_ids), P), dtype=np.int64)
+    spring_counts = np.zeros(P, dtype=np.int64)
+    for p in local:
+        g, gn = dof_gids[p], node_gids[p]
+        ndof_p[p] = len(g)
+        nnode_p[p] = len(gn)
+        dof_count[g] += 1
+        dof_owner[g] = np.minimum(dof_owner[g], p)
+        node_count[gn] += 1
+        node_owner[gn] = np.minimum(node_owner[gn], p)
+        for ti, t in enumerate(type_ids):
+            type_counts[ti, p] = len(type_elems[p][t])
+        if spr_part is not None:
+            spring_counts[p] = int(np.count_nonzero(spr_part == p))
+    nl_local = _node_layout_local(model, dof_gids, node_gids, nl_elems_ok)
+
+    sums, mins = comm.allreduce_groups([
+        ([ndof_p, nnode_p, dof_count, node_count, type_counts,
+          spring_counts], "sum"),
+        ([np.asarray([int(nl_local)], dtype=np.int64)], "min"),
+    ])
+    (ndof_p, nnode_p, dof_count, node_count, type_counts,
+     spring_counts) = sums
+    node_layout = bool(int(mins[0][0]))
+    # springs need no exchange: every process of a sharded FULL-model
+    # build derives the identical spring list from the identical model,
+    # and slab-ingested views reject interface elements outright
+    have_springs = n_springs > 0
+
+    n_node_loc = int(-(-int(nnode_p.max()) // pad_multiple) * pad_multiple)
+    # Keep n_loc = 3*n_node_loc so the dof vector reshapes to (n_node, 3)
+    # rows for the node-wise gather/scatter fast path.  The ELL path assumes
+    # node-interleaved dofs at BOTH levels: per element
+    # (elem_dofs[e][3a+c] == 3*elem_nodes[e][a]+c, which Ke4/sign_nc rely
+    # on) and per part (dof_gid == 3*node_gid+c, which the x3 reshape
+    # relies on — springs can break it by pulling in node-less dofs).
+    if node_layout:
+        n_loc = 3 * n_node_loc
+    else:
+        n_loc = int(-(-int(ndof_p.max()) // pad_multiple) * pad_multiple)
+
+    iface_gid = np.where(dof_count >= 2)[0]
+    niface_gid = np.where(node_count >= 2)[0]
+    # Owners only matter on the SHARED (interface) ids — exchange them
+    # sparsely (surface-scale, not O(n_dof)): every process derives the
+    # identical iface sets from the reduced counts, so the min-reduce of
+    # the restricted owner slices lines up position-for-position.
+    # Padded to a power-of-two length so the data-dependent payload
+    # shape reuses a handful of compiled exchange programs.
+    n_if, n_nif = len(iface_gid), len(niface_gid)
+    pad = max(1 << (max(n_if + n_nif, 1) - 1).bit_length(), 16)
+    own = np.full(pad, np.iinfo(np.int32).max, dtype=np.int32)
+    own[:n_if] = dof_owner[iface_gid]
+    own[n_if:n_if + n_nif] = node_owner[niface_gid]
+    (own,), = comm.allreduce_groups([([own], "min")])
+    iface_owner = own[:n_if].copy()
+    niface_owner = own[n_if:n_if + n_nif].copy()
+    type_N = {}
+    for ti, t in enumerate(type_ids):
+        N_t = int(type_counts[ti].max()) if P else 0
+        type_N[t] = (int(-(-N_t // pad_multiple) * pad_multiple)
+                     if N_t > 0 else 0)
+    NS = 0
+    if have_springs:
+        NS = int(spring_counts.max())
+        NS = max(int(-(-NS // pad_multiple) * pad_multiple), 1)
+    return PartitionLayout(
+        n_parts=P, n_loc=n_loc, n_node_loc=n_node_loc,
+        node_layout=node_layout, ndof_p=ndof_p, nnode_p=nnode_p,
+        iface_gid=iface_gid, iface_owner=iface_owner,
+        niface_gid=niface_gid, niface_owner=niface_owner,
+        type_N=type_N, NS=NS, have_springs=have_springs)
 
 
 def partition_model(
@@ -240,6 +506,10 @@ def partition_model(
     pad_multiple: int = 8,
     method: str = "rcb",
     block_filter: Optional[np.ndarray] = None,
+    part_range: Optional[Tuple[int, int]] = None,
+    comm=None,
+    layout: Optional[PartitionLayout] = None,
+    slab2_slabs: int = 1,
 ) -> PartitionedModel:
     """Partition ``model`` into ``n_parts`` padded shards.
 
@@ -247,69 +517,128 @@ def partition_model(
     their part (their nodes/dofs are in the local sets, weights, and
     interface maps) but are EXCLUDED from the type blocks and scatter maps
     — the hybrid level-grid backend (parallel/hybrid.py) applies their
-    stiffness through dense per-level stencils instead."""
+    stiffness through dense per-level stencils instead.
+
+    Sharded setup (ISSUE 14): with ``part_range=(lo, hi)`` only the heavy
+    per-part structures of parts [lo, hi) are built — rows outside the
+    range stay at their padding values (weight 0, dof_gid -1, index maps
+    at their out-of-range sentinels) — so an N-process ``jax.distributed``
+    run builds its own slab of parts in 1/N the time.  The global layout
+    (padded sizes, the shared-dof interface set + owners) is the ONLY
+    globally assembled state, merged from per-process count/owner
+    reductions through ``comm`` (``SerialComm`` when None — correct for a
+    single process covering the whole range; pass
+    ``parallel/distributed.HostComm`` under jax.distributed).  A
+    precomputed ``layout`` (e.g. from the shard cache's glue entry, or a
+    prior full build's ``pm.layout``) skips every exchange.  The full
+    default build (``part_range=None``) is bit-identical to the
+    historical monolithic output.
+
+    ``model`` may be a slab-ingested view (models/mdf.read_mdf_slab):
+    per-element arrays then cover only the slab's elements (``elem_part``
+    must be slab-positional) while nodal lookups resolve through the
+    slab's sparse vectors — global dof/node ids and counts are unchanged,
+    so the interface reduction still operates on global ids."""
     BUILD_CALLS["partition_model"] += 1
     if elem_part is None:
-        elem_part = make_elem_part(model, n_parts, method=method)
+        if getattr(model, "elem_ids", None) is not None:
+            raise ValueError(
+                "partition_model: a slab-ingested model view needs an "
+                "explicit slab-positional elem_part (use "
+                "slab_local_parts) — a fresh global partition cannot be "
+                "derived from one slab")
+        if (method == "slab2" and slab2_slabs > 1
+                and part_range is not None
+                and not getattr(model, "intfc_elems", None)):
+            # sharded fast path: refine ONLY the slabs overlapping this
+            # process's parts (unrefined slabs keep slab-granular
+            # labels, never queried for out-of-range parts).  Spring
+            # models are excluded: spring->part anchoring reads labels
+            # of arbitrary slabs.
+            pps = n_parts // slab2_slabs
+            BUILD_CALLS["make_elem_part"] += 1
+            elem_part = two_level_partition(
+                model.sctrs, n_parts, slab2_slabs,
+                refine=range(part_range[0] // pps,
+                             -(-part_range[1] // pps)))
+        else:
+            elem_part = make_elem_part(model, n_parts, method=method,
+                                       n_slabs=slab2_slabs)
 
     P = n_parts
+    if part_range is None:
+        part_range = (0, P)
+    lo, hi = int(part_range[0]), int(part_range[1])
+    if not (0 <= lo < hi <= P):
+        raise ValueError(f"part_range {part_range} outside [0, {P})")
+    local = range(lo, hi)
+    comm = comm or SerialComm()
     type_ids = sorted(model.elem_lib.keys())
-    # Per-part element id lists
-    part_elems = [np.where(elem_part == p)[0] for p in range(P)]
+    # Per-part element id lists (LOCAL parts only — under a sharded build
+    # the other parts' elements are never touched; ids are positional in
+    # the model's element arrays, which for a slab model cover only the
+    # slab)
+    part_elems = {p: np.where(elem_part == p)[0] for p in local}
 
     # ---- interface springs: assigned to the part of their anchor element --
     spr_ga, spr_gb, spr_gk, spr_adj = model.interface_springs()
-    have_springs = len(spr_ga) > 0
-    spr_part = elem_part[spr_adj] if have_springs else None
+    spr_part = elem_part[spr_adj] if len(spr_ga) > 0 else None
 
     # ---- local dof/node renumbering per part ------------------------------
-    dof_gids: List[np.ndarray] = []
-    node_gids: List[np.ndarray] = []
-    for p in range(P):
+    dof_gids: Dict[int, np.ndarray] = {}
+    node_gids: Dict[int, np.ndarray] = {}
+    nl_elems_ok = True
+    r3 = np.arange(3)
+    for p in local:
         e = part_elems[p]
         # All models here have constant dofs-per-elem within a type; gather
         # ragged CSR slices via offsets.
         dof_idx = _csr_take(model.elem_dofs_flat, model.elem_dofs_offset, e)
         node_idx = _csr_take(model.elem_nodes_flat, model.elem_nodes_offset, e)
-        if have_springs:
+        if nl_elems_ok:
+            # per-element node-interleave condition, checked on the
+            # local CSR slices (every process's parts together tile all
+            # elements — _node_layout_local)
+            nl_elems_ok = (
+                len(dof_idx) == 3 * len(node_idx)
+                and np.array_equal(
+                    dof_idx, (3 * node_idx[:, None] + r3).ravel()))
+        if spr_part is not None:
             # both sides of a part's springs must be locally addressable;
             # any cross-part sharing this creates is resolved by the normal
             # interface-dof assembly (a dof in >= 2 parts is psum-combined)
             m = spr_part == p
             dof_idx = np.concatenate([dof_idx, spr_ga[m], spr_gb[m]])
-        dof_gids.append(_unique(dof_idx))
-        node_gids.append(_unique(node_idx))
+        dof_gids[p] = _unique(dof_idx)
+        node_gids[p] = _unique(node_idx)
 
-    ndof_p = np.array([len(g) for g in dof_gids])
-    nnode_p = np.array([len(g) for g in node_gids])
-    n_node_loc = int(-(-int(nnode_p.max()) // pad_multiple) * pad_multiple)
-    # Keep n_loc = 3*n_node_loc so the dof vector reshapes to (n_node, 3)
-    # rows for the node-wise gather/scatter fast path.  The ELL path assumes
-    # node-interleaved dofs at BOTH levels: per element
-    # (elem_dofs[e][3a+c] == 3*elem_nodes[e][a]+c, which Ke4/sign_nc rely
-    # on) and per part (dof_gid == 3*node_gid+c, which the x3 reshape
-    # relies on — springs can break it by pulling in node-less dofs).
-    node_layout = (
-        len(model.elem_dofs_flat) == 3 * len(model.elem_nodes_flat)
-        and np.array_equal(np.asarray(model.elem_dofs_offset),
-                           3 * np.asarray(model.elem_nodes_offset))
-        and np.array_equal(
-            np.asarray(model.elem_dofs_flat),
-            (3 * np.asarray(model.elem_nodes_flat)[:, None]
-             + np.arange(3)).ravel())
-        and all(
-            len(dg) == 3 * len(ng)
-            and np.array_equal(dg, (3 * ng[:, None] + np.arange(3)).ravel())
-            for dg, ng in zip(dof_gids, node_gids))
-    )
-    if node_layout:
-        n_loc = 3 * n_node_loc
-    else:
-        n_loc = int(-(-int(ndof_p.max()) // pad_multiple) * pad_multiple)
+    # per-(part, type) element lists, computed ONCE and shared by the
+    # layout counts and the type-block build (the elem_type gather per
+    # part is O(local elements) — doing it twice would double-pay on
+    # the timed cold path)
+    type_elems: Dict[int, Dict[int, np.ndarray]] = {}
+    for p in local:
+        et = model.elem_type[part_elems[p]]
+        per_t = {}
+        for t in type_ids:
+            e = part_elems[p][et == t]
+            if block_filter is not None:
+                e = e[block_filter[e]]
+            per_t[t] = e
+        type_elems[p] = per_t
 
-    # ---- interface dofs/nodes (shared by >= 2 parts) ----------------------
-    iface_gid, iface_owner = _shared_ids(dof_gids, model.n_dof)
-    niface_gid, niface_owner = _shared_ids(node_gids, model.n_node)
+    if layout is None:
+        layout = _compute_layout(
+            model, P, local, type_elems, dof_gids, node_gids, type_ids,
+            spr_part, len(spr_ga), pad_multiple, comm,
+            nl_elems_ok=nl_elems_ok)
+    n_loc, n_node_loc = layout.n_loc, layout.n_node_loc
+    node_layout = layout.node_layout
+    ndof_p, nnode_p = layout.ndof_p, layout.nnode_p
+    have_springs = layout.have_springs
+
+    iface_gid, iface_owner = layout.iface_gid, layout.iface_owner
+    niface_gid, niface_owner = layout.niface_gid, layout.niface_owner
     n_iface = len(iface_gid)
     n_node_iface = len(niface_gid)
 
@@ -323,13 +652,13 @@ def partition_model(
     dof_gid_arr = np.full((P, n_loc), -1, dtype=np.int64)
     node_gid_arr = np.full((P, n_node_loc), -1, dtype=np.int64)
 
-    iface_local_l, iface_slot_l = [], []
-    niface_local_l, niface_slot_l = [], []
+    iface_local_l, iface_slot_l = {}, {}
+    niface_local_l, niface_slot_l = {}, {}
 
     eff_mask_glob = np.zeros(model.n_dof, dtype=bool)
-    eff_mask_glob[model.dof_eff] = True
+    eff_mask_glob[np.asarray(model.dof_eff)] = True
 
-    for p in range(P):
+    for p in local:
         g = dof_gids[p]
         n = len(g)
         dof_gid_arr[p, :n] = g
@@ -363,19 +692,13 @@ def partition_model(
         node_weight[p, : nnode_p[p]] = nw
 
         # interface maps for this part
-        iface_local_l.append(np.where(is_if)[0].astype(np.int32))
-        iface_slot_l.append(pos[is_if].astype(np.int32))
-        niface_local_l.append(np.where(nis_if)[0].astype(np.int32))
-        niface_slot_l.append(npos[nis_if].astype(np.int32))
+        iface_local_l[p] = np.where(is_if)[0].astype(np.int32)
+        iface_slot_l[p] = pos[is_if].astype(np.int32)
+        niface_local_l[p] = np.where(nis_if)[0].astype(np.int32)
+        niface_slot_l[p] = npos[nis_if].astype(np.int32)
 
-    NI = int(max((len(a) for a in iface_local_l), default=0))
-    NNI = int(max((len(a) for a in niface_local_l), default=0))
-    NI = max(NI, 1)
-    NNI = max(NNI, 1)
-    iface_local = np.stack([_pad_to(a, NI, n_loc) for a in iface_local_l])
-    iface_slot = np.stack([_pad_to(a, NI, n_iface) for a in iface_slot_l])
-    niface_local = np.stack([_pad_to(a, NNI, n_node_loc) for a in niface_local_l])
-    niface_slot = np.stack([_pad_to(a, NNI, n_node_iface) for a in niface_slot_l])
+    # (iface maps padded below — the NI/NNI/K pad widths resolve in ONE
+    # exchange round after the ELL multiplicities are known)
 
     # ---- type blocks ------------------------------------------------------
     type_blocks: List[TypeBlock] = []
@@ -384,16 +707,10 @@ def partition_model(
         lib = model.elem_lib[t]
         d = lib["Ke"].shape[0]
         nn = lib["n_nodes"]
-        per_part = []
-        for p in range(P):
-            e = part_elems[p][model.elem_type[part_elems[p]] == t]
-            if block_filter is not None:
-                e = e[block_filter[e]]
-            per_part.append(e)
-        N_t = int(max((len(e) for e in per_part), default=0))
+        per_part = {p: type_elems[p][t] for p in local}
+        N_t = layout.type_N[t]
         if N_t == 0:
             continue
-        N_t = int(-(-N_t // pad_multiple) * pad_multiple)
 
         dof = np.full((P, d, N_t), n_loc, dtype=np.int32)
         sign = np.zeros((P, d, N_t), dtype=bool)
@@ -404,7 +721,7 @@ def partition_model(
         valid = np.zeros((P, N_t), dtype=bool)
         n_elem_t = np.zeros(P, dtype=np.int64)
 
-        for p in range(P):
+        for p in local:
             e = per_part[p]
             ne = len(e)
             n_elem_t[p] = ne
@@ -437,7 +754,7 @@ def partition_model(
     NC = sum(tb.d * tb.dof.shape[2] for tb in type_blocks)
     scat_perm = np.zeros((P, NC), dtype=np.int32)
     scat_ids = np.zeros((P, NC), dtype=np.int32)
-    for p in range(P if type_blocks else 0):
+    for p in (local if type_blocks else ()):
         flat = np.concatenate([tb.dof[p].ravel() for tb in type_blocks])
         nat = native.sort_i32(flat.astype(np.int32))
         if nat is not None:
@@ -447,14 +764,13 @@ def partition_model(
             scat_perm[p] = perm
             scat_ids[p] = flat[perm]
 
-    # ---- node-ELL scatter map (TPU fast path) -----------------------------
-    ell = None
-    if node_layout and type_blocks:
+    # ---- node-ELL multiplicities (TPU fast path, fill deferred) -----------
+    want_ell = node_layout and bool(type_blocks)
+    seg_data = {}
+    K_loc = 1
+    if want_ell:
         n_slots = sum(tb.n_nodes * tb.node.shape[2] for tb in type_blocks)
-        per_part_ell = []
-        seg_data = []
-        K = 1
-        for p in range(P):
+        for p in local:
             # slot id = block_base + node_slot*N_blk + elem  (ravel of (nn, N))
             ids_n = np.concatenate([tb.node[p].reshape(-1) for tb in type_blocks])
             valid = ids_n < n_node_loc        # padded slots point out of range
@@ -463,28 +779,52 @@ def partition_model(
             order = np.argsort(ids_v, kind="stable")
             ids_s, slots_s = ids_v[order], slots[order]
             counts = np.bincount(ids_s, minlength=n_node_loc)
-            K = max(K, int(counts.max()) if len(counts) else 0)
-            seg_data.append((ids_s, slots_s, counts))
-        for p in range(P):
+            K_loc = max(K_loc, int(counts.max()) if len(counts) else 0)
+            seg_data[p] = (ids_s, slots_s, counts)
+
+    # ---- the ONE pad-width exchange round (NI/NNI/K) ----------------------
+    if layout.NI is None or (want_ell and layout.K is None):
+        (dims,), = comm.allreduce_groups([([np.asarray(
+            [max((len(a) for a in iface_local_l.values()), default=0),
+             max((len(a) for a in niface_local_l.values()), default=0),
+             K_loc], dtype=np.int64)], "max")])
+        layout.NI = max(int(dims[0]), 1)
+        layout.NNI = max(int(dims[1]), 1)
+        layout.K = int(dims[2])
+    NI, NNI = int(layout.NI), int(layout.NNI)
+    iface_local = np.stack(
+        [_pad_to(iface_local_l.get(p, np.zeros(0, np.int32)), NI,
+                 n_loc) for p in range(P)])
+    iface_slot = np.stack(
+        [_pad_to(iface_slot_l.get(p, np.zeros(0, np.int32)), NI,
+                 n_iface) for p in range(P)])
+    niface_local = np.stack(
+        [_pad_to(niface_local_l.get(p, np.zeros(0, np.int32)), NNI,
+                 n_node_loc) for p in range(P)])
+    niface_slot = np.stack(
+        [_pad_to(niface_slot_l.get(p, np.zeros(0, np.int32)), NNI,
+                 n_node_iface) for p in range(P)])
+
+    # ---- node-ELL scatter map fill ----------------------------------------
+    ell = None
+    if want_ell:
+        K = int(layout.K)
+        ell = np.full((P, n_node_loc, K), n_slots, dtype=np.int32)
+        for p in local:
             ids_s, slots_s, counts = seg_data[p]
-            ell_p = np.full((n_node_loc, K), n_slots, dtype=np.int32)
             off = np.concatenate([[0], np.cumsum(counts)])
             rank = np.arange(len(ids_s)) - off[ids_s]
-            ell_p[ids_s, rank] = slots_s
-            per_part_ell.append(ell_p)
-        ell = np.stack(per_part_ell)
+            ell[p][ids_s, rank] = slots_s
 
     # ---- padded interface-spring arrays -----------------------------------
     spr_a = spr_b = spr_k = None
     if have_springs:
-        per_part = [np.where(spr_part == p)[0] for p in range(P)]
-        NS = int(max((len(s) for s in per_part), default=0))
-        NS = max(int(-(-NS // pad_multiple) * pad_multiple), 1)
+        NS = layout.NS
         spr_a = np.full((P, NS), n_loc, dtype=np.int32)
         spr_b = np.full((P, NS), n_loc, dtype=np.int32)
         spr_k = np.zeros((P, NS))
-        for p in range(P):
-            s = per_part[p]
+        for p in local:
+            s = np.where(spr_part == p)[0]
             ns = len(s)
             if ns == 0:
                 continue
@@ -524,6 +864,8 @@ def partition_model(
         spr_a=spr_a,
         spr_b=spr_b,
         spr_k=spr_k,
+        layout=layout,
+        part_range=(lo, hi),
     )
 
 
@@ -558,12 +900,3 @@ def _csr_take(flat: np.ndarray, offset: np.ndarray, elems: np.ndarray) -> np.nda
     return flat[np.cumsum(out_idx)]
 
 
-def _shared_ids(gid_lists: List[np.ndarray], n_glob: int):
-    """Global ids present in >= 2 lists; returns (sorted ids, owner part)."""
-    count = np.zeros(n_glob, dtype=np.int32)
-    owner = np.full(n_glob, np.iinfo(np.int32).max, dtype=np.int32)
-    for p, g in enumerate(gid_lists):
-        count[g] += 1
-        owner[g] = np.minimum(owner[g], p)
-    shared = np.where(count >= 2)[0]
-    return shared, owner[shared]

@@ -65,10 +65,19 @@ class StructuredPartition:
     node_gid: np.ndarray        # (P, n_node_loc) int64
     ndof_p: np.ndarray          # (P,)
 
+    # Sharded setup (ISSUE 14): the slab range whose rows are populated;
+    # (0, n_parts) for a full build.  The layout here is analytic (every
+    # dimension derives from the grid), so there is no exchange — each
+    # process just fills its own slab rows.
+    part_range: Optional[tuple] = None
 
-def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
+
+def partition_structured(model: ModelData, n_parts: int,
+                         part_range=None) -> StructuredPartition:
     """Slab-partition a structured cube model (requires model.grid set and
-    nx % n_parts == 0)."""
+    nx % n_parts == 0).  With ``part_range=(lo, hi)`` only those slabs'
+    model-sized gathers (F/Ud/eff/ck/ce/gid maps) are materialized — the
+    sharded-setup fast path; rows outside the range stay zero/-1."""
     from pcg_mpi_solver_tpu.parallel.partition import BUILD_CALLS
 
     BUILD_CALLS["partition_structured"] += 1
@@ -81,6 +90,12 @@ def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
         raise ValueError("structured path expects the single-type cube library")
 
     P = n_parts
+    if part_range is None:
+        part_range = (0, P)
+    lo, hi = int(part_range[0]), int(part_range[1])
+    if not (0 <= lo < hi <= P):
+        raise ValueError(f"part_range {part_range} outside [0, {P})")
+    local = range(lo, hi)
     nxc = nx // P
     nxn = nxc + 1
     nny, nnz = ny + 1, nz + 1
@@ -90,9 +105,12 @@ def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
 
     # cell ck grid: global element id = ex + nx*(ey + ny*ez)  (x fastest)
     ck_glob = np.asarray(model.ck).reshape(nz, ny, nx).transpose(2, 1, 0)  # (nx,ny,nz)
-    ck = np.stack([ck_glob[p * nxc:(p + 1) * nxc] for p in range(P)])
+    ck = np.zeros((P, nxc, ny, nz))
+    ce = np.zeros((P, nxc, ny, nz))
     ce_glob = np.asarray(model.ce).reshape(nz, ny, nx).transpose(2, 1, 0)
-    ce = np.stack([ce_glob[p * nxc:(p + 1) * nxc] for p in range(P)])
+    for p in local:
+        ck[p] = ck_glob[p * nxc:(p + 1) * nxc]
+        ce[p] = ce_glob[p * nxc:(p + 1) * nxc]
 
     # local node (ix,iy,iz) [x-major local layout] -> global dof ids
     nnx = nx + 1
@@ -100,18 +118,20 @@ def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
     eff = np.zeros((P, n_loc))
     F = np.zeros((P, n_loc))
     Ud = np.zeros((P, n_loc))
-    dof_gid = np.zeros((P, n_loc), dtype=np.int64)
+    # -1 init so non-built rows of a sharded build read as padding for
+    # the owner masks (a full build overwrites every row — bit-identical)
+    dof_gid = np.full((P, n_loc), -1, dtype=np.int64)
 
     eff_mask_glob = np.zeros(model.n_dof, dtype=bool)
     eff_mask_glob[model.dof_eff] = True
 
     n_node_loc = nxn * nny * nnz
-    node_gid = np.zeros((P, n_node_loc), dtype=np.int64)
+    node_gid = np.full((P, n_node_loc), -1, dtype=np.int64)
     ix = np.arange(nxn)
     iy = np.arange(nny)
     iz = np.arange(nnz)
     IX, IY, IZ = np.meshgrid(ix, iy, iz, indexing="ij")
-    for p in range(P):
+    for p in local:
         gnode = (IX + p * nxc) + nnx * (IY + nny * IZ)          # (nxn,nny,nnz)
         node_gid[p] = gnode.reshape(-1)
         gdof = (3 * gnode[..., None] + np.arange(3)).transpose(3, 0, 1, 2)
@@ -154,6 +174,7 @@ def partition_structured(model: ModelData, n_parts: int) -> StructuredPartition:
         dof_gid=dof_gid,
         node_gid=node_gid,
         ndof_p=np.full(P, n_loc),
+        part_range=(lo, hi),
     )
 
 

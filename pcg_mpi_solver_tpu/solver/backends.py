@@ -8,6 +8,8 @@ dispatch-chunked machinery on top, driver.py:131-230)."""
 
 from __future__ import annotations
 
+import os
+
 from pcg_mpi_solver_tpu.models.model_data import ModelData
 from pcg_mpi_solver_tpu.ops.matvec import Ops, device_data
 from pcg_mpi_solver_tpu.parallel.mesh import PARTS_AXIS
@@ -33,6 +35,23 @@ def select_time_backend(model: ModelData, n_parts: int, *,
     if backend == "hybrid" and not can_hybrid(model):
         raise ValueError("hybrid backend requested but model has no "
                          "octree/brick metadata")
+    if backend == "auto" and can_hybrid(model) \
+            and os.environ.get("PCG_TPU_ENABLE_HYBRID") != "1":
+        # hybrid demotion gate (ISSUE 14; same policy as the quasi-static
+        # driver): AUTO selection needs the explicit opt-in — dry-runs
+        # put the hybrid partition at 117-183 s where structured takes
+        # 10.5 s, and its stencil compiles cost minutes per
+        # instantiation (RUNBOOK "Scaling the setup path").  Loud like
+        # the driver's note event — a silent reroute would make octree
+        # dynamics perf regressions undiagnosable.
+        import warnings
+
+        warnings.warn(
+            "model is hybrid-backend eligible but auto-selection is "
+            "gated (set PCG_TPU_ENABLE_HYBRID=1 or pass "
+            "backend='hybrid'); using the general backend — see "
+            "RUNBOOK 'Scaling the setup path'")
+        backend = "general"
     if backend in ("auto", "hybrid") and can_hybrid(model):
         from pcg_mpi_solver_tpu.parallel.hybrid import (
             HybridOps, device_data_hybrid, hybrid_pallas_enabled,

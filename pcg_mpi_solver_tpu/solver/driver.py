@@ -283,6 +283,10 @@ class Solver:
         if backend not in ("auto", "structured", "hybrid", "general"):
             raise ValueError(f"backend must be 'auto'|'structured'|'hybrid'|"
                              f"'general', got {backend!r}")
+        setup_shard = getattr(self.config, "setup_shard", "auto")
+        if setup_shard not in ("auto", "on", "off"):
+            raise ValueError(f"RunConfig.setup_shard must be "
+                             f"'auto'|'on'|'off', got {setup_shard!r}")
         # Kernel variant is FIXED at construction (the env knob is read at
         # trace time); the checkpoint fingerprint must record what this
         # solver actually compiled, not the env at save() time.
@@ -302,12 +306,76 @@ class Solver:
         if backend == "hybrid" and not can_hybrid:
             raise ValueError("hybrid backend requested but model has no "
                              "octree/brick metadata")
+        # Hybrid demotion gate (ISSUE 14 satellite): dry-runs put the
+        # hybrid partition build at 117-183 s where structured takes
+        # 10.5 s at the same scale (ROADMAP item 2), and its level-grid
+        # stencil compile costs minutes per instantiation — AUTO
+        # selection now requires the explicit PCG_TPU_ENABLE_HYBRID=1
+        # opt-in (docs/RUNBOOK.md "Scaling the setup path" carries the
+        # deprecation note).  An EXPLICIT backend="hybrid" request is
+        # honored unchanged — the gate only stops silent auto-routing.
+        hybrid_ok = os.environ.get("PCG_TPU_ENABLE_HYBRID") == "1"
         if backend in ("auto", "structured") and can_structured:
             self.backend = "structured"
-        elif backend in ("auto", "hybrid") and can_hybrid:
+        elif backend == "hybrid" and can_hybrid:
+            self.backend = "hybrid"
+        elif backend == "auto" and can_hybrid and hybrid_ok:
             self.backend = "hybrid"
         else:
+            if backend == "auto" and can_hybrid and not hybrid_ok:
+                self._rec.note(
+                    "model is hybrid-backend eligible but auto-selection "
+                    "is gated (set PCG_TPU_ENABLE_HYBRID=1 or pass "
+                    "backend='hybrid'); using the general backend — see "
+                    "RUNBOOK 'Scaling the setup path'")
             self.backend = "general"
+
+        # ---- sharded setup (ISSUE 14): under multi-process
+        # jax.distributed, build/load only THIS process's parts of the
+        # partition (the general/structured builders take part_range; the
+        # global layout merges via HostComm reductions) — the cold path
+        # then scales with process count instead of model size.  The
+        # hybrid backend keeps the monolithic build (level grids are not
+        # part-sharded).
+        from pcg_mpi_solver_tpu.parallel.distributed import (
+            HostComm, local_part_range)
+
+        self._setup_range = None
+        self._setup_comm = None
+        self.partition_build_s = 0.0
+        if (setup_shard != "off" and jax.process_count() > 1
+                and self.backend in ("general", "structured")):
+            rng = local_part_range(self.mesh, n_parts)
+            # equal contiguous slabs only: the glue exchange allgathers
+            # same-shaped blocks from every process
+            ok = (rng is not None and rng != (0, n_parts)
+                  and (rng[1] - rng[0]) * jax.process_count() == n_parts)
+            # The engage decision GATES collective code paths (warmup,
+            # the layout exchange, the glue allgathers) — it must be
+            # GROUP-AGREED: an exotic device order can make one
+            # process's parts non-contiguous while another's pass, and
+            # a split decision deadlocks the group on its first
+            # unmatched collective.  Every process reaches this reduce
+            # (the inputs above are process-invariant).
+            comm = HostComm()
+            (agreed,), = comm.allreduce_groups(
+                [([np.asarray([int(ok)], dtype=np.int64)], "min")])
+            if bool(int(agreed[0])):
+                self._setup_range = rng
+                self._setup_comm = comm
+                from pcg_mpi_solver_tpu.parallel.partition import (
+                    layout_exchange_sizes)
+
+                with self._rec.span("setup_comm_warmup"):
+                    self._setup_comm.warmup(layout_exchange_sizes(
+                        model.n_dof, model.n_node,
+                        len(model.elem_lib), n_parts))
+            elif setup_shard == "on":
+                raise ValueError(
+                    "RunConfig.setup_shard='on' but some process's parts "
+                    "are not one contiguous equal block of the mesh (use "
+                    "make_global_mesh, or n_parts divisible by the "
+                    "device count)")
 
         interp = solver_cfg.pallas == "interpret"
         if self.backend == "structured":
@@ -315,8 +383,10 @@ class Solver:
                 StructuredOps, device_data_structured, partition_structured)
 
             self.pm = self._partition_cached(
-                "structured", lambda: partition_structured(model, n_parts),
-                n_parts=n_parts)
+                "structured",
+                lambda part_range=None: partition_structured(
+                    model, n_parts, part_range=part_range),
+                n_parts=n_parts, shard=True)
             sp = self.pm
             use_pallas = _pallas_enabled(
                 solver_cfg.pallas, self.mesh,
@@ -441,18 +511,38 @@ class Solver:
                                    axis_name=PARTS_AXIS),
                     rdata)
         else:
+            method = self.config.partition_method
+            extra = {}
+            if method == "slab2":
+                # two-level split: the coarse slab count is structural
+                # (a different count = a different partition) — one slab
+                # per process so each process refines only its own slab.
+                # A function of the PROCESS TOPOLOGY alone, never of
+                # whether sharding engaged: toggling setup_shard (a
+                # TRACE_NEUTRAL_RUNCONFIG field) must not change the
+                # element partition.
+                extra["slab2_slabs"] = jax.process_count()
             self.pm = self._partition_cached(
                 "general",
-                lambda: partition_model(
-                    model, n_parts, elem_part=elem_part,
-                    method=self.config.partition_method),
-                n_parts=n_parts, method=self.config.partition_method,
-                elem_part=elem_part)
+                lambda part_range=None: partition_model(
+                    model, n_parts, elem_part=elem_part, method=method,
+                    part_range=part_range, comm=self._setup_comm,
+                    slab2_slabs=extra.get("slab2_slabs", 1)),
+                n_parts=n_parts, method=method,
+                elem_part=elem_part, extra=extra, shard=True)
             self.ops = Ops.from_model(self.pm, dot_dtype=dot_dtype,
                                       axis_name=PARTS_AXIS)
             data = device_data(self.pm, dtype)
             ops32_factory = lambda: Ops.from_model(
                 self.pm, dot_dtype=jnp.float32, axis_name=PARTS_AXIS)
+
+        if self._setup_range is not None:
+            # Sharded setup: re-assemble the small host-side EXPORT GLUE
+            # (owner masks + global id maps — gather_owned_global,
+            # solve_many staging, export maps read ALL parts' rows) from
+            # every process's slab.  The heavy per-part structures stay
+            # local; this is O(P * n_loc) ids, not O(model).
+            self._exchange_export_glue(self.pm)
 
         # ---- MG hierarchy (precond="mg" — ops/mg.py): host-built level
         # lattice + transfers into the device data tree, the Chebyshev
@@ -472,10 +562,7 @@ class Solver:
 
             t_mg0 = time.perf_counter()
             with self._rec.span("mg_setup"):
-                mg_setup = mgmod.build_mg_host(
-                    model, self.pm,
-                    n_levels=int(solver_cfg.mg_levels),
-                    degree=int(solver_cfg.mg_smooth_degree))
+                mg_setup = self._build_mg_cached(model, solver_cfg)
             # float leaves at the STORAGE dtype (mgmod.cast_tree); the
             # mixed shadow below re-derives its f32 copy
             data["mg"] = mgmod.cast_tree(mg_setup.tree, dtype)
@@ -739,8 +826,67 @@ class Solver:
                             else "warm" if hits and not miss else "cold")
         self._rec.gauge("setup_s", round(self.setup_s, 3))
         self._rec.gauge("setup.cache", self.setup_cache)
+        self._rec.gauge("setup.partition_build_s",
+                        round(self.partition_build_s, 3))
+        if self._setup_range is not None:
+            # setup-phase shard attribution (ISSUE 14): which parts this
+            # process built/loaded and whether the partition came warm —
+            # the flight-recorder-grade record the setup ladder and the
+            # sharded-warm-start tests read
+            self._rec.event(
+                "setup_shard", parts=list(self._setup_range),
+                n_parts=int(self.pm.n_parts),
+                cold=self.setup_cache != "warm",
+                partition_build_s=round(self.partition_build_s, 6),
+                setup_s=round(self.setup_s, 6))
 
     # ------------------------------------------------------------------
+    def _exchange_export_glue(self, pm):
+        """Sharded setup: allgather the per-part export-glue rows (owner
+        weights + global id maps) so host-side global views
+        (gather_owned_global, owner_mask, solve_many staging) keep their
+        all-parts contract while everything heavy stays per-process.
+        Each process contributes exactly its built slab; slabs tile
+        [0, n_parts) by construction (local_part_range).  Packed into
+        TWO collectives (one int64 buffer for ranges+id maps, one
+        float64 for the weights) — each allgather costs a dispatch and
+        a per-shape compile, and this runs on EVERY sharded
+        construction, warm starts included."""
+        from jax.experimental import multihost_utils as mh
+
+        lo, hi = self._setup_range
+        names = ("dof_gid", "node_gid", "weight", "node_weight")
+        arrs = {n: np.asarray(getattr(pm, n)) for n in names
+                if getattr(pm, n, None) is not None}
+        ints = np.concatenate(
+            [np.asarray([lo, hi], dtype=np.int64)]
+            + [arrs[n][lo:hi].ravel().astype(np.int64)
+               for n in ("dof_gid", "node_gid") if n in arrs])
+        flts = np.concatenate(
+            [arrs[n][lo:hi].ravel().astype(np.float64)
+             for n in ("weight", "node_weight") if n in arrs]
+            or [np.zeros(0)])
+        g_int = np.asarray(mh.process_allgather(ints))
+        g_flt = np.asarray(mh.process_allgather(flts))
+        for proc in range(g_int.shape[0]):
+            l, h = int(g_int[proc, 0]), int(g_int[proc, 1])
+            pos_i, pos_f = 2, 0
+            for n in names:
+                if n not in arrs:
+                    continue
+                full = arrs[n]
+                rows = (h - l,) + full.shape[1:]
+                cnt = int(np.prod(rows))
+                if n in ("dof_gid", "node_gid"):
+                    blk = g_int[proc, pos_i:pos_i + cnt]
+                    pos_i += cnt
+                else:
+                    blk = g_flt[proc, pos_f:pos_f + cnt]
+                    pos_f += cnt
+                full[l:h] = blk.reshape(rows).astype(full.dtype)
+        for n, full in arrs.items():
+            setattr(pm, n, full)
+
     def _make_prec(self, ops, d):
         """Preconditioner inverse per config.solver.precond: scalar Jacobi
         (P, n_loc), 3x3 node-block Jacobi (P, n_node_loc, 3, 3), or the
@@ -749,6 +895,53 @@ class Solver:
         from pcg_mpi_solver_tpu.ops.precond import make_prec
 
         return make_prec(ops, d, self.config.solver.precond)
+
+    def _build_mg_cached(self, model, scfg):
+        """Host MG hierarchy build, served from the SHARD-ADDRESSED
+        partition cache when a cache dir is set (ISSUE 14): the
+        replicated coarse hierarchy + meta live in one glue entry, the
+        parts-sharded fine transfer arrays in per-part entries — a warm
+        start (or an N-host warm start, each host its own parts) skips
+        the whole host-side rediscretization.  The structural knobs
+        (levels/degree/replication cutoff) key every entry."""
+        from pcg_mpi_solver_tpu.ops import mg as mgmod
+
+        def build():
+            return mgmod.build_mg_host(
+                model, self.pm,
+                n_levels=int(scfg.mg_levels),
+                degree=int(scfg.mg_smooth_degree),
+                max_replicated_dofs=int(scfg.mg_max_replicated_dofs))
+
+        if not self._cache_dir:
+            return build()
+        from pcg_mpi_solver_tpu.cache import keys as ckeys
+        from pcg_mpi_solver_tpu.cache.partition_cache import (
+            cached_partition_shards)
+        from pcg_mpi_solver_tpu.cache.shards import join_mg, split_mg
+
+        rng = self._setup_range or (0, int(self.pm.n_parts))
+        key_kw = dict(
+            n_parts=int(self.pm.n_parts), backend=f"mg-{self.backend}",
+            dtype=str(np.dtype(self.dtype)),
+            extra={"levels": int(scfg.mg_levels),
+                   "degree": int(scfg.mg_smooth_degree),
+                   "max_replicated_dofs":
+                       int(scfg.mg_max_replicated_dofs),
+                   # the fine transfers are laid out in the PARTITION's
+                   # node order — hierarchies built against different
+                   # partitions of the same model must never collide
+                   "partition": getattr(self, "_partition_cache_id",
+                                        None)})
+        part_keys = {p: ckeys.partition_shard_key(
+            self._model_fp, part_idx=p, **key_kw)
+            for p in range(rng[0], rng[1])}
+        return cached_partition_shards(
+            self._cache_dir,
+            glue_key=ckeys.partition_glue_key(self._model_fp, **key_kw),
+            part_keys=part_keys, builder=build,
+            split=lambda s: split_mg(s, rng), join=join_mg,
+            comm=self._setup_comm, recorder=self._rec, label="mg")
 
     def _prec_operand_spec(self):
         """shard_map PartitionSpec (pytree) of the preconditioner
@@ -808,7 +1001,8 @@ class Solver:
     # Warm-path subsystem (cache/): partition cache, AOT step, warmup
     # ------------------------------------------------------------------
     def _partition_cached(self, backend_label, builder, *, n_parts,
-                          method="n/a", elem_part=None, extra=None):
+                          method="n/a", elem_part=None, extra=None,
+                          shard=False):
         """Serve a partition from the content-addressed cache (cache/),
         falling through to ``builder`` on a miss.  The key covers the
         model content (fingerprint), n_parts, backend, dtype, the
@@ -816,11 +1010,29 @@ class Solver:
         partitioner is actually available), an explicit elem_part array's
         hash, and backend-specific layout knobs — plus the cache schema
         and package version (cache/keys.py), so a code bump invalidates
-        rather than deserializing stale layouts."""
+        rather than deserializing stale layouts.
+
+        ``shard=True`` (the general/structured backends, ISSUE 14) routes
+        through the SHARD-ADDRESSED store: per-part entries + one glue
+        entry, so on a warm start each process reads only its own parts'
+        entries; the monolithic key stays as the legacy-entry shim.
+        ``builder`` then takes ``part_range=`` (None = full build).
+        Cold builds are timed into ``self.partition_build_s`` under the
+        ``partition_build`` span — the setup ladder's attribution."""
+        part_range = self._setup_range if shard else None
+
+        def timed_build(part_range=part_range):
+            t0 = time.perf_counter()
+            with self._rec.span("partition_build"):
+                pm = builder(part_range=part_range) if shard else builder()
+            self.partition_build_s += time.perf_counter() - t0
+            return pm
+
         if not self._cache_dir:
-            return builder()
+            return timed_build()
         from pcg_mpi_solver_tpu.cache import keys as ckeys
-        from pcg_mpi_solver_tpu.cache.partition_cache import cached_partition
+        from pcg_mpi_solver_tpu.cache.partition_cache import (
+            cached_partition, cached_partition_shards)
 
         extra = dict(extra or {})
         if method == "auto" and elem_part is None:
@@ -829,14 +1041,35 @@ class Solver:
             from pcg_mpi_solver_tpu import native
 
             extra["native"] = bool(native.available())
-        key = ckeys.partition_cache_key(
-            self._model_fp, n_parts=int(n_parts), backend=backend_label,
+        key_kw = dict(
+            n_parts=int(n_parts), backend=backend_label,
             dtype=str(np.dtype(self.dtype)), method=method,
             elem_part_hash=(ckeys.array_hash(elem_part)
                             if elem_part is not None else None),
             extra=extra)
-        return cached_partition(self._cache_dir, key, builder,
-                                recorder=self._rec, label=backend_label)
+        legacy_key = ckeys.partition_cache_key(self._model_fp, **key_kw)
+        # partition identity for DERIVED per-shard entries (the MG
+        # hierarchy): its fine-transfer arrays are laid out in THIS
+        # partition's node order, so anything cached against it must
+        # re-key when the partition does (method/elem_part/knobs)
+        self._partition_cache_id = legacy_key
+        if not shard:
+            return cached_partition(self._cache_dir, legacy_key,
+                                    timed_build, recorder=self._rec,
+                                    label=backend_label)
+        from pcg_mpi_solver_tpu.cache.shards import (
+            join_partition, split_partition)
+
+        lo, hi = part_range if part_range is not None else (0, n_parts)
+        part_keys = {p: ckeys.partition_shard_key(
+            self._model_fp, part_idx=p, **key_kw) for p in range(lo, hi)}
+        return cached_partition_shards(
+            self._cache_dir,
+            glue_key=ckeys.partition_glue_key(self._model_fp, **key_kw),
+            part_keys=part_keys, builder=timed_build,
+            split=split_partition, join=join_partition,
+            legacy_key=legacy_key, comm=self._setup_comm,
+            recorder=self._rec, label=backend_label)
 
     def _build_aot_step(self, shard_step, donate_step):
         """AOT-export path for the one-shot step program: deserialize the

@@ -241,7 +241,9 @@ class NewmarkSolver:
             with self._rec.span("mg_setup"):
                 mg_setup = mgmod.build_mg_host(
                     model, self.pm, n_levels=int(scfg.mg_levels),
-                    degree=int(scfg.mg_smooth_degree))
+                    degree=int(scfg.mg_smooth_degree),
+                    max_replicated_dofs=int(
+                        scfg.mg_max_replicated_dofs))
             self._mg_meta = mg_setup.meta
             self._mg_setup = (mg_setup, time.perf_counter() - t_mg0)
         data = mk_data(dtype)

@@ -317,6 +317,47 @@ def _check_mg_hierarchy(model, scfg) -> CheckResult:
     return CheckResult("mg_hierarchy", "ok")
 
 
+def _check_mg_replication(model, scfg) -> CheckResult:
+    """MG replication scale audit (ISSUE 14): every coarse level is
+    REPLICATED on every device (PR 9's zero-collective-coarse-cycle
+    design), so the planned hierarchy's replicated dof total must fit
+    the ``SolverConfig.mg_max_replicated_dofs`` cutoff.  Mirrors the
+    named reasons ``ops/mg.apply_replication_cutoff`` raises — here the
+    arithmetic runs BEFORE any partition build, and a hierarchy the
+    cutoff will silently TRUNCATE (auto depth) warns so the shallower-
+    than-expected cycle is no surprise."""
+    if getattr(scfg, "precond", "jacobi") != "mg":
+        return CheckResult("mg_replication", "ok")
+    cap = int(getattr(scfg, "mg_max_replicated_dofs", 0))
+    if cap <= 0:
+        return CheckResult("mg_replication", "ok")
+    from pcg_mpi_solver_tpu.ops.mg import (
+        MGSetupError, apply_replication_cutoff, fine_lattice,
+        level_replicated_dofs, plan_levels)
+
+    dims, _lat = fine_lattice(model)
+    if dims is None:
+        return CheckResult("mg_replication", "ok")   # mg_hierarchy fails
+    n_levels = int(getattr(scfg, "mg_levels", 0))
+    try:
+        planned = plan_levels(dims, n_levels)
+    except MGSetupError:
+        return CheckResult("mg_replication", "ok")   # mg_hierarchy fails
+    try:
+        kept = apply_replication_cutoff(planned, n_levels, cap)
+    except MGSetupError as e:
+        return CheckResult("mg_replication", "fail", str(e))
+    if len(kept) < len(planned):
+        total = sum(level_replicated_dofs(planned))
+        return CheckResult(
+            "mg_replication", "warn",
+            f"mg hierarchy will be truncated from {len(planned)} to "
+            f"{len(kept)} coarse level(s): the full hierarchy needs "
+            f"{total} replicated dofs per device, over the "
+            f"mg_max_replicated_dofs={cap} cutoff")
+    return CheckResult("mg_replication", "ok")
+
+
 def check_mg_interval(lmin: float, lmax: float) -> CheckResult:
     """Degenerate Chebyshev interval diagnostic for the MG smoother
     (ISSUE 10 satellite): the setup-time eigenvalue estimates
@@ -439,6 +480,7 @@ def preflight_checks(model, config=None,
         results.append(_check_tol_floor(scfg))
         results.append(_check_snapshot_cadence(config, context))
         results.append(_check_mg_hierarchy(model, scfg))
+        results.append(_check_mg_replication(model, scfg))
     if (context or {}).get("kind") == "dynamics":
         results.append(_check_explicit_dt(model, context))
     return results

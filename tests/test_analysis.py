@@ -492,7 +492,7 @@ def test_rule_catalog_complete():
     expected = {"collective-budget", "hot-loop-purity", "dtype-discipline",
                 "donation-integrity", "fingerprint-completeness",
                 "recovery-paths", "recovery-coverage", "telemetry-schema",
-                "cost-model-completeness"}
+                "cost-model-completeness", "partition-key-components"}
     assert expected <= set(rules)
     assert len(expected) >= 5
     # the pre-hardware-window gate covers the structural claims
@@ -500,6 +500,7 @@ def test_rule_catalog_complete():
     assert rules["recovery-paths"].fast
     assert rules["recovery-coverage"].fast
     assert rules["cost-model-completeness"].fast
+    assert rules["partition-key-components"].fast
     assert not rules["fingerprint-completeness"].fast
 
 

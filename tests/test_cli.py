@@ -73,7 +73,8 @@ def test_cli_octree_demo(tmp_path, capsys):
     assert "flag=0" in out and ">success!" in out
 
 
-def test_cli_solve_backend_flag(tmp_path, capsys):
+def test_cli_solve_backend_flag(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("PCG_TPU_ENABLE_HYBRID", "1")   # auto->hybrid gate
     from pcg_mpi_solver_tpu.models.octree import make_octree_model
 
     model = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3)

@@ -120,6 +120,38 @@ def test_priority_queue_step_order_has_pipelined_after_fused(tmp_path,
     assert "matvec A/B v9" in names
 
 
+def test_priority_queue_setup_ladder_after_lint_before_variants(
+        tmp_path, monkeypatch):
+    """ISSUE 14: the setup-ladder leg runs AFTER the lints (a broken
+    structural claim aborts first), BEFORE the variant A/Bs, on CPU,
+    sharing the warm cache dir, and writes the SETUP_LADDER.json
+    artifact."""
+    from tools import hw_session
+
+    steps = []
+
+    def fake_run_step(path, name, argv, env_extra=None, **kw):
+        steps.append((name, dict(env_extra or {})))
+        return "rc=0"
+
+    monkeypatch.setattr(hw_session, "run_step", fake_run_step)
+    hw_session.run_priority_queue(str(tmp_path / "log.txt"), quick=True)
+
+    names = [n for n, _ in steps]
+    i_lint = names.index("contract lint (step 0)")
+    i_ladder = names.index("setup ladder")
+    i_c = names.index("flagship classic")
+    assert i_lint < i_ladder < i_c, names
+    env = dict(steps)["setup ladder"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["BENCH_SETUP_LADDER"]
+    assert env["BENCH_SETUP_OUT"].endswith("SETUP_LADDER.json")
+    # shares the variant legs' warm cache dir (the A/B steps inherit
+    # whatever the ladder already warmed)
+    assert env["BENCH_CACHE_DIR"] == \
+        dict(steps)["flagship classic"]["BENCH_CACHE_DIR"]
+
+
 def test_priority_queue_aborts_on_lint_failure(tmp_path, monkeypatch):
     """A FAILED step-0 lint must abort before any hardware step — the
     pipelined leg's overlap claim is exactly what the lint proves, so

@@ -61,7 +61,8 @@ def test_ingest_archive(tmp_path):
     m2 = read_mdf(mdf)
     assert m2.n_elem == model.n_elem
 
-def test_mdf_roundtrip_fastpath_sidecars(tmp_path):
+def test_mdf_roundtrip_fastpath_sidecars(tmp_path, monkeypatch):
+    monkeypatch.setenv("PCG_TPU_ENABLE_HYBRID", "1")   # auto->hybrid gate
     """grid/octree metadata survives the MDF round trip, so re-ingested
     models keep their structured/hybrid backend eligibility."""
     from pcg_mpi_solver_tpu.models.octree import make_octree_model

@@ -129,7 +129,8 @@ def test_newmark_modes(mode, precond):
                                atol=1e-7 * np.abs(u_ref).max())
 
 
-def test_newmark_hybrid_octree():
+def test_newmark_hybrid_octree(monkeypatch):
+    monkeypatch.setenv("PCG_TPU_ENABLE_HYBRID", "1")   # auto->hybrid gate
     model = make_octree_model(2, 2, 2, max_level=2, n_incl=2, seed=3,
                               load="traction", load_value=1.0)
     dt = 0.1

@@ -333,6 +333,23 @@ def run_priority_queue(path, quick: bool):
     # HERE, not silently inherited from bench.py's default
     cache = {"BENCH_CACHE_DIR": os.path.join(REPO, ".pcg_cache")}
     size = {"BENCH_NX": "24" if quick else "150"}
+    # Setup ladder (ISSUE 14): the weak-scaling cold-path measurement —
+    # sharded partition build vs the monolithic serial build, streamed
+    # slab-ingest peak memory, shard-cache warm/cold deltas — runs on
+    # CPU (jax.distributed child groups; it never touches the
+    # accelerator grant) BEFORE the variant A/Bs.  It scratches inside
+    # BENCH_CACHE_DIR but in an isolated per-run subdir it deletes on
+    # exit (its rungs must COLD-build to measure honestly), so it
+    # neither pollutes nor pre-warms the later legs' entries.
+    # Artifact: SETUP_LADDER.json in the repo (BENCH-schema rungs).
+    run_step(path, "setup ladder", ["bench.py"],
+             env_extra=dict(cache,
+                            BENCH_SETUP_LADDER="1,2" if quick else "1,2,4",
+                            BENCH_SETUP_NX="12" if quick else "40",
+                            BENCH_SETUP_OUT=os.path.join(
+                                REPO, "SETUP_LADDER.json"),
+                            JAX_PLATFORMS="cpu"),
+             timeout=1800, gate_s=0)
     run_step(path, "flagship classic", ["bench.py"],
              env_extra=dict(cache, **size), timeout=3600)
     run_step(path, "flagship fused", ["bench.py"],
@@ -414,8 +431,12 @@ def main():
                              "BENCH_DTYPE": "float64"},
                             **({"BENCH_NX": nx} if args.quick else {})),
              timeout=3600)
+    # hybrid auto-selection is deprecation-gated (ISSUE 14; RUNBOOK
+    # "Scaling the setup path") — this step measures it DELIBERATELY
     run_step(path, "octree flagship (hybrid)", ["bench.py"],
-             env_extra=dict({"BENCH_MODEL": "octree"}, **ot), timeout=4800)
+             env_extra=dict({"BENCH_MODEL": "octree",
+                             "PCG_TPU_ENABLE_HYBRID": "1"}, **ot),
+             timeout=4800)
     run_step(path, "iteration breakdown",
              ["examples/bench_iter_breakdown.py", nx], timeout=1800)
     run_step(path, "hybrid per-level breakdown",
