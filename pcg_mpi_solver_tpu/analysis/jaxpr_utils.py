@@ -323,3 +323,31 @@ def loop_body_primitives(closed_jaxpr, names) -> List[str]:
             if e.primitive.name in names:
                 hits.add(e.primitive.name)
     return sorted(hits)
+
+
+def scope_labels(closed_jaxpr, prefix: str = "pcg/") -> dict:
+    """{label: eqn count} of every ``<prefix><word>`` jax.named_scope
+    label in the program's equation name stacks, recursing into every
+    nested sub-jaxpr (while bodies, cond branches, pjit calls).
+
+    The name stack is trace-time metadata (``eqn.source_info``) — the
+    same string that lands in the compiled module's ``op_name`` HLO
+    metadata and, from there, in profiler-trace events; reading it here
+    proves the scope-labels the trace consumer (obs/profview.py)
+    buckets on actually exist in the traced hot loop.  An equation with
+    no readable name stack simply contributes nothing (the walker is
+    tolerant of jax-internal representation changes — the RULE then
+    fails on a missing label, loudly, rather than crashing here)."""
+    import re as _re
+
+    pat = _re.compile(_re.escape(prefix) + r"([A-Za-z0-9_]+)")
+    out: dict = {}
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        try:
+            stack = str(eqn.source_info.name_stack)
+        except Exception:                               # noqa: BLE001
+            continue
+        for m in pat.finditer(stack):
+            label = prefix + m.group(1)
+            out[label] = out.get(label, 0) + 1
+    return out

@@ -145,6 +145,107 @@ def psum_overlap_rule(ctx) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# scope-labels: the trace-attribution named scopes exist in every hot loop
+# ---------------------------------------------------------------------------
+
+def check_scope_labels(prog, phase_scopes=None) -> List[Finding]:
+    """Every phase label ``obs/profview.py`` buckets trace events on
+    (PHASE_SCOPES: pcg/matvec, pcg/precond, pcg/reduce, pcg/axpy) must
+    appear in the traced program of EVERY variant, scalar AND blocked —
+    a loop body that lost its ``jax.named_scope`` would silently move
+    its device-op time into the report's 'other' bucket and the
+    hardware attribution table would stop explaining the iteration.
+    ``phase_scopes`` is the seeded-violation test hook."""
+    from pcg_mpi_solver_tpu.analysis import jaxpr_utils as ju
+    from pcg_mpi_solver_tpu.obs.profview import PHASE_SCOPES
+
+    scopes = phase_scopes if phase_scopes is not None else PHASE_SCOPES
+    found = ju.scope_labels(prog.jaxpr)
+    out = []
+    for label in scopes:
+        if not found.get(label):
+            out.append(Finding(
+                rule="scope-labels", loc=f"program:{prog.name}",
+                message=f"named-scope label {label!r} is absent from "
+                        "the traced program: its phase's device-op "
+                        "time would bucket as 'other' in every parsed "
+                        "trace (obs/profview.py) — re-thread "
+                        "jax.named_scope through the loop body "
+                        f"(labels found: {sorted(found)})"))
+    return out
+
+
+def check_unknown_label_loudness(bucket_fn=None) -> List[Finding]:
+    """The parser-side half of the contract: a device op matching NO
+    phase must be COUNTED (other_events/other_ms), and a ``pcg/<x>``
+    label outside the known four must land in ``unknown_scopes`` on
+    BOTH arrival paths — TPU event-text metadata AND the CPU sidecar
+    scope map — never silently dropped.  Probed on synthetic events
+    through the REAL bucketing code (``bucket_fn`` is the
+    seeded-violation hook)."""
+    from pcg_mpi_solver_tpu.obs import profview
+
+    fn = bucket_fn if bucket_fn is not None else profview.bucket_phases
+    ops = [
+        {"name": "mystery_fusion.9", "base": "mystery_fusion",
+         "ts": 0.0, "dur": 5.0, "pid": 1, "tid": 1, "text": ""},
+        {"name": "dot.1", "base": "dot", "ts": 10.0, "dur": 7.0,
+         "pid": 1, "tid": 1, "text": "jit(f)/pcg/notaphase/dot_general"},
+        # the CPU flavor: a bare instruction name whose ONLY route to a
+        # label is the compiled-HLO sidecar map
+        {"name": "ghost.1", "base": "ghost", "ts": 20.0, "dur": 3.0,
+         "pid": 1, "tid": 1, "text": ""},
+    ]
+    smap = profview.scope_map_from_hlo_text(
+        '%ghost.1 = f32[2]{0} add(...), '
+        'metadata={op_name="jit(f)/pcg/ghostphase/add"}')
+    out = []
+    try:
+        b = fn(list(ops), smap)
+    except Exception as e:                              # noqa: BLE001
+        return [Finding(
+            rule="scope-labels", loc="probe:unknown-label",
+            message=f"bucket_phases crashed on an unbucketable event "
+                    f"({type(e).__name__}: {e}) — the tolerant-parse "
+                    "contract demands counting, not crashing")]
+    total_bucketed = sum(d["us"] for d in b["phases"].values()) \
+        + b["other_us"]
+    if b["other_events"] < 1 or total_bucketed < 15.0 - 1e-9:
+        out.append(Finding(
+            rule="scope-labels", loc="probe:unknown-label",
+            message=f"bucket_phases DROPPED unbucketable device-op "
+                    f"time (other_events={b['other_events']}, "
+                    f"bucketed {total_bucketed} of 15.0 us): time that "
+                    "matches no phase must be counted and reported, "
+                    "never vanish from the attribution table"))
+    if (b["unknown_scopes"].get("notaphase", 0) != 1
+            or b["unknown_scopes"].get("ghostphase", 0) != 1):
+        out.append(Finding(
+            rule="scope-labels", loc="probe:unknown-label",
+            message="a pcg/<x> label outside the known phase set was "
+                    f"not counted into unknown_scopes (got "
+                    f"{b['unknown_scopes']}; expected notaphase=1 via "
+                    "event text AND ghostphase=1 via the sidecar scope "
+                    "map) — a future phase label would silently "
+                    "disappear from parsed traces instead of being "
+                    "reported as unknown"))
+    return out
+
+
+@rule("scope-labels", kind="jaxpr", fast=True,
+      doc="every pcg/* named-scope label the trace consumer "
+          "(obs/profview.py) buckets on appears in the traced hot loop "
+          "of every variant (scalar + blocked), and the parser counts "
+          "+ reports unknown labels instead of dropping them")
+def scope_labels_rule(ctx) -> List[Finding]:
+    out = []
+    for prog in ctx.programs():
+        out.extend(check_scope_labels(prog))
+    out.extend(check_unknown_label_loudness())
+    return out
+
+
+# ---------------------------------------------------------------------------
 # hot-loop-purity: no host callbacks, no oversized folded constants
 # ---------------------------------------------------------------------------
 

@@ -16,6 +16,8 @@ orchestrated by examples/run_basic_script.bash) as one typed CLI.
     pcg-tpu cache-stats [--cache-dir D]                # warm-path cache table
     pcg-tpu lint      [--fast] [--json F]              # contract lint (analysis/)
     pcg-tpu perf-report [--nx N | scratch]             # measured-vs-model phases
+    pcg-tpu prof-report <trace-artifact>               # parse a captured device trace
+    pcg-tpu trend     [BENCH_r*.json ...]              # bench-trend regression sentinel
     pcg-tpu summary   <run.jsonl> [...]                # offline telemetry summary
     pcg-tpu telemetry-merge <run.jsonl> --out M.jsonl  # merge per-process shards
 
@@ -750,6 +752,30 @@ def cmd_perf_report(args):
             cm = None
     probe = run_phase_probe(s, reps=args.reps, nrhs=nrhs,
                             inner=args.inner)
+    trace_rep = None
+    if getattr(args, "profile_dir", None):
+        # ISSUE 15: the MEASURED column — capture a device trace of one
+        # warm solve on this same solver and parse it back
+        # (obs/profview.py); capture trouble degrades to the
+        # predicted|recorded table, never a crash.
+        from pcg_mpi_solver_tpu.obs import profview
+
+        try:
+            cap = profview.capture_solve_profile(
+                s, args.profile_dir, nrhs=nrhs, recorder=s.recorder)
+            trace_rep = profview.profile_report(cap["artifact"])
+            profview.emit_prof_report(s.recorder, trace_rep)
+        except Exception as e:                          # noqa: BLE001
+            print(f">device-trace capture failed ({type(e).__name__}: "
+                  f"{e}) — the predicted|recorded table below stands")
+    if trace_rep is not None:
+        # predicted | recorded | measured: the cost model next to the
+        # compiled phase probes next to the parsed-trace attribution
+        print()
+        print(profview.format_report(trace_rep, predicted=cm,
+                                     recorded=probe["phases"]))
+        _finish_telemetry(s, args)
+        return
     print()
     print(f"{'phase':<10} {'model_ms':>10} {'measured_ms':>12} "
           f"{'share':>7}")
@@ -777,6 +803,67 @@ def cmd_perf_report(args):
                   f"(predicted {cm['predicted_ms_per_iter']:.4f} ms/iter, "
                   f"profile={cm['profile']})")
     _finish_telemetry(s, args)
+
+
+def cmd_prof_report(args):
+    """Offline device-trace report (ISSUE 15, obs/profview.py): parse a
+    captured profiler artifact — the trace-viewer JSON(.gz) itself, its
+    run dir, or any capture root — into per-phase attribution, the
+    measured collective-overlap fraction, and the tolerant reader's
+    verdict.  Works on any artifact, chiplessly: truncated files and
+    missing device lanes degrade to a NAMED verdict, never a crash.
+    When the capture sidecar (profview_meta.json) is present, the
+    obs/perf.py cost model is rebuilt from it for the predicted
+    column.  jax is never imported — a dead-tunnel post-mortem must
+    not wait on an accelerator runtime."""
+    from pcg_mpi_solver_tpu.obs import profview
+
+    # resolve the artifact and its sidecar ONCE, then hand both to the
+    # parser (profile_report short-circuits on a direct file path)
+    files = profview.find_trace_files(args.path)
+    meta = profview.load_meta(files[0]) if files else None
+    rep = profview.profile_report(files[0] if files else args.path,
+                                  meta=meta, iters=args.iters)
+    predicted = None
+    try:
+        predicted = profview.predicted_from_meta(meta or {})
+    except KeyError as e:
+        print(f">predicted column unavailable: unknown name {e} in the "
+              "capture sidecar (name tables out of sync?)")
+    if meta:
+        print(f">profile: {meta.get('pcg_variant')} variant, "
+              f"{meta.get('precond')} precond, nrhs={meta.get('nrhs')}, "
+              f"{meta.get('backend')} backend, "
+              f"{meta.get('n_dof')} dofs on "
+              f"{meta.get('n_devices')} device(s) "
+              f"[{meta.get('platform')}]")
+    print(profview.format_report(rep, predicted=predicted))
+    if args.telemetry_out:
+        from pcg_mpi_solver_tpu.obs.metrics import (
+            JsonlSink, MetricsRecorder)
+
+        rec = MetricsRecorder(sinks=[JsonlSink(args.telemetry_out)])
+        profview.emit_prof_report(rec, rep)
+        rec.close()
+        print(f">telemetry: {args.telemetry_out}")
+    if not files:
+        raise SystemExit(2)
+
+
+def cmd_trend(args):
+    """Bench-trend regression sentinel (ISSUE 15, obs/trend.py): match
+    legs across the committed BENCH_r*.json round artifacts (plus an
+    optional fresh artifact) by shape/variant/precond/nrhs and print
+    per-leg deltas with threshold verdicts.  Exit 1 = at least one
+    matched leg REGRESSED; exit 2 = nothing to compare."""
+    from pcg_mpi_solver_tpu.obs import trend
+
+    thr = (args.threshold if args.threshold is not None
+           else trend.DEFAULT_THRESHOLD)
+    rc = trend.main_cli(list(args.artifacts), fresh=args.fresh,
+                        threshold=thr)
+    if rc:
+        raise SystemExit(rc)
 
 
 def main(argv=None):
@@ -1046,10 +1133,53 @@ def main(argv=None):
                    help="matvec backend for the probed solver (default "
                         "general — the probe works on any, general is "
                         "the portable reference)")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="also capture a device trace of one warm solve "
+                        "into DIR and parse it back (obs/profview.py): "
+                        "the table gains the MEASURED column next to "
+                        "predicted (cost model) and recorded (phase "
+                        "probes), plus the collective-overlap verdict")
     _add_telemetry_flags(p)
     _add_cache_flag(p)
     _add_preflight_flag(p)
     p.set_defaults(fn=cmd_perf_report, precision=None)
+
+    p = sub.add_parser("prof-report",
+                       help="parse a captured jax.profiler trace "
+                            "artifact into per-phase attribution + the "
+                            "measured collective-overlap verdict "
+                            "(offline, tolerant — a truncated artifact "
+                            "degrades to a named verdict)")
+    p.add_argument("path",
+                   help="trace artifact: the *.trace.json(.gz) file, "
+                        "its run dir, or any capture root (e.g. the "
+                        "--profile-dir / BENCH_PROFILE_DIR directory)")
+    p.add_argument("--iters", type=int, default=None,
+                   help="iteration count override for per-iteration "
+                        "normalization (default: the capture sidecar's)")
+    p.add_argument("--telemetry-out", default=None, metavar="FILE.jsonl",
+                   help="also emit the schema-versioned prof_report "
+                        "event + prof.* gauges here")
+    p.set_defaults(fn=cmd_prof_report)
+
+    p = sub.add_parser("trend",
+                       help="bench-trend regression sentinel: match "
+                            "legs across committed BENCH_r*.json round "
+                            "artifacts (by shape/variant/precond/nrhs) "
+                            "and print threshold-based regressed/"
+                            "improved/flat verdicts; exit 1 on a "
+                            "regression")
+    p.add_argument("artifacts", nargs="*", metavar="BENCH_rNN.json",
+                   help="round artifacts in round order (default: "
+                        "./BENCH_r*.json sorted)")
+    p.add_argument("--fresh", default=None, metavar="FILE.json",
+                   help="a fresh artifact (raw bench line or round "
+                        "wrapper) appended as the newest round — the "
+                        "before/after answer for a live window")
+    p.add_argument("--threshold", type=float, default=None,
+                   help="relative change separating flat from "
+                        "regressed/improved (default 0.10)")
+    p.set_defaults(fn=cmd_trend)
 
     p = sub.add_parser("summary",
                        help="offline summary of a telemetry/flight JSONL "

@@ -414,6 +414,12 @@ def summarize_jsonl(path: str) -> str:
             d = disp[name]
             lines.append(f"{name:<24} {int(d[0]):>6} {d[1]:>9.3f} "
                          f"{d[2]:>9.3f}")
+    caps = [ev for ev in events if ev.get("kind") == "profile_capture"]
+    if caps:
+        lines.append("")
+        for ev in caps:
+            lines.append(f"profile artifact: {ev.get('path')} "
+                         f"(read it back with `pcg-tpu prof-report`)")
     summaries = [ev for ev in events if ev.get("kind") == "run_summary"]
     if summaries:
         gauges = summaries[-1].get("gauges") or {}

@@ -107,6 +107,18 @@ EVENT_KINDS: Dict[str, tuple] = {
     # anchor from the real solve program
     "phase_probe": ("pcg_variant", "precond", "phases",
                     "sum_ms_per_iter", "whole_ms_per_iter"),
+    # one bounded profiler-trace capture (obs/profview.py
+    # capture_solve_profile, or the driver's profile_dir bracket): the
+    # on-disk artifact path — the pointer `summary` and post-mortems
+    # follow to the trace a run left behind
+    "profile_capture": ("path",),
+    # one parsed device-trace report (obs/profview.py profile_report /
+    # `pcg-tpu prof-report`): per-phase bucketed device-op wall time,
+    # the measured collective-overlap fraction (null when the trace
+    # carries no collectives), and the tolerant reader's verdict
+    # ("ok" or "degraded: <named reason>" — a truncated artifact still
+    # emits, it never crashes)
+    "prof_report": ("source", "phases", "overlap_frac", "verdict"),
     # one crash-durable flight record (obs/flight.py — fsync-per-event):
     # op = meta | begin | heartbeat | end | fail; begin/end/fail carry
     # name+seq, every record carries the monotonic clock next to the
@@ -155,6 +167,13 @@ BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 #  the acceptance number), ``cold_setup_s``/``warm_setup_s`` (solver
 #  setup wall on the cold vs shard-cache-warm start), and
 #  ``ingest_peak_bytes`` (streamed slab ingest's peak host memory).
+#  ``measured_ms_per_iter_matvec`` / ``overlap_frac`` (ISSUE 15,
+#  obs/profview.py) are the PROFILED-leg fields (BENCH_PROFILE=1): the
+#  trace-measured matvec ms/iter and the measured collective-overlap
+#  fraction of the profiled warm solve.  ABSENT (not null) on
+#  unprofiled legs, and on insurance/salvage lines emitted only when
+#  the capture actually ran before the failure — a line must never
+#  carry a measurement that was not taken.
 BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "nrhs_planned", "dof_iter_rhs_per_s",
                         "nrhs_quarantined", "nrhs_recoveries",
@@ -162,7 +181,8 @@ BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "predicted_ms_per_iter", "model_ratio",
                         "procs", "partition_build_s",
                         "partition_serial_s", "cold_setup_s",
-                        "warm_setup_s", "ingest_peak_bytes")
+                        "warm_setup_s", "ingest_peak_bytes",
+                        "measured_ms_per_iter_matvec", "overlap_frac")
 # ``setup_cache``: warm-path partition attribution (cache/ subsystem).
 BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
 # ``pcg_variant``: the engaged PCG loop formulation of the line's

@@ -754,6 +754,10 @@ class Solver:
         # in step() is the only live reference either way.
         donate_step = (1,) if self._donate else ()
         self._step_fn = jax.jit(shard_step, donate_argnums=donate_step)
+        # kept lowerable even when the AOT warm path replaces _step_fn
+        # below: obs/profview.scope_map_from_solver re-lowers THIS to
+        # read the compiled op_name metadata (named-scope -> phase map)
+        self._step_fn_jit = self._step_fn
 
         # ---- dispatch-chunked solve path (large problems) -----------------
         # (solver/chunked.py; auto-engaged above ~4M dofs)
@@ -2180,8 +2184,16 @@ class Solver:
 
             warnings.warn("profile_dir is ignored in speed-test mode "
                           "(speed_test disables all I/O)")
+        prof_dir = self.config.profile_dir
         if profiling:
-            jax.profiler.start_trace(self.config.profile_dir)
+            # Multi-process: two hosts must not race one trace directory
+            # (the profiler's run-dir naming is second-granular) — each
+            # process captures into its own p<idx> subdir, the same
+            # sharding rule the telemetry JSONL stream follows.
+            if jax.process_count() > 1:
+                prof_dir = os.path.join(prof_dir,
+                                        f"p{jax.process_index()}")
+            jax.profiler.start_trace(prof_dir)
 
         results = []
         try:
@@ -2203,6 +2215,21 @@ class Solver:
             self._resume_pending = False
             if profiling:
                 jax.profiler.stop_trace()
+                # profile_capture event: the pointer `pcg-tpu summary`
+                # and post-mortems follow to the on-disk artifact
+                # (obs/profview.newest_profile_artifact resolves the
+                # run dir the profiler just wrote; best-effort — a
+                # capture that wrote nothing still reports the root)
+                try:
+                    from pcg_mpi_solver_tpu.obs.profview import (
+                        newest_profile_artifact)
+
+                    art = newest_profile_artifact(prof_dir) or prof_dir
+                except Exception:                       # noqa: BLE001
+                    art = prof_dir
+                self._rec.event("profile_capture", path=art,
+                                source="solve",
+                                steps=len(results))
 
         if do_export:
             store.write_time_list(self._export_times)

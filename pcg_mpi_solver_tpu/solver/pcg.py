@@ -16,6 +16,13 @@ Per iteration (``variant="classic"``): 3 scalar/fused psums + 1
 interface-assembly psum inside the matvec — the same communication count
 as the reference's 3 allreduces + 1 halo exchange (SURVEY.md §3.1).
 
+Every loop body (all three variants, scalar and blocked) traces its
+phases under ``jax.named_scope`` labels — ``pcg/matvec``,
+``pcg/precond``, ``pcg/reduce``, ``pcg/axpy`` — so profiler-trace
+events bucket deterministically into the obs/perf.py attribution
+phases (obs/profview.py parses them back; the analysis/ fast-tier
+``scope-labels`` rule proves the labels exist in every traced variant).
+
 ``variant="fused"`` restructures the loop body around the
 Chronopoulos–Gear recurrence (the single-reduction CG of arXiv:2105.06176
 §2): the matvec runs on the preconditioned residual (w = A.z), the search
@@ -420,8 +427,13 @@ def pcg(
 
     def amul(v):
         """Assembled K.v restricted to effective dofs (reference computes the
-        full product then slices to LocDofEff, pcg_solver.py:482-484)."""
-        return eff * ops.matvec(data, v)
+        full product then slices to LocDofEff, pcg_solver.py:482-484).
+        Traced under the ``pcg/matvec`` named scope so profiler-trace
+        events bucket deterministically (obs/profview.py; the handful of
+        out-of-loop applications — r0, finalize, deferred checks — are
+        O(1) per solve and absorbed by the per-iteration division)."""
+        with jax.named_scope("pcg/matvec"):
+            return eff * ops.matvec(data, v)
 
     if warm:
         x0 = carry_in["x"]
@@ -651,24 +663,28 @@ def pcg(
             # (P, n_node_loc, 3, 3), or the mg V-cycle dict —
             # ops.apply_prec dispatches on type/rank (data carries the
             # mg hierarchy; unused by the array preconditioners)
-            z = ops.apply_prec(inv_diag, c["r"], data=data)
+            with jax.named_scope("pcg/precond"):
+                z = ops.apply_prec(inv_diag, c["r"], data=data)
             # The inf-preconditioner predicate must agree across shards or
             # the while_loop exits divergently and collective counts
             # desync; fuse its global reduction into the rho psum (still
             # one collective).
             inf_loc = jnp.any(jnp.isinf(z)).astype(ops.dot_dtype)
-            red = ops.wdots(w, [(z, c["r"])], extra=[inf_loc])
+            with jax.named_scope("pcg/reduce"):
+                red = ops.wdots(w, [(z, c["r"])], extra=[inf_loc])
             rho, flag2 = red[0], red[1] > 0
             bad_rho = (rho == 0) | jnp.isinf(rho)
             beta = (rho / c["rho"]).astype(dt)
-            if warm:
-                # Resumed iteration: the beta/p recurrence continues from
-                # the previous call's direction on the very first pass.
-                bad_beta = (beta == 0) | jnp.isinf(beta)
-                p = z + beta * c["p"]
-            else:
-                bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
-                p = jnp.where(i == 0, z, z + beta * c["p"])
+            with jax.named_scope("pcg/axpy"):
+                if warm:
+                    # Resumed iteration: the beta/p recurrence continues
+                    # from the previous call's direction on the very
+                    # first pass.
+                    bad_beta = (beta == 0) | jnp.isinf(beta)
+                    p = z + beta * c["p"]
+                else:
+                    bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
+                    p = jnp.where(i == 0, z, z + beta * c["p"])
             return p, dict(rho=rho, flag2=flag2, bad_pre=bad_rho | bad_beta)
 
         def pre_check(c):
@@ -681,7 +697,8 @@ def pcg(
         def post_iterate(args):
             c, p, q, aux = args
             rho = aux["rho"]
-            pq = ops.wdot(w, p, q)
+            with jax.named_scope("pcg/reduce"):
+                pq = ops.wdot(w, p, q)
             bad_pq = (pq <= 0) | jnp.isinf(pq)
             alpha = (rho / pq).astype(dt)
             bad_alpha = jnp.isinf(alpha)
@@ -704,17 +721,20 @@ def pcg(
                 return out
 
             def on_continue(c):
-                r = c["r"] - alpha * q
+                with jax.named_scope("pcg/axpy"):
+                    r = c["r"] - alpha * q
                 # Fused 3-norm reduction: ||p||, ||x_old||, ||r|| in ONE
                 # psum (reference pcg_solver.py:504-507).
-                sq = ops.wdots(w, [(p, p), (c["x"], c["x"]), (r, r)])
+                with jax.named_scope("pcg/reduce"):
+                    sq = ops.wdots(w, [(p, p), (c["x"], c["x"]), (r, r)])
                 normp, normx, normr = (jnp.sqrt(sq[0]), jnp.sqrt(sq[1]),
                                        jnp.sqrt(sq[2]))
                 stag = jnp.where(
                     normp * jnp.abs(alpha).astype(ops.dot_dtype)
                     < eps * normx,
                     c["stag"] + 1, 0).astype(jnp.int32)
-                x = c["x"] + alpha * p
+                with jax.named_scope("pcg/axpy"):
+                    x = c["x"] + alpha * p
 
                 candidate = ((normr <= tolb) | (stag >= max_stag_steps)
                              | (c["moresteps"] > 0))
@@ -740,7 +760,8 @@ def pcg(
             # q = amul(x): recompute the ACTUAL residual before declaring
             # convergence (reference pcg_solver.py:527-533).
             r_true = fext - q
-            normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
+            with jax.named_scope("pcg/reduce"):
+                normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
             return _resolve(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
                             stag=c["stag"], normr_act=normr_act,
                             candidate=jnp.asarray(True), i=i)
@@ -774,7 +795,8 @@ def pcg(
         def pre_iterate(c):
             # scalar/block-Jacobi inverse or mg V-cycle (classic
             # pre_iterate's z)
-            return ops.apply_prec(inv_diag, c["r"], data=data)
+            with jax.named_scope("pcg/precond"):
+                return ops.apply_prec(inv_diag, c["r"], data=data)
 
         def pre_check(c):
             return c["x"]
@@ -787,9 +809,10 @@ def pcg(
             # the inf-preconditioner predicate rides the same collective
             # (classic fuses it into the rho psum the same way)
             inf_loc = jnp.any(jnp.isinf(z)).astype(ops.dot_dtype)
-            red = ops.wdots(w, [(c["r"], z), (z, wz),
-                                (c["r"], c["r"]), (c["p"], c["p"]),
-                                (c["x"], c["x"])], extra=[inf_loc])
+            with jax.named_scope("pcg/reduce"):
+                red = ops.wdots(w, [(c["r"], z), (z, wz),
+                                    (c["r"], c["r"]), (c["p"], c["p"]),
+                                    (c["x"], c["x"])], extra=[inf_loc])
             rho, mu = red[0], red[1]
             normr = jnp.sqrt(red[2])
             normp, normx = jnp.sqrt(red[3]), jnp.sqrt(red[4])
@@ -845,10 +868,11 @@ def pcg(
             def on_continue(c):
                 beta_dt = beta.astype(dt)
                 alpha_dt = alpha.astype(dt)
-                p2 = z + beta_dt * c["p"]        # p = 0 cold => p2 = z
-                q2 = wz + beta_dt * c["q"]       # A.p by recurrence
-                x2 = c["x"] + alpha_dt * p2
-                r2 = c["r"] - alpha_dt * q2
+                with jax.named_scope("pcg/axpy"):
+                    p2 = z + beta_dt * c["p"]    # p = 0 cold => p2 = z
+                    q2 = wz + beta_dt * c["q"]   # A.p by recurrence
+                    x2 = c["x"] + alpha_dt * p2
+                    r2 = c["r"] - alpha_dt * q2
                 # Epilogue of the LAGGED iterate (min residual tracked
                 # against c["x"], whose norm this trip's reduction
                 # computed), while the carry commits the fresh update.
@@ -882,7 +906,8 @@ def pcg(
             # trip), and ``fresh`` drops so a failed check cannot
             # re-fire without an intervening committed update.
             r_true = fext - kx
-            normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
+            with jax.named_scope("pcg/reduce"):
+                normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
             # residual-drift guard (arXiv:2501.03743): a non-converged
             # check whose TRUE residual exceeds FUSED_DRIFT_FACTOR x the
             # recurrence norm that prompted the candidacy means the
@@ -940,9 +965,10 @@ def pcg(
 
         # ---- the ONE fused psum: carry-state operands only ------------
         inf_loc = jnp.any(jnp.isinf(c["u"])).astype(ops.dot_dtype)
-        red = ops.wdots(w, [(c["r"], c["u"]), (c["w"], c["u"]),
-                            (c["r"], c["r"]), (c["p"], c["p"]),
-                            (c["x"], c["x"])], extra=[inf_loc])
+        with jax.named_scope("pcg/reduce"):
+            red = ops.wdots(w, [(c["r"], c["u"]), (c["w"], c["u"]),
+                                (c["r"], c["r"]), (c["p"], c["p"]),
+                                (c["x"], c["x"])], extra=[inf_loc])
         gamma, delta = red[0], red[1]
         normr = jnp.sqrt(red[2])
         normp, normx = jnp.sqrt(red[3]), jnp.sqrt(red[4])
@@ -957,7 +983,8 @@ def pcg(
             # operand).  Both sources are carry leaves: the apply never
             # waits on the psum above.
             src = jnp.where(c["init"] > 0, c["r"], c["w"])
-            return ops.apply_prec(inv_diag, src, data=data)
+            with jax.named_scope("pcg/precond"):
+                return ops.apply_prec(inv_diag, src, data=data)
 
         m = jax.lax.cond(is_check, pre_check, pre_work, c)
         km = amul(m)          # the ONE stencil instantiation in the body
@@ -1016,14 +1043,15 @@ def pcg(
             def on_continue(c):
                 beta_dt = beta.astype(dt)
                 alpha_dt = alpha.astype(dt)
-                p2 = c["u"] + beta_dt * c["p"]   # p = 0 cold => p2 = u
-                s2 = c["w"] + beta_dt * c["s"]   # A.p by recurrence
-                q2 = m + beta_dt * c["q"]        # M^-1.s by recurrence
-                z2 = km + beta_dt * c["z"]       # A.q by recurrence
-                x2 = c["x"] + alpha_dt * p2
-                r2 = c["r"] - alpha_dt * s2
-                u2 = c["u"] - alpha_dt * q2      # M^-1.r by recurrence
-                w2 = c["w"] - alpha_dt * z2      # A.u by recurrence
+                with jax.named_scope("pcg/axpy"):
+                    p2 = c["u"] + beta_dt * c["p"]  # p = 0 cold => p2 = u
+                    s2 = c["w"] + beta_dt * c["s"]  # A.p by recurrence
+                    q2 = m + beta_dt * c["q"]       # M^-1.s by recurrence
+                    z2 = km + beta_dt * c["z"]      # A.q by recurrence
+                    x2 = c["x"] + alpha_dt * p2
+                    r2 = c["r"] - alpha_dt * s2
+                    u2 = c["u"] - alpha_dt * q2     # M^-1.r by recurrence
+                    w2 = c["w"] - alpha_dt * z2     # A.u by recurrence
                 resolved = _resolve(
                     c, x=c["x"], r=c["r"], p=c["p"], rho=gamma, stag=stag,
                     normr_act=normr.astype(ops.dot_dtype),
@@ -1068,7 +1096,8 @@ def pcg(
             # TIGHTER pipelined budget — replacement bounds drift per
             # check; the counter catches a recurrence that keeps lying.
             r_true = fext - kx
-            normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
+            with jax.named_scope("pcg/reduce"):
+                normr_act = jnp.sqrt(ops.wdot(w, r_true, r_true))
             disagree = ((normr_act > tolb)
                         & (normr_act > jnp.asarray(
                             FUSED_DRIFT_FACTOR, normr_act.dtype)
@@ -1597,7 +1626,10 @@ def pcg_many(
     tolb = jnp.asarray(tol, dd) * n2b                  # (R,)
 
     def amul(v):
-        return eff[..., None] * ops.matvec(data, v)
+        # named pcg/matvec: blocked trace events bucket like the scalar
+        # loop's (obs/profview.py)
+        with jax.named_scope("pcg/matvec"):
+            return eff[..., None] * ops.matvec(data, v)
 
     if warm:
         x0 = carry_in["x"]
@@ -1675,10 +1707,11 @@ def pcg_many(
         residual; the pipelined body passes its per-column r/w
         select)."""
         src = c["r"] if src is None else src
-        z = ops.apply_prec(inv_diag, src, data=data)
-        if inv_diag_fb is not None:
-            z = _colsel(c["prec_sel"] > 0,
-                        ops.apply_prec(inv_diag_fb, src), z)
+        with jax.named_scope("pcg/precond"):
+            z = ops.apply_prec(inv_diag, src, data=data)
+            if inv_diag_fb is not None:
+                z = _colsel(c["prec_sel"] > 0,
+                            ops.apply_prec(inv_diag_fb, src), z)
         return z
 
     def cond(c):
@@ -1797,17 +1830,19 @@ def pcg_many(
         # -- pre (mode 0): z, rho, beta, direction recurrence ----------
         z = _prec_apply(c)
         inf_col = jnp.isinf(z).any(axis=(0, 1)).astype(dd)
-        red = ops.wdots_many(w, [(z, c["r"])], extra=[inf_col])
+        with jax.named_scope("pcg/reduce"):
+            red = ops.wdots_many(w, [(z, c["r"])], extra=[inf_col])
         rho_new, flag2 = red[0], red[1] > 0
         bad_rho = (rho_new == 0) | jnp.isinf(rho_new)
         beta = (rho_new / c["rho"]).astype(dt)
-        if warm:
-            bad_beta = (beta == 0) | jnp.isinf(beta)
-            p_new = z + beta[None, None, :] * c["p"]
-        else:
-            bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
-            p_new = jnp.where((i == 0)[None, None, :], z,
-                              z + beta[None, None, :] * c["p"])
+        with jax.named_scope("pcg/axpy"):
+            if warm:
+                bad_beta = (beta == 0) | jnp.isinf(beta)
+                p_new = z + beta[None, None, :] * c["p"]
+            else:
+                bad_beta = (i > 0) & ((beta == 0) | jnp.isinf(beta))
+                p_new = jnp.where((i == 0)[None, None, :], z,
+                                  z + beta[None, None, :] * c["p"])
 
         # the ONE blocked stencil application: check columns ride their
         # committed iterate through the same matvec (q_j = A.x_j there)
@@ -1815,7 +1850,8 @@ def pcg_many(
         q = amul(operand)
 
         # -- iterate path ----------------------------------------------
-        pq = ops.wdot_many(w, p_new, q)
+        with jax.named_scope("pcg/reduce"):
+            pq = ops.wdot_many(w, p_new, q)
         bad_pq = (pq <= 0) | jnp.isinf(pq)
         alpha = (rho_new / pq).astype(dt)
         bad_alpha = jnp.isinf(alpha)
@@ -1823,21 +1859,25 @@ def pcg_many(
         new_flag = jnp.where(flag2, 2,
                              jnp.where(breakdown, 4, 1)).astype(jnp.int32)
 
-        r_upd = c["r"] - alpha[None, None, :] * q
-        sq = ops.wdots_many(w, [(p_new, p_new), (c["x"], c["x"]),
-                                (r_upd, r_upd)])
+        with jax.named_scope("pcg/axpy"):
+            r_upd = c["r"] - alpha[None, None, :] * q
+        with jax.named_scope("pcg/reduce"):
+            sq = ops.wdots_many(w, [(p_new, p_new), (c["x"], c["x"]),
+                                    (r_upd, r_upd)])
         normp, normx = jnp.sqrt(sq[0]), jnp.sqrt(sq[1])
         normr = jnp.sqrt(sq[2])
         stag_upd = jnp.where(
             normp * jnp.abs(alpha).astype(dd) < eps * normx,
             c["stag"] + 1, 0).astype(jnp.int32)
-        x_upd = c["x"] + alpha[None, None, :] * p_new
+        with jax.named_scope("pcg/axpy"):
+            x_upd = c["x"] + alpha[None, None, :] * p_new
         cand_new = ((normr <= tolb) | (stag_upd >= max_stag_steps)
                     | (c["moresteps"] > 0))
 
         # -- check path: true residual of the committed iterate --------
         r_true = fext - q
-        normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
+        with jax.named_scope("pcg/reduce"):
+            normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
 
         chk = _resolve_many(c, x=c["x"], r=r_true, p=c["p"], rho=c["rho"],
                             stag=c["stag"], normr_act=normr_chk,
@@ -1873,9 +1913,10 @@ def pcg_many(
         kop = amul(operand)          # A.z (iterate cols) / A.x (check cols)
 
         inf_col = jnp.isinf(z).any(axis=(0, 1)).astype(dd)
-        red = ops.wdots_many(w, [(c["r"], z), (z, kop),
-                                 (c["r"], c["r"]), (c["p"], c["p"]),
-                                 (c["x"], c["x"])], extra=[inf_col])
+        with jax.named_scope("pcg/reduce"):
+            red = ops.wdots_many(w, [(c["r"], z), (z, kop),
+                                     (c["r"], c["r"]), (c["p"], c["p"]),
+                                     (c["x"], c["x"])], extra=[inf_col])
         rho, mu = red[0], red[1]
         normr = jnp.sqrt(red[2])
         normp, normx = jnp.sqrt(red[3]), jnp.sqrt(red[4])
@@ -1902,10 +1943,11 @@ def pcg_many(
 
         beta_dt = beta.astype(dt)[None, None, :]
         alpha_dt = alpha.astype(dt)[None, None, :]
-        p2 = z + beta_dt * c["p"]
-        q2 = kop + beta_dt * c["q"]
-        x2 = c["x"] + alpha_dt * p2
-        r2 = c["r"] - alpha_dt * q2
+        with jax.named_scope("pcg/axpy"):
+            p2 = z + beta_dt * c["p"]
+            q2 = kop + beta_dt * c["q"]
+            x2 = c["x"] + alpha_dt * p2
+            r2 = c["r"] - alpha_dt * q2
 
         res = _resolve_many(
             c, x=c["x"], r=c["r"], p=c["p"], rho=rho, stag=stag,
@@ -1921,7 +1963,8 @@ def pcg_many(
         brk = dict(c, flag=new_flag, iter_out=i, rho=rho)
 
         r_true = fext - kop
-        normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
+        with jax.named_scope("pcg/reduce"):
+            normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
         # per-column residual-drift guard (same contract as the scalar
         # fused post_check): a non-converged check whose true residual
         # exceeds FUSED_DRIFT_FACTOR x the recurrence norm counts as
@@ -1967,9 +2010,10 @@ def pcg_many(
 
         # ---- the ONE fused psum: carry-state operands only ------------
         inf_col = jnp.isinf(c["u"]).any(axis=(0, 1)).astype(dd)
-        red = ops.wdots_many(w, [(c["r"], c["u"]), (c["w"], c["u"]),
-                                 (c["r"], c["r"]), (c["p"], c["p"]),
-                                 (c["x"], c["x"])], extra=[inf_col])
+        with jax.named_scope("pcg/reduce"):
+            red = ops.wdots_many(w, [(c["r"], c["u"]), (c["w"], c["u"]),
+                                     (c["r"], c["r"]), (c["p"], c["p"]),
+                                     (c["x"], c["x"])], extra=[inf_col])
         gamma, delta = red[0], red[1]
         normr = jnp.sqrt(red[2])
         normp, normx = jnp.sqrt(red[3]), jnp.sqrt(red[4])
@@ -2007,14 +2051,15 @@ def pcg_many(
 
         beta_dt = beta.astype(dt)[None, None, :]
         alpha_dt = alpha.astype(dt)[None, None, :]
-        p2 = c["u"] + beta_dt * c["p"]       # p = 0 cold => p2 = u
-        s2 = c["w"] + beta_dt * c["s"]       # A.p by recurrence
-        q2 = m + beta_dt * c["q"]            # M^-1.s by recurrence
-        z2 = kop + beta_dt * c["z"]          # A.q by recurrence
-        x2 = c["x"] + alpha_dt * p2
-        r2 = c["r"] - alpha_dt * s2
-        u2 = c["u"] - alpha_dt * q2          # M^-1.r by recurrence
-        w2 = c["w"] - alpha_dt * z2          # A.u by recurrence
+        with jax.named_scope("pcg/axpy"):
+            p2 = c["u"] + beta_dt * c["p"]   # p = 0 cold => p2 = u
+            s2 = c["w"] + beta_dt * c["s"]   # A.p by recurrence
+            q2 = m + beta_dt * c["q"]        # M^-1.s by recurrence
+            z2 = kop + beta_dt * c["z"]      # A.q by recurrence
+            x2 = c["x"] + alpha_dt * p2
+            r2 = c["r"] - alpha_dt * s2
+            u2 = c["u"] - alpha_dt * q2      # M^-1.r by recurrence
+            w2 = c["w"] - alpha_dt * z2      # A.u by recurrence
 
         res = _resolve_many(
             c, x=c["x"], r=c["r"], p=c["p"], rho=gamma, stag=stag,
@@ -2040,7 +2085,8 @@ def pcg_many(
         # priming bit re-armed so u/w re-sync next trip; the TIGHTER
         # pipelined drift budget still gates flag 6
         r_true = fext - kop
-        normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
+        with jax.named_scope("pcg/reduce"):
+            normr_chk = jnp.sqrt(ops.wdot_many(w, r_true, r_true))
         disagree = ((normr_chk > tolb)
                     & (normr_chk > jnp.asarray(FUSED_DRIFT_FACTOR, dd)
                        * c["chk_normr"]))

@@ -492,7 +492,8 @@ def test_rule_catalog_complete():
     expected = {"collective-budget", "hot-loop-purity", "dtype-discipline",
                 "donation-integrity", "fingerprint-completeness",
                 "recovery-paths", "recovery-coverage", "telemetry-schema",
-                "cost-model-completeness", "partition-key-components"}
+                "cost-model-completeness", "partition-key-components",
+                "scope-labels"}
     assert expected <= set(rules)
     assert len(expected) >= 5
     # the pre-hardware-window gate covers the structural claims
@@ -501,7 +502,72 @@ def test_rule_catalog_complete():
     assert rules["recovery-coverage"].fast
     assert rules["cost-model-completeness"].fast
     assert rules["partition-key-components"].fast
+    assert rules["scope-labels"].fast
     assert not rules["fingerprint-completeness"].fast
+
+
+# ----------------------------------------------------------------------
+# scope-labels (ISSUE 15): trace-attribution named scopes in every loop
+# ----------------------------------------------------------------------
+
+def test_scope_labels_clean_on_real_programs():
+    """Every canonical program (all variants, scalar + blocked) carries
+    all four pcg/* phase labels, and the parser-side loudness probe
+    passes on the real bucketer."""
+    from pcg_mpi_solver_tpu.analysis.programs import build_programs
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import (
+        check_scope_labels, check_unknown_label_loudness)
+
+    for prog in build_programs(fast=True):
+        assert check_scope_labels(prog) == [], prog.name
+    assert check_unknown_label_loudness() == []
+
+
+def test_scope_labels_fires_on_missing_label():
+    """A label the trace consumer buckets on but no program carries
+    (here: a seeded extra phase) must fire per program — a hot loop
+    that lost its named scope silently moves its time to 'other'."""
+    from pcg_mpi_solver_tpu.analysis.programs import build_programs
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import (
+        check_scope_labels)
+
+    prog = build_programs(fast=True)[0]
+    seeded = {"pcg/matvec": "matvec", "pcg/ghost_phase": "ghost"}
+    findings = check_scope_labels(prog, phase_scopes=seeded)
+    assert len(findings) == 1
+    assert "pcg/ghost_phase" in findings[0].message
+    assert findings[0].loc == f"program:{prog.name}"
+    # ...and a toy program with no scopes at all fires on every label
+    toy = _toy_program(_body_psums(1), {"psum": 1})
+    all_missing = check_scope_labels(toy)
+    assert len(all_missing) == 4
+
+
+def test_scope_labels_unknown_label_loudness_probe_fires():
+    """The probe must catch a bucketer that silently DROPS unbucketable
+    time or unknown pcg/* labels (seeded broken implementations)."""
+    from pcg_mpi_solver_tpu.analysis.rules_jaxpr import (
+        check_unknown_label_loudness)
+
+    def drops_unknowns(ops, scope_map):
+        from pcg_mpi_solver_tpu.obs.perf import PHASES
+
+        return {"phases": {ph: {"us": 0.0, "events": 0}
+                           for ph in PHASES},
+                "other_us": 0.0, "other_events": 0,
+                "unknown_scopes": {}}
+
+    findings = check_unknown_label_loudness(bucket_fn=drops_unknowns)
+    assert len(findings) == 2       # dropped time AND dropped label
+    assert any("DROPPED" in f.message for f in findings)
+    assert any("unknown_scopes" in f.message for f in findings)
+
+    def crashes(ops, scope_map):
+        raise RuntimeError("boom")
+
+    findings = check_unknown_label_loudness(bucket_fn=crashes)
+    assert len(findings) == 1
+    assert "crashed" in findings[0].message
 
 
 # ----------------------------------------------------------------------

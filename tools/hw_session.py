@@ -34,6 +34,14 @@ Presets:
                             off three adjacent lines (the overlap claim
                             is lint-proven by step 0.2; this leg only
                             has to confirm the ms/iter number)
+  3.5 profiled flagship   — ISSUE 15: one BENCH_PROFILE=1 rung on the
+                            same warm cache/size (pipelined when the
+                            overlap lint passed, else classic); the
+                            captured device trace is parsed back and
+                            the MEASURED overlap verdict + the
+                            bench-trend verdict (obs/trend.py over the
+                            committed BENCH_r*.json series) are logged
+                            into this session log
   4. MG A/B               — classic+jacobi vs classic+mg at a
                             multi-level-coarsenable size (BENCH_NX=144;
                             BENCH_PRECOND=mg): iters + ms/iter +
@@ -258,6 +266,65 @@ def run_step(path, name, argv, env_extra=None, timeout=3600, gate_s=900,
     return status
 
 
+def log_profile_verdicts(path, prof_dir, since=None):
+    """ISSUE 15: after the profiled flagship rung, put the two
+    mechanical verdicts INTO the session log — the measured
+    collective-overlap fraction parsed from the captured device trace
+    (obs/profview.py) and the bench-trend verdict over the committed
+    BENCH_r*.json series plus this queue's fresh line (obs/trend.py).
+    ``since`` (unix seconds, the profiled step's start) guards against
+    attributing a STALE artifact: bench swallows capture failures by
+    design, and bench_profile/ persists across sessions — an earlier
+    round's trace must not be logged as this round's measurement.
+    Best-effort end to end: a broken trace parse or a missing artifact
+    logs a named reason and must never cost the step (tested in
+    tests/test_hw_queue.py)."""
+    try:
+        from pcg_mpi_solver_tpu.obs import profview
+
+        files = profview.find_trace_files(prof_dir)
+        if not files:
+            raise FileNotFoundError(f"no trace artifact under "
+                                    f"{prof_dir}")
+        if since is not None and os.path.getmtime(files[0]) < since:
+            raise FileNotFoundError(
+                f"newest artifact predates this step (the capture "
+                f"failed silently; stale: {files[0]})")
+        rep = profview.profile_report(files[0])
+        ov = rep.get("overlap_frac")
+        mv = (rep.get("phases") or {}).get("matvec", {}).get(
+            "ms_per_iter")
+        log_line(path, "overlap verdict: "
+                 + (f"{ov:.3f} of collective time hidden behind "
+                    "concurrent compute" if ov is not None else
+                    "n/a (no collective ops in trace)")
+                 + f" (matvec {mv} ms/iter, parse verdict "
+                   f"{rep.get('verdict')!r}, artifact "
+                   f"{rep.get('source')})")
+    except Exception as e:                              # noqa: BLE001
+        log_line(path, f"overlap verdict unavailable "
+                       f"({type(e).__name__}: {e}); continuing")
+    try:
+        import glob as _glob
+
+        from pcg_mpi_solver_tpu.obs import trend
+
+        arts = sorted(_glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+        fresh = os.path.join(REPO, "bench_provisional.json")
+        rep = trend.trend_report(
+            arts, fresh=fresh if os.path.exists(fresh) else None)
+        log_line(path, "trend verdict: " + trend.verdict_line(rep))
+        for leg in rep["legs"]:
+            if leg["verdict"] == "regressed":
+                log_line(path, f"trend REGRESSION: {leg['leg']} "
+                               f"{leg['old_value']:.3g} -> "
+                               f"{leg['new_value']:.3g} "
+                               f"({leg['delta_pct']:+.1f}%)")
+    except Exception as e:                              # noqa: BLE001
+        log_line(path, f"trend verdict unavailable "
+                       f"({type(e).__name__}: {e}); continuing")
+
+
 def start_queue(name, deadline_min, log):
     """Shared session-start policy for every hardware queue script: derive
     the log path, probe the accelerator with the ONE retry policy (incl.
@@ -371,6 +438,26 @@ def run_priority_queue(path, quick: bool):
                        "the variant would benchmark a disproven "
                        "latency-hiding claim; the rest of the queue "
                        "does not depend on it")
+    # Profiled flagship rung (ISSUE 15): one BENCH_PROFILE=1 leg
+    # directly after the variant A/Bs, on the SAME warm cache dir and
+    # size — the bench captures a jax.profiler trace of one warm solve
+    # (after its timed solve; the A/B numbers above are never
+    # perturbed), parses it back (obs/profview.py), and stamps
+    # detail.measured_ms_per_iter_matvec + detail.overlap_frac on its
+    # line.  The profiled variant is pipelined when the overlap lint
+    # passed (the hardware twin of the step-0.2 static proof — the
+    # MEASURED overlap fraction), else classic.  The overlap + trend
+    # verdicts land in this session log right after the step; a broken
+    # trace parse logs a reason and never costs the step.
+    prof_dir = os.path.join(REPO, "bench_profile")
+    prof_env = dict(cache, BENCH_PROFILE="1", BENCH_PROFILE_DIR=prof_dir,
+                    **size)
+    if overlap_ok:
+        prof_env["BENCH_PCG_VARIANT"] = "pipelined"
+    t_prof0 = time.time()
+    run_step(path, "profiled flagship", ["bench.py"],
+             env_extra=prof_env, timeout=3600)
+    log_profile_verdicts(path, prof_dir, since=t_prof0)
     # MG A/B (ISSUE 10): classic+jacobi anchor vs classic+mg at an
     # even, multi-level-coarsenable size (150 halves once to 75 and
     # stops; 144 = 16*9 gives the 72/36/18/9 coarse chain), sharing the
