@@ -75,3 +75,53 @@ def telemetry_schema_rule(ctx) -> List[Finding]:
                 loc=os.path.relpath(loc, REPO),
                 message=msg or err))
     return findings
+
+
+# -- doc-schema sync (ISSUE 16) -----------------------------------------
+
+EVENT_TABLE_DOC = os.path.join("docs", "OBSERVABILITY.md")
+
+
+def documented_event_kinds(doc_text: str) -> set:
+    """Event kinds documented in OBSERVABILITY.md's event table: the
+    first backticked token of each table row (``| `kind` | ... |``)."""
+    import re
+
+    kinds = set()
+    for line in doc_text.splitlines():
+        m = re.match(r"^\|\s*`([a-z0-9_]+)`\s*\|", line)
+        if m:
+            kinds.add(m.group(1))
+    return kinds
+
+
+def check_doc_schema_sync(doc_text: str, kinds=None) -> List[str]:
+    """Every event kind in obs/schema.py EVENT_KINDS must have a row in
+    the doc's event table — an event a consumer cannot look up is
+    undocumented telemetry.  Returns one error string per missing kind
+    (testable directly on synthetic doc text)."""
+    if kinds is None:
+        from pcg_mpi_solver_tpu.obs.schema import EVENT_KINDS
+
+        kinds = EVENT_KINDS
+    documented = documented_event_kinds(doc_text)
+    return [f"event kind `{k}` (obs/schema.py EVENT_KINDS) has no row "
+            f"in the event table"
+            for k in kinds if k not in documented]
+
+
+@rule("doc-schema-sync", kind="artifact", fast=True,
+      doc="every event kind in obs/schema.py EVENT_KINDS has a row in "
+          "docs/OBSERVABILITY.md's event table (schema without doc is "
+          "telemetry nobody can read back)")
+def doc_schema_sync_rule(ctx) -> List[Finding]:
+    path = os.path.join(REPO, EVENT_TABLE_DOC)
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return [Finding(rule="doc-schema-sync", loc=EVENT_TABLE_DOC,
+                        message=f"unreadable ({e})")]
+    return [Finding(rule="doc-schema-sync", loc=EVENT_TABLE_DOC,
+                    message=msg)
+            for msg in check_doc_schema_sync(text)]

@@ -811,6 +811,24 @@ def _capture_bench_profile(solver, nrhs):
              f"verdict={rep['verdict']} matvec_ms_per_iter={mv} "
              f"overlap_frac={rep.get('overlap_frac')} "
              "(read back: pcg-tpu prof-report)")
+        # Multi-controller capture (p<idx>/ subdirs): fold the fleet
+        # skew verdict into the line — skew_frac / straggler_rank are
+        # stamped ONLY when the report measured cross-process skew
+        # (bench_detail_fields returns {} otherwise, same
+        # never-fabricate contract as the fields above)
+        import jax
+
+        from pcg_mpi_solver_tpu.obs import fleet
+
+        frep = fleet.fleet_report(pdir)
+        fdet = fleet.bench_detail_fields(frep, jax.process_index())
+        if fdet:
+            fleet.emit_fleet_report(_REC, frep)
+            out.update(fdet)
+            _log(f"# fleet skew: skew_frac={fdet['skew_frac']} "
+                 f"straggler_rank={fdet['straggler_rank']} "
+                 f"straggler=p{frep['straggler']} "
+                 "(read back: pcg-tpu fleet-report)")
     except Exception as e:                              # noqa: BLE001
         _log(f"# profile capture failed ({type(e).__name__}: {e}); "
              "continuing unprofiled")

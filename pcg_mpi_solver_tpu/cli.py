@@ -17,8 +17,10 @@ orchestrated by examples/run_basic_script.bash) as one typed CLI.
     pcg-tpu lint      [--fast] [--json F]              # contract lint (analysis/)
     pcg-tpu perf-report [--nx N | scratch]             # measured-vs-model phases
     pcg-tpu prof-report <trace-artifact>               # parse a captured device trace
+    pcg-tpu fleet-report <capture-root>                # cross-process collective skew
     pcg-tpu trend     [BENCH_r*.json ...]              # bench-trend regression sentinel
     pcg-tpu summary   <run.jsonl> [...]                # offline telemetry summary
+    pcg-tpu watch     <run.jsonl> [--once]             # live monitor + stall alarm
     pcg-tpu telemetry-merge <run.jsonl> --out M.jsonl  # merge per-process shards
 
 Settings come from ``--settings settings.json`` (same shape as the
@@ -31,6 +33,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import time
 
 import numpy as np
 
@@ -685,11 +688,23 @@ def cmd_telemetry_merge(args):
         raise SystemExit("telemetry-merge: no shards found for "
                          f"{args.paths} (expected FILE.jsonl and/or "
                          "FILE.p<N>.jsonl siblings)")
-    stats = merge_shards(paths, args.out)
+    align = None if args.align == "none" else args.align
+    stats = merge_shards(paths, args.out, align=align)
     for name in sorted(stats["shards"]):
         st = stats["shards"][name]
         print(f">shard {name}: {st['events']} event(s), "
               f"{st['truncated']} truncated line(s) skipped")
+    al = stats.get("align")
+    if al is not None:
+        if al["matched_anchors"]:
+            offs = "  ".join(f"{n}={v:+.6f}s"
+                             for n, v in sorted(al["offsets_s"].items()))
+            print(f">clock alignment ({al['mode']}): "
+                  f"{al['matched_anchors']} matched anchor(s); "
+                  f"offsets vs first shard: {offs}")
+        else:
+            print(">clock alignment: no matched dispatch anchors across "
+                  "shards — falling back to raw t ordering")
     print(f">merged {stats['events']} event(s) from "
           f"{len(stats['shards'])} shard(s) -> {args.out}"
           + (f" ({stats['truncated_lines']} truncated line(s) skipped)"
@@ -848,6 +863,76 @@ def cmd_prof_report(args):
         print(f">telemetry: {args.telemetry_out}")
     if not files:
         raise SystemExit(2)
+
+
+def cmd_fleet_report(args):
+    """Cross-process collective-skew attribution (ISSUE 16,
+    obs/fleet.py): align the per-process capture subdirs
+    (``p<idx>/…``) a multi-controller ``capture_solve_profile`` run
+    writes on matched collective END anchors, split every matched
+    collective into transport vs wait, and name the straggler per phase.
+    Offline and jax-free like ``prof-report``; a single-process capture
+    or a collective-free trace degrades to a NAMED verdict, never a
+    crash."""
+    from pcg_mpi_solver_tpu.obs import fleet
+
+    rep = fleet.fleet_report(args.path)
+    print(fleet.format_fleet_report(rep))
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+        print(f">json: {args.json}")
+    if args.telemetry_out:
+        from pcg_mpi_solver_tpu.obs.metrics import (
+            JsonlSink, MetricsRecorder)
+
+        rec = MetricsRecorder(sinks=[JsonlSink(args.telemetry_out)])
+        fleet.emit_fleet_report(rec, rep)
+        rec.close()
+        print(f">telemetry: {args.telemetry_out}")
+    if rep["n_processes"] == 0:
+        raise SystemExit(2)
+
+
+def cmd_watch(args):
+    """Live run monitor (ISSUE 16, obs/watch.py): tail the flight/
+    telemetry JSONL shards of a running solve — per-dispatch progress,
+    completed-step residuals, a stall alarm when ALL shards' heartbeats
+    go silent past the threshold, and a cost-model x observed-rate ETA.
+    ``--once`` prints one snapshot and exits (exit 3 when that snapshot
+    is a stall — the scriptable probe); the default polls until the run
+    is done or interrupted.  Read-only on the watched stream."""
+    from pcg_mpi_solver_tpu.obs import watch
+
+    rec = None
+    if args.telemetry_out:
+        from pcg_mpi_solver_tpu.obs.metrics import (
+            JsonlSink, MetricsRecorder)
+
+        rec = MetricsRecorder(sinks=[JsonlSink(args.telemetry_out)])
+    stalled = False
+    try:
+        while True:
+            snap = watch.watch_snapshot(args.path,
+                                        stall_after_s=args.stall_after,
+                                        tol=args.tol)
+            print(watch.format_watch(snap), flush=True)
+            if rec is not None:
+                watch.emit_watch_events(rec, snap)
+            stalled = snap["status"] == "stalled"
+            if args.once or snap["status"] == "done":
+                break
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                break
+            print(flush=True)
+    finally:
+        if rec is not None:
+            rec.close()
+            print(f">telemetry: {args.telemetry_out}")
+    if stalled and args.once:
+        raise SystemExit(3)
 
 
 def cmd_trend(args):
@@ -1162,6 +1247,52 @@ def main(argv=None):
                         "event + prof.* gauges here")
     p.set_defaults(fn=cmd_prof_report)
 
+    p = sub.add_parser("fleet-report",
+                       help="cross-process collective-skew attribution "
+                            "over a multi-controller capture root "
+                            "(p<idx>/ subdirs): clock-align on matched "
+                            "collective ends, split transport vs wait, "
+                            "name the straggler per phase (offline, "
+                            "jax-free, tolerant)")
+    p.add_argument("path",
+                   help="capture root holding the per-process p<idx>/ "
+                        "subdirs (e.g. the --profile-dir / "
+                        "BENCH_PROFILE_DIR directory)")
+    p.add_argument("--json", default=None, metavar="FILE.json",
+                   help="also write the full report as JSON")
+    p.add_argument("--telemetry-out", default=None, metavar="FILE.jsonl",
+                   help="also emit the schema-versioned fleet_report "
+                        "event + fleet.* gauges here")
+    p.set_defaults(fn=cmd_fleet_report)
+
+    p = sub.add_parser("watch",
+                       help="live run monitor: tail the flight/telemetry "
+                            "JSONL shards of a running solve — progress, "
+                            "stall alarm (all shards silent past the "
+                            "threshold), and a cost-model x observed-"
+                            "rate ETA")
+    p.add_argument("path", metavar="FILE.jsonl",
+                   help="base telemetry/flight path; on-disk .p<N> "
+                        "shards are tailed together")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit (exit 3 when it is "
+                        "a stall)")
+    p.add_argument("--interval", type=float, default=5.0,
+                   help="poll interval in seconds (default 5)")
+    p.add_argument("--stall-after", type=float, default=None,
+                   metavar="S",
+                   help="flag a stall when ALL shards are silent this "
+                        "long (default: 3x the flight heartbeat "
+                        "cadence)")
+    p.add_argument("--tol", type=float, default=1e-8,
+                   help="convergence target the ETA aims the observed "
+                        "rate at (the stream does not carry the run's "
+                        "tol; default matches SolverConfig)")
+    p.add_argument("--telemetry-out", default=None, metavar="FILE.jsonl",
+                   help="emit watch/stall events here (never to the "
+                        "watched stream)")
+    p.set_defaults(fn=cmd_watch)
+
     p = sub.add_parser("trend",
                        help="bench-trend regression sentinel: match "
                             "legs across committed BENCH_r*.json round "
@@ -1198,6 +1329,13 @@ def main(argv=None):
                    help="base path(s); on-disk .p<N> siblings are "
                         "discovered automatically")
     p.add_argument("--out", required=True, metavar="MERGED.jsonl")
+    p.add_argument("--align", choices=["none", "collectives"],
+                   default="none",
+                   help="'collectives': clock-align shards on matched "
+                        "dispatch completions (the fleet-report anchor "
+                        "model) before ordering, so skewed host clocks "
+                        "interleave in true order; events gain "
+                        "t_aligned, raw t is preserved")
     p.set_defaults(fn=cmd_telemetry_merge)
 
     args = ap.parse_args(argv)

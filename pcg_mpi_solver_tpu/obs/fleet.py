@@ -1,0 +1,404 @@
+"""Cross-process collective-skew attribution over per-process profile
+captures (ISSUE 16 tentpole) — the multi-host twin of
+:mod:`~pcg_mpi_solver_tpu.obs.profview`'s single-capture report.
+
+:func:`~pcg_mpi_solver_tpu.obs.profview.capture_solve_profile` writes one
+subdir per controller (``p<idx>/…``) when ``jax.process_count() > 1``.
+Each process's trace clock is local — the profiler timestamps carry an
+arbitrary per-host origin — so the per-process timelines cannot be
+compared directly.  But a collective is a synchronization point: every
+participant leaves it at (physically) the same instant, so matched
+collective END events are cross-process clock anchors.  The per-process
+clock offset is the median end-time difference against process 0 over
+every matched collective (median: robust to the handful of collectives a
+profiler clips at a trace boundary).
+
+With the timelines aligned, each matched collective's duration splits
+into
+
+* **transport** — the minimum duration across processes.  The process
+  that arrived LAST did not wait for anyone; its duration is the pure
+  wire/reduction cost.
+* **wait** — each process's excess over transport: the time it sat
+  blocked at the rendezvous because a straggler arrived late.
+
+The straggler of a collective is therefore the process with the
+*minimum* duration (it arrived last and waited least); the wait it
+caused is the sum of every other process's excess.  Summed per phase
+(``pcg/matvec`` vs ``pcg/reduce`` scope labels, same bucketing as
+profview) this names WHICH host the weak-scaling latency comes from —
+the number the pipelined variant exists to hide (arXiv:2105.06176).
+
+Import-light on purpose (no jax/numpy): ``pcg-tpu fleet-report`` must
+run on a laptop against a copied capture dir.  The clock-alignment
+helper (:func:`align_offsets`) is shared with ``telemetry-merge
+--align collectives`` (obs/flight.py), which applies the same
+matched-anchor median to telemetry ``dispatch`` completions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from pcg_mpi_solver_tpu.obs.profview import (
+    _base_scope_map, device_ops, find_trace_files, is_collective,
+    load_meta, phase_of, read_trace_events)
+
+FLEET_SCHEMA = "pcg-tpu-fleet/1"
+
+_PDIR_RE = re.compile(r"^p(\d+)$")
+
+
+# ----------------------------------------------------------------------
+# Generic matched-anchor clock alignment (shared with telemetry-merge)
+# ----------------------------------------------------------------------
+
+def align_offsets(anchors: Mapping[Any, Mapping[Any, float]]
+                  ) -> Tuple[Dict[Any, float], int]:
+    """Per-stream clock offsets from matched synchronization anchors.
+
+    ``anchors`` maps stream id -> {anchor key: completion time}; an
+    anchor key identifies the SAME synchronization event across streams
+    (e.g. ``(collective base name, occurrence index)``).  Completion
+    times share a unit but not an origin.  Returns ``(offsets,
+    n_matched)`` where ``offsets[s]`` is the median of ``t_s - t_ref``
+    over every anchor present in ALL streams (ref = lowest stream id,
+    offset 0.0 by construction).  Subtracting ``offsets[s]`` from stream
+    ``s`` timestamps puts every stream on the reference clock.  A stream
+    is given offset 0.0 (unaligned) when fewer than one anchor matches.
+    """
+    ids = sorted(anchors)
+    offsets: Dict[Any, float] = {s: 0.0 for s in ids}
+    if len(ids) < 2:
+        return offsets, 0
+    ref = ids[0]
+    shared = set(anchors[ref])
+    for s in ids[1:]:
+        shared &= set(anchors[s])
+    for s in ids[1:]:
+        deltas = sorted(anchors[s][k] - anchors[ref][k] for k in shared)
+        if deltas:
+            m = len(deltas) // 2
+            offsets[s] = (deltas[m] if len(deltas) % 2
+                          else 0.5 * (deltas[m - 1] + deltas[m]))
+    return offsets, len(shared)
+
+
+# ----------------------------------------------------------------------
+# Capture discovery + per-process collective sequences
+# ----------------------------------------------------------------------
+
+def discover_process_dirs(root: str) -> List[Tuple[int, str]]:
+    """``(process index, dir)`` pairs under a capture root: the
+    ``p<idx>/`` subdirs capture_solve_profile writes on multi-controller
+    runs, or ``[(0, root)]`` when the root itself holds a single
+    process's trace (degraded single-process mode)."""
+    out: List[Tuple[int, str]] = []
+    if os.path.isdir(root):
+        for name in sorted(os.listdir(root)):
+            m = _PDIR_RE.match(name)
+            d = os.path.join(root, name)
+            if m and os.path.isdir(d) and find_trace_files(d):
+                out.append((int(m.group(1)), d))
+    if out:
+        return sorted(out)
+    if find_trace_files(root):
+        return [(0, root)]
+    return []
+
+
+def collective_occurrences(ops: List[dict]) -> Dict[Tuple[str, int], dict]:
+    """One representative per (collective base, occurrence index) for a
+    single process's device ops.
+
+    On a real TPU pod slice each local device is a trace lane
+    (``pid``/``tid``) and the SAME program collective appears once per
+    lane; on the forced-host CPU mesh all virtual devices usually share
+    one lane.  Occurrences are counted per lane in timestamp order, then
+    the k-th occurrences are aggregated across lanes: ``end`` = max end
+    (the process leaves the rendezvous when its slowest lane does),
+    ``dur`` = max duration, ``ts`` = min start.  The representative op
+    dict keeps the max-duration lane's ``text`` for phase attribution.
+    """
+    lanes: Dict[Tuple[Any, Any], Dict[str, List[dict]]] = {}
+    for op in ops:
+        if not is_collective(op["base"]):
+            continue
+        lane = lanes.setdefault((op.get("pid"), op.get("tid")), {})
+        lane.setdefault(op["base"], []).append(op)
+    reps: Dict[Tuple[str, int], dict] = {}
+    for lane in lanes.values():
+        for base, evs in lane.items():
+            evs.sort(key=lambda o: o["ts"])
+            for k, op in enumerate(evs):
+                key = (base, k)
+                rep = reps.get(key)
+                end = op["ts"] + op["dur"]
+                if rep is None:
+                    reps[key] = {"base": base, "name": op["name"],
+                                 "text": op.get("text", ""),
+                                 "ts": op["ts"], "dur": op["dur"],
+                                 "end": end, "lanes": 1}
+                else:
+                    rep["ts"] = min(rep["ts"], op["ts"])
+                    rep["end"] = max(rep["end"], end)
+                    if op["dur"] > rep["dur"]:
+                        rep["dur"] = op["dur"]
+                        rep["name"] = op["name"]
+                        rep["text"] = op.get("text", "")
+                    rep["lanes"] += 1
+    return reps
+
+
+def _load_process(pdir: str) -> Tuple[Optional[dict], List[str]]:
+    """Parse one process's newest trace: ``{"colls", "meta", "n_ops"}``
+    plus the tolerant reader's problem list (never raises)."""
+    files = find_trace_files(pdir)
+    if not files:
+        return None, [f"{pdir}: no trace files"]
+    events, problems = read_trace_events(files[0])
+    ops = device_ops(events)
+    colls = collective_occurrences(ops)
+    return ({"dir": pdir, "trace": files[0], "colls": colls,
+             "meta": load_meta(files[0]), "n_ops": len(ops)},
+            [f"{os.path.basename(pdir)}: {p}" for p in problems])
+
+
+# ----------------------------------------------------------------------
+# The fleet report
+# ----------------------------------------------------------------------
+
+def fleet_report(root: str) -> Dict[str, Any]:
+    """Cross-process skew attribution over a capture root (see module
+    docstring for the alignment + transport/wait model).  Tolerant: a
+    missing process dir, an unreadable trace, or a collective-free
+    capture degrades the verdict by name — it never raises."""
+    problems: List[str] = []
+    pdirs = discover_process_dirs(root)
+    procs: Dict[int, dict] = {}
+    for idx, pdir in pdirs:
+        info, probs = _load_process(pdir)
+        problems.extend(probs)
+        if info is not None:
+            procs[idx] = info
+    report: Dict[str, Any] = {
+        "schema": FLEET_SCHEMA, "source": root,
+        "n_processes": len(procs),
+        "processes": {}, "phases": {},
+        "matched_collectives": 0, "skew_frac": None,
+        "transport_ms": 0.0, "wait_ms": 0.0,
+        "straggler": None, "clock_offsets_ms": {},
+        "iters": None, "verdict": "ok",
+    }
+    if not procs:
+        problems.append("no per-process captures found")
+        report["verdict"] = "degraded: " + "; ".join(problems)
+        return report
+    meta0 = next((procs[i]["meta"] for i in sorted(procs)
+                  if procs[i]["meta"]), None)
+    iters = None
+    if meta0:
+        try:
+            iters = int(meta0.get("iters") or 0) or None
+        except (TypeError, ValueError):
+            iters = None
+    report["iters"] = iters
+    if len(procs) == 1:
+        idx = next(iter(procs))
+        report["processes"][str(idx)] = {
+            "dir": procs[idx]["dir"], "coll_ms": round(sum(
+                c["dur"] for c in procs[idx]["colls"].values()) / 1e3, 3),
+            "wait_ms": None, "transport_ms": None, "skew_frac": None,
+            "wait_ms_per_iter": None, "caused_wait_ms": None,
+            "straggler_rank": None}
+        problems.append("single-process capture (no cross-process skew)")
+        report["verdict"] = "degraded: " + "; ".join(problems)
+        return report
+
+    # -- clock alignment over matched collective END anchors -----------
+    ids = sorted(procs)
+    anchors = {i: {k: c["end"] for k, c in procs[i]["colls"].items()}
+               for i in ids}
+    offsets_us, n_matched = align_offsets(anchors)
+    report["clock_offsets_ms"] = {
+        str(i): round(offsets_us[i] / 1e3, 3) for i in ids}
+    report["matched_collectives"] = n_matched
+    if n_matched == 0:
+        problems.append("no matched collectives across processes")
+        report["verdict"] = "degraded: " + "; ".join(problems)
+        return report
+
+    shared = set(anchors[ids[0]])
+    for i in ids[1:]:
+        shared &= set(anchors[i])
+
+    # per-process phase maps for attribution
+    base_maps = {}
+    scope_maps = {}
+    for i in ids:
+        sm = (procs[i]["meta"] or {}).get("scope_map") or {}
+        scope_maps[i] = sm
+        base_maps[i] = _base_scope_map(sm) if sm else {}
+
+    per_proc = {i: {"coll_us": 0.0, "wait_us": 0.0,
+                    "caused_wait_us": 0.0, "straggler_hits": 0}
+                for i in ids}
+    phases: Dict[str, dict] = {}
+    transport_us_total = 0.0
+    for key in sorted(shared):
+        durs = {i: procs[i]["colls"][key]["dur"] for i in ids}
+        transport = min(durs.values())
+        transport_us_total += transport
+        waits = {i: durs[i] - transport for i in ids}
+        slow = min(ids, key=lambda i: durs[i])   # arrived last, waited least
+        caused = sum(waits.values())
+        phase = None
+        for i in ids:
+            phase = phase_of(procs[i]["colls"][key], scope_maps[i],
+                             base_maps[i])
+            if phase is not None:
+                break
+        ph = phases.setdefault(phase or "other", {
+            "matched": 0, "wait_ms": 0.0,
+            "caused_wait_us": {i: 0.0 for i in ids}})
+        ph["matched"] += 1
+        ph["wait_ms"] += caused / 1e3
+        ph["caused_wait_us"][slow] += caused
+        for i in ids:
+            per_proc[i]["coll_us"] += durs[i]
+            per_proc[i]["wait_us"] += waits[i]
+        per_proc[slow]["caused_wait_us"] += caused
+        per_proc[slow]["straggler_hits"] += 1
+
+    coll_us_total = sum(p["coll_us"] for p in per_proc.values())
+    wait_us_total = sum(p["wait_us"] for p in per_proc.values())
+    ranking = sorted(ids, key=lambda i: (-per_proc[i]["caused_wait_us"],
+                                         i))
+    report["transport_ms"] = round(transport_us_total / 1e3, 3)
+    report["wait_ms"] = round(wait_us_total / 1e3, 3)
+    report["skew_frac"] = round(wait_us_total / coll_us_total, 4) \
+        if coll_us_total > 0 else None
+    if per_proc[ranking[0]]["caused_wait_us"] > 0:
+        report["straggler"] = str(ranking[0])
+    for rank, i in enumerate(ranking):
+        pp = per_proc[i]
+        report["processes"][str(i)] = {
+            "dir": procs[i]["dir"],
+            "coll_ms": round(pp["coll_us"] / 1e3, 3),
+            "wait_ms": round(pp["wait_us"] / 1e3, 3),
+            "transport_ms": round(transport_us_total / 1e3, 3),
+            "skew_frac": round(pp["wait_us"] / pp["coll_us"], 4)
+            if pp["coll_us"] > 0 else None,
+            "wait_ms_per_iter": round(pp["wait_us"] / 1e3 / iters, 4)
+            if iters else None,
+            "caused_wait_ms": round(pp["caused_wait_us"] / 1e3, 3),
+            "straggler_hits": pp["straggler_hits"],
+            "straggler_rank": rank,
+        }
+    for name, ph in phases.items():
+        prank = sorted(ids, key=lambda i: (-ph["caused_wait_us"][i], i))
+        report["phases"][name] = {
+            "matched": ph["matched"],
+            "wait_ms": round(ph["wait_ms"], 3),
+            "straggler": str(prank[0])
+            if ph["caused_wait_us"][prank[0]] > 0 else None,
+            "ranking": [str(i) for i in prank],
+        }
+    if problems:
+        report["verdict"] = "degraded: " + "; ".join(problems)
+    return report
+
+
+# ----------------------------------------------------------------------
+# Rendering + telemetry emission
+# ----------------------------------------------------------------------
+
+def format_fleet_report(report: Dict[str, Any]) -> str:
+    """Human-readable fleet report (``pcg-tpu fleet-report``)."""
+    lines = [f"fleet report: {report['source']}",
+             f"  processes: {report['n_processes']}   "
+             f"matched collectives: {report['matched_collectives']}   "
+             f"iters: {report['iters'] if report['iters'] else '?'}"]
+    offs = report.get("clock_offsets_ms") or {}
+    if offs:
+        lines.append("  clock offsets vs p0 (ms): "
+                     + "  ".join(f"p{i}={offs[i]:+.3f}"
+                                 for i in sorted(offs, key=int)))
+    if report.get("skew_frac") is not None:
+        lines.append(f"  transport {report['transport_ms']:.3f} ms   "
+                     f"wait {report['wait_ms']:.3f} ms   "
+                     f"skew_frac {report['skew_frac']:.4f}")
+    procs = report.get("processes") or {}
+    if procs:
+        lines.append("  proc   coll_ms    wait_ms  skew_frac  "
+                     "wait_ms/iter  caused_ms  rank")
+        for i in sorted(procs, key=int):
+            p = procs[i]
+
+            def _f(v, fmt):
+                return format(v, fmt) if v is not None else "-"
+
+            lines.append(
+                f"  p{i:<4} {_f(p['coll_ms'], '9.3f')}  "
+                f"{_f(p['wait_ms'], '9.3f')}  {_f(p['skew_frac'], '9.4f')}  "
+                f"{_f(p.get('wait_ms_per_iter'), '12.4f')}  "
+                f"{_f(p.get('caused_wait_ms'), '9.3f')}  "
+                f"{_f(p.get('straggler_rank'), 'd')}")
+    for name in sorted(report.get("phases") or {}):
+        ph = report["phases"][name]
+        who = f"p{ph['straggler']}" if ph["straggler"] is not None \
+            else "none (balanced)"
+        lines.append(f"  phase {name:<10} matched {ph['matched']:>4}  "
+                     f"wait {ph['wait_ms']:9.3f} ms  straggler {who}")
+    if report.get("straggler") is not None:
+        lines.append(f"  straggler: p{report['straggler']}")
+    lines.append(f"  verdict: {report['verdict']}")
+    return "\n".join(lines)
+
+
+def emit_fleet_report(recorder, report: Dict[str, Any]) -> None:
+    """One schema-versioned ``fleet_report`` telemetry event + gauges."""
+    recorder.event(
+        "fleet_report", source=report["source"],
+        n_processes=report["n_processes"],
+        matched_collectives=report["matched_collectives"],
+        skew_frac=report["skew_frac"], straggler=report["straggler"],
+        processes=report["processes"], phases=report["phases"],
+        clock_offsets_ms=report["clock_offsets_ms"],
+        verdict=report["verdict"])
+    if report["skew_frac"] is not None:
+        recorder.gauge("fleet.skew_frac", report["skew_frac"])
+    for i, p in (report.get("processes") or {}).items():
+        if p.get("wait_ms_per_iter") is not None:
+            recorder.gauge(f"fleet.wait_ms_per_iter.p{i}",
+                           p["wait_ms_per_iter"])
+
+
+def bench_detail_fields(report: Dict[str, Any],
+                        process_index: int = 0) -> Dict[str, Any]:
+    """The ``detail.skew_frac`` / ``detail.straggler_rank`` bench fields
+    for THIS process, or ``{}`` when the capture carried no cross-process
+    skew (single process, no matched collectives) — a bench line must
+    never carry a measurement that was not taken."""
+    if report.get("skew_frac") is None:
+        return {}
+    p = (report.get("processes") or {}).get(str(process_index))
+    if p is None or p.get("straggler_rank") is None:
+        return {}
+    return {"skew_frac": report["skew_frac"],
+            "straggler_rank": p["straggler_rank"]}
+
+
+def load_fleet_report(path: str) -> Optional[Dict[str, Any]]:
+    """Read a previously saved fleet report JSON; None when absent or
+    not a fleet report."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            rep = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return rep if isinstance(rep, dict) \
+        and rep.get("schema") == FLEET_SCHEMA else None

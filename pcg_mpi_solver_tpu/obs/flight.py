@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from contextlib import contextmanager
@@ -284,12 +285,81 @@ def flight_verdict(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "last_wall": last_wall, "last_mono": last_mono}
 
 
+_SALVAGE_NUM_RE = {
+    k: re.compile(r'"%s"\s*:\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)' % k)
+    for k in ("t", "mono")}
+_SALVAGE_STR_RE = {
+    k: re.compile(r'"%s"\s*:\s*"([^"]*)"' % k) for k in ("kind", "op")}
+
+
+def salvage_truncated_tail(path: str) -> Optional[Dict[str, Any]]:
+    """Best-effort fields of a FINAL line that was cut mid-write.
+
+    A process killed mid-``write()`` leaves one truncated trailing line;
+    :func:`read_jsonl_tolerant` rightly skips it as unparseable — but
+    when that line is the stream's last heartbeat, dropping it makes the
+    shard look dead ``(write interval + heartbeat cadence)`` earlier
+    than it really was, and a stall monitor would flag a live run.  The
+    JSONL writers emit ``schema``/``t``/``kind`` first (metrics.event,
+    FlightRecorder.emit), so even a badly cut line usually still carries
+    the timestamp.  Returns ``{"t", "mono", "kind", "op", "salvaged":
+    True}`` (fields present only when recovered) for a trailing line
+    that starts like a record but does not parse; None when the file
+    ends with a complete line (or cannot be read)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 65536))
+            tail = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    lines = tail.splitlines()
+    if not lines:
+        return None
+    last = lines[-1].strip()
+    if not last or not last.startswith("{"):
+        return None
+    try:
+        json.loads(last)
+        return None                     # complete final line: nothing cut
+    except ValueError:
+        pass
+    out: Dict[str, Any] = {"salvaged": True}
+    for k, rx in _SALVAGE_NUM_RE.items():
+        m = rx.search(last)
+        if m:
+            out[k] = float(m.group(1))
+    for k, rx in _SALVAGE_STR_RE.items():
+        m = rx.search(last)
+        if m:
+            out[k] = m.group(1)
+    return out if len(out) > 1 else None
+
+
 def flight_verdict_path(path: str) -> Dict[str, Any]:
     """:func:`flight_verdict` of a file, tolerant of truncation; the
-    skipped-line count rides along as ``truncated_lines``."""
+    skipped-line count rides along as ``truncated_lines``.
+
+    A final heartbeat cut mid-write still counts as the stream's last
+    breath: its salvaged ``t``/``mono`` advance ``last_wall`` /
+    ``last_mono`` (flagged ``salvaged_tail``) so a shard killed while
+    writing its newest heartbeat is not read as having died a heartbeat
+    interval earlier than it did."""
     events, truncated = read_jsonl_tolerant(path)
     out = flight_verdict(events)
     out["truncated_lines"] = truncated
+    tail = salvage_truncated_tail(path)
+    if tail and tail.get("kind") == "flight":
+        t, mono = tail.get("t"), tail.get("mono")
+        if t is not None and (out["last_wall"] is None
+                              or t > out["last_wall"]):
+            out["last_wall"] = t
+            out["salvaged_tail"] = True
+        if mono is not None and (out["last_mono"] is None
+                                 or mono > out["last_mono"]):
+            out["last_mono"] = mono
+            out["salvaged_tail"] = True
     return out
 
 
@@ -392,7 +462,39 @@ def shard_jsonl_path(path: str, process_index: Optional[int] = None,
     return f"{root}.p{int(process_index)}{ext or '.jsonl'}"
 
 
-def merge_shards(paths: List[str], out_path: str) -> Dict[str, Any]:
+def dispatch_anchors(events: List[Dict[str, Any]]
+                     ) -> Dict[Tuple[str, int], float]:
+    """Matched-anchor completion times of one telemetry/flight shard for
+    clock alignment: every jitted dispatch is an SPMD program all
+    processes block on together, so the k-th completion of dispatch
+    ``name`` is the telemetry-granularity analogue of a collective end
+    event (the anchors obs/fleet.py aligns trace clocks with).  Keys are
+    ``(name, occurrence)`` over telemetry ``dispatch`` events and flight
+    ``end`` records of ``dispatch:*`` brackets; values are the wall
+    ``t``."""
+    anchors: Dict[Tuple[str, int], float] = {}
+    counts: Dict[str, int] = {}
+    for ev in events:
+        t = ev.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        kind = ev.get("kind")
+        name = None
+        if kind == "dispatch":
+            name = str(ev.get("name"))
+        elif kind == "flight" and ev.get("op") == "end" \
+                and str(ev.get("name", "")).startswith("dispatch:"):
+            name = str(ev.get("name"))
+        if name is None:
+            continue
+        k = counts.get(name, 0)
+        counts[name] = k + 1
+        anchors[(name, k)] = float(t)
+    return anchors
+
+
+def merge_shards(paths: List[str], out_path: str,
+                 align: Optional[str] = None) -> Dict[str, Any]:
     """Aggregate per-process telemetry/flight shards into ONE
     time-ordered JSONL stream.
 
@@ -407,8 +509,19 @@ def merge_shards(paths: List[str], out_path: str) -> Dict[str, Any]:
     position).  Truncated lines — the dead-tunnel signature — are
     SKIPPED and counted per shard, never raised on.
 
+    ``align="collectives"`` reuses the fleet clock-alignment
+    (obs/fleet.py :func:`~pcg_mpi_solver_tpu.obs.fleet.align_offsets`)
+    over matched dispatch completions (:func:`dispatch_anchors`): hosts
+    with skewed wall clocks would otherwise interleave out of true
+    order.  Each shard's median offset against shard 0 is subtracted
+    from its ordering key and stamped on its events as ``t_aligned``
+    (``t`` itself is never rewritten — provenance keeps the raw clock);
+    the offsets and matched-anchor count ride along in the returned
+    stats under ``align``.  With no matched anchors the mode degrades to
+    the plain ``t`` ordering (offsets 0) and says so.
+
     Returns ``{"events", "shards": {name: {"events", "truncated"}},
-    "truncated_lines"}``."""
+    "truncated_lines"[, "align"]}``."""
     base_counts: Dict[str, int] = {}
     for p in paths:
         b = os.path.basename(p)
@@ -421,19 +534,36 @@ def merge_shards(paths: List[str], out_path: str) -> Dict[str, Any]:
         n = name_counts.get(name, 0)
         name_counts[name] = n + 1
         names.append(f"{name}#{n}" if n else name)
-    merged: List[Tuple[float, int, int, Dict[str, Any]]] = []
+    per_shard: List[List[Dict[str, Any]]] = []
     stats: Dict[str, Dict[str, int]] = {}
     total_trunc = 0
     for si, p in enumerate(paths):
         events, truncated = read_jsonl_tolerant(p)
-        name = names[si]
-        stats[name] = {"events": len(events), "truncated": truncated}
+        per_shard.append(events)
+        stats[names[si]] = {"events": len(events), "truncated": truncated}
         total_trunc += truncated
+    offsets = {si: 0.0 for si in range(len(paths))}
+    align_stats = None
+    if align == "collectives":
+        from pcg_mpi_solver_tpu.obs.fleet import align_offsets
+
+        offsets, matched = align_offsets(
+            {si: dispatch_anchors(evs)
+             for si, evs in enumerate(per_shard)})
+        align_stats = {"mode": align, "matched_anchors": matched,
+                       "offsets_s": {names[si]: round(offsets[si], 6)
+                                     for si in range(len(paths))}}
+    merged: List[Tuple[float, int, int, Dict[str, Any]]] = []
+    for si, events in enumerate(per_shard):
+        name = names[si]
         for ei, ev in enumerate(events):
             t = ev.get("t")
-            key = float(t) if isinstance(t, (int, float)) else float("-inf")
+            key = float(t) - offsets[si] \
+                if isinstance(t, (int, float)) else float("-inf")
             ev = dict(ev)
             ev.setdefault("shard", name)
+            if align_stats is not None and key != float("-inf"):
+                ev["t_aligned"] = round(key, 6)
             merged.append((key, si, ei, ev))
     merged.sort(key=lambda r: (r[0], r[1], r[2]))
     d = os.path.dirname(os.path.abspath(out_path))
@@ -443,8 +573,11 @@ def merge_shards(paths: List[str], out_path: str) -> Dict[str, Any]:
         for _, _, _, ev in merged:
             f.write(json.dumps(ev, default=_jsonable) + "\n")
     os.replace(tmp, out_path)
-    return {"events": len(merged), "shards": stats,
-            "truncated_lines": total_trunc}
+    out = {"events": len(merged), "shards": stats,
+           "truncated_lines": total_trunc}
+    if align_stats is not None:
+        out["align"] = align_stats
+    return out
 
 
 def find_shards(path: str) -> List[str]:

@@ -368,7 +368,7 @@ def summarize_jsonl(path: str) -> str:
     present — the mechanical verdict (clean / failed / died-in-flight
     with the unclosed record names and last heartbeat)."""
     from pcg_mpi_solver_tpu.obs.flight import (
-        flight_verdict, read_jsonl_tolerant)
+        flight_verdict_path, read_jsonl_tolerant)
 
     events, truncated = read_jsonl_tolerant(path)
     lines = [f"{path}: {len(events)} event(s), "
@@ -428,7 +428,11 @@ def summarize_jsonl(path: str) -> str:
             lines.extend(f"gauge {k} = {gauges[k]}"
                          for k in sorted(gauges))
     if any(ev.get("kind") == "flight" for ev in events):
-        v = flight_verdict(events)
+        # flight_verdict_path folds a final heartbeat cut mid-write back
+        # into last_wall/last_mono (salvaged_tail): a shard killed while
+        # writing its newest beat must read as alive until then, not as
+        # having died a heartbeat interval earlier
+        v = flight_verdict_path(path)
         lines.append("")
         lines.append(f"flight verdict: {v['verdict']} "
                      f"({v['records']} record(s))")
@@ -441,7 +445,9 @@ def summarize_jsonl(path: str) -> str:
             lines.append(f"  expected descent: {msg}")
         if v["last_wall"] is not None:
             lines.append(f"  last record at t={v['last_wall']:.3f} "
-                         f"(mono {v['last_mono']})")
+                         f"(mono {v['last_mono']})"
+                         + (" [salvaged from the truncated final line]"
+                            if v.get("salvaged_tail") else ""))
     if truncated:
         lines.append(f"({truncated} truncated line(s) skipped — the "
                      "partial write of a killed process)")

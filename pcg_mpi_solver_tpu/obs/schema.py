@@ -131,6 +131,24 @@ EVENT_KINDS: Dict[str, tuple] = {
     # partition-build wall — the per-process record the setup ladder
     # aggregates and the sharded-warm-start tests assert on
     "setup_shard": ("parts", "n_parts", "cold", "partition_build_s"),
+    # one cross-process collective-skew attribution report (obs/fleet.py
+    # fleet_report / `pcg-tpu fleet-report`, ISSUE 16): per-process
+    # transport-vs-wait split over clock-aligned matched collectives,
+    # the fleet-wide skew fraction (null when the capture carried no
+    # cross-process skew — single process, no matched collectives), the
+    # named straggler, and the tolerant verdict
+    "fleet_report": ("source", "n_processes", "matched_collectives",
+                     "skew_frac", "verdict"),
+    # one live-monitor snapshot (obs/watch.py / `pcg-tpu watch`): the
+    # run's liveness status (running | stalled | done | empty), shard
+    # count, fleet-wide newest-record age, and the cost-model x
+    # observed-rate ETA (null with a named reason in the rendering)
+    "watch": ("path", "status", "n_shards", "silent_s", "eta_s"),
+    # the monitor's stall alarm: ALL shards' heartbeats silent past the
+    # threshold — `silent_s` is the newest record's age at detection,
+    # `in_flight` the union of unclosed flight brackets (what the run
+    # was doing when it wedged)
+    "stall": ("path", "silent_s", "threshold_s", "in_flight"),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }
@@ -174,6 +192,13 @@ BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 #  unprofiled legs, and on insurance/salvage lines emitted only when
 #  the capture actually ran before the failure — a line must never
 #  carry a measurement that was not taken.
+#  ``skew_frac`` / ``straggler_rank`` (ISSUE 16, obs/fleet.py) are the
+#  multi-controller PROFILED-leg fields: the fleet-wide fraction of
+#  collective time spent blocked on stragglers and THIS process's rank
+#  in the caused-wait ordering (0 = the straggler).  ABSENT (not null)
+#  on single-process captures and whenever the fleet report carried no
+#  matched collectives — same never-fabricate contract as the ISSUE 15
+#  fields above.
 BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "nrhs_planned", "dof_iter_rhs_per_s",
                         "nrhs_quarantined", "nrhs_recoveries",
@@ -182,7 +207,8 @@ BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "procs", "partition_build_s",
                         "partition_serial_s", "cold_setup_s",
                         "warm_setup_s", "ingest_peak_bytes",
-                        "measured_ms_per_iter_matvec", "overlap_frac")
+                        "measured_ms_per_iter_matvec", "overlap_frac",
+                        "skew_frac", "straggler_rank")
 # ``setup_cache``: warm-path partition attribution (cache/ subsystem).
 BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
 # ``pcg_variant``: the engaged PCG loop formulation of the line's

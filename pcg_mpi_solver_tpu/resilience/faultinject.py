@@ -11,7 +11,7 @@ or passed programmatically, e.g. ``Solver.fault_plan = FaultPlan(...)``):
 
     spec     := term ("," term)*
     term     := mode "@" ["s:" | "col:"] index ["*" count]
-    mode     := "kill" | "exc" | "nan" | "inf" | "rho0"
+    mode     := "kill" | "exc" | "nan" | "inf" | "rho0" | "sleep"
     index    := 0-based position in the mode's counter (see below);
                 with the "s:" prefix, the ABSOLUTE timestep number of a
                 time-history run; with the "col:" prefix, the COLUMN
@@ -27,7 +27,7 @@ be aimed at a later ladder rung):
   dispatch ("exc" fires *before* the dispatch with that index runs);
 * the BOUNDARY counter advances once per chunk boundary — after a direct
   chunk / mixed refinement cycle completes and any due snapshot is taken
-  ("kill" / "nan" / "inf" / "rho0" fire *at* that boundary);
+  ("kill" / "nan" / "inf" / "rho0" / "sleep" fire *at* that boundary);
 * the STEP domain ("s:" prefix — ``kill@s:3``, ``nan@s:5``) is indexed
   by the absolute completed-timestep number of a dynamics/Newmark time
   history (:meth:`FaultPlan.at_step`, driven by
@@ -67,16 +67,24 @@ Modes and the recovery path each one exercises:
           case: NO MATLAB flag trips on NaN (every breakdown predicate
           compares false), so this exercises the host-side NaN-carry
           detection, not the in-graph flags.
+``sleep`` ``time.sleep`` on the HOST at the chunk boundary (duration
+          ``FaultPlan.sleep_s``, env ``PCG_TPU_FAULT_SLEEP_S``, default
+          0.25 s) — the straggler simulator: not a failure at all, so
+          no recovery path fires, but on a multi-controller run every
+          OTHER process blocks at the next collective until this one
+          arrives.  The deterministic delayed-rank injection the
+          obs/fleet.py skew-attribution tests are built on.
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional
 
-MODES = ("kill", "exc", "nan", "inf", "rho0")
+MODES = ("kill", "exc", "nan", "inf", "rho0", "sleep")
 _DISPATCH_MODES = ("exc",)
-_BOUNDARY_MODES = ("kill", "nan", "inf", "rho0")
+_BOUNDARY_MODES = ("kill", "nan", "inf", "rho0", "sleep")
 _STEP_MODES = ("kill", "nan", "inf")
 _COL_MODES = ("nan", "inf", "rho0")
 
@@ -158,6 +166,11 @@ class FaultPlan:
         self.dispatches = 0         # completed Krylov dispatches
         self.boundaries = 0         # completed chunk boundaries
         self.fired: List[dict] = []  # (mode, point, index) audit trail
+        try:
+            self.sleep_s = float(
+                os.environ.get("PCG_TPU_FAULT_SLEEP_S", 0.25))
+        except ValueError:
+            self.sleep_s = 0.25     # straggler-delay duration ("sleep")
 
     @classmethod
     def from_env(cls, recorder=None) -> Optional["FaultPlan"]:
@@ -238,6 +251,12 @@ class FaultPlan:
         land."""
         idx = self.boundaries
         self.boundaries += 1
+        if self._take("sleep", idx):
+            # host-side straggler delay: fires BEFORE any poison/kill at
+            # this boundary — a delayed process still runs its chunk, it
+            # just arrives late at the next collective
+            self._fire("sleep", "boundary", idx)
+            time.sleep(self.sleep_s)
         for mode, leaf in (("nan", "r"), ("inf", "r"), ("rho0", "rho")):
             if leaf in carry and self._take(mode, idx):
                 self._fire(mode, "boundary", idx)

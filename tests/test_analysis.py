@@ -493,7 +493,7 @@ def test_rule_catalog_complete():
                 "donation-integrity", "fingerprint-completeness",
                 "recovery-paths", "recovery-coverage", "telemetry-schema",
                 "cost-model-completeness", "partition-key-components",
-                "scope-labels"}
+                "scope-labels", "doc-schema-sync"}
     assert expected <= set(rules)
     assert len(expected) >= 5
     # the pre-hardware-window gate covers the structural claims
@@ -503,6 +503,7 @@ def test_rule_catalog_complete():
     assert rules["cost-model-completeness"].fast
     assert rules["partition-key-components"].fast
     assert rules["scope-labels"].fast
+    assert rules["doc-schema-sync"].fast
     assert not rules["fingerprint-completeness"].fast
 
 

@@ -304,6 +304,29 @@ def log_profile_verdicts(path, prof_dir, since=None):
     except Exception as e:                              # noqa: BLE001
         log_line(path, f"overlap verdict unavailable "
                        f"({type(e).__name__}: {e}); continuing")
+    # Fleet skew verdict (ISSUE 16): on a multi-controller capture the
+    # p<idx>/ subdirs carry per-process traces — log the cross-process
+    # transport-vs-wait attribution next to the overlap verdict.  On the
+    # usual single-controller window this degrades to a named reason.
+    try:
+        from pcg_mpi_solver_tpu.obs import fleet
+
+        frep = fleet.fleet_report(prof_dir)
+        if frep.get("skew_frac") is not None:
+            who = (f"p{frep['straggler']}" if frep.get("straggler")
+                   is not None else "none (balanced)")
+            log_line(path, f"fleet verdict: skew_frac="
+                           f"{frep['skew_frac']:.4f} (wait "
+                           f"{frep['wait_ms']:.1f} ms vs transport "
+                           f"{frep['transport_ms']:.1f} ms over "
+                           f"{frep['matched_collectives']} matched "
+                           f"collectives), straggler {who} "
+                           "(read back: pcg-tpu fleet-report)")
+        else:
+            log_line(path, f"fleet verdict: n/a ({frep['verdict']})")
+    except Exception as e:                              # noqa: BLE001
+        log_line(path, f"fleet verdict unavailable "
+                       f"({type(e).__name__}: {e}); continuing")
     try:
         import glob as _glob
 
@@ -458,6 +481,16 @@ def run_priority_queue(path, quick: bool):
     run_step(path, "profiled flagship", ["bench.py"],
              env_extra=prof_env, timeout=3600)
     log_profile_verdicts(path, prof_dir, since=t_prof0)
+    # Watch smoke (ISSUE 16): one `pcg-tpu watch --once` snapshot of
+    # THIS session's own flight stream (the file run_step's brackets +
+    # heartbeats write), logged into the session — so a wedged hardware
+    # run is diagnosed from the session log (status/last-breath/in-
+    # flight names) instead of a dead tunnel.  Healthy session: the
+    # watch step itself is in flight, so the snapshot reads RUNNING.
+    run_step(path, "watch smoke (--once)",
+             ["-m", "pcg_mpi_solver_tpu.cli", "watch",
+              path + ".flight.jsonl", "--once"],
+             env_extra={"JAX_PLATFORMS": "cpu"}, timeout=600, gate_s=0)
     # MG A/B (ISSUE 10): classic+jacobi anchor vs classic+mg at an
     # even, multi-level-coarsenable size (150 halves once to 75 and
     # stops; 144 = 16*9 gives the 72/36/18/9 coarse chain), sharing the
