@@ -299,3 +299,145 @@ def recovery_coverage_rule(ctx) -> List[Finding]:
         findings.append(Finding(rule="recovery-coverage", loc=loc,
                                 message=msg))
     return findings
+
+
+# ----------------------------------------------------------------------
+# consensus-coverage: every host-side collective on the dispatch path
+# routes its verdict through parallel/consensus or carries a documented
+# exemption (ISSUE 18).
+# ----------------------------------------------------------------------
+
+#: Files swept for host-side collective call sites.  The dispatch path
+#: only: setup-layer collectives (``parallel/partition.py`` glue
+#: exchanges, ``cache/partition_cache.py``) run once before any Krylov
+#: loop and already route their gate verdicts through the consensus
+#: module by construction.
+CONSENSUS_COVERAGE_FILES = (
+    "pcg_mpi_solver_tpu/solver/driver.py",
+    "pcg_mpi_solver_tpu/solver/chunked.py",
+    "pcg_mpi_solver_tpu/resilience/engine.py",
+)
+
+#: Host-collective call names that pair blocking rounds across
+#: processes: a divergent branch around ANY of these wedges the fleet.
+#: Deliberately NOT ``warmup`` — ``ChunkedEngine.warmup`` is the
+#: unrelated compile-warmup method and would shadow every sweep.
+COLLECTIVE_CALL_NAMES = frozenset(
+    {"allreduce", "allreduce_many", "allreduce_groups",
+     "process_allgather", "sync_global_devices"})
+
+#: (file, function) -> coverage requirement, the RECOVERY_SURFACES
+#: shape: ``calls:<name>`` — the function must invoke that
+#: ``parallel/consensus`` primitive (or the chunk-boundary liveness
+#: sync), the positive proof its group verdict cannot diverge;
+#: ``exempt`` — the function must carry a ``consensus-exempt:`` comment
+#: documenting why no verdict needs agreement (an unconditional data
+#: gather or plain barrier that every process reaches).
+CONSENSUS_SITES = {
+    # engage decision gates collective code paths -> agree_flag
+    ("pcg_mpi_solver_tpu/solver/driver.py", "__init__"):
+        "calls:agree_flag",
+    # pallas-probe allgather: unconditional, AND-reduced on every rank
+    ("pcg_mpi_solver_tpu/solver/driver.py", "_pallas_enabled"): "exempt",
+    # export-glue layout exchange: unconditional data movement
+    ("pcg_mpi_solver_tpu/solver/driver.py", "_exchange_export_glue"):
+        "exempt",
+    # runstore-prepared barrier: no verdict, every process reaches it
+    ("pcg_mpi_solver_tpu/solver/driver.py", "solve"): "exempt",
+    # chunk loops open every iteration with the guarded liveness sync
+    ("pcg_mpi_solver_tpu/solver/chunked.py", "run"):
+        "calls:sync_boundary",
+    # scalar ladder triggers are group-agreed before branching
+    ("pcg_mpi_solver_tpu/resilience/engine.py", "run_with_recovery"):
+        "calls:agree_trigger",
+    # per-column triggers (quarantine/ladder masks) likewise
+    ("pcg_mpi_solver_tpu/resilience/engine.py",
+     "run_many_with_recovery"): "calls:agree_triggers",
+}
+
+
+def _has_collective_call(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            got = (f.attr if isinstance(f, ast.Attribute)
+                   else getattr(f, "id", ""))
+            if got in COLLECTIVE_CALL_NAMES:
+                return True
+    return False
+
+
+def check_consensus_coverage(sources) -> List[str]:
+    """Coverage violations for ``{relpath: source}`` (the rule feeds the
+    real files; tests feed seeded-violation sources)."""
+    errs: List[str] = []
+    for rel, source in sources.items():
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            errs.append(f"{rel}:0: unparseable ({e})")
+            continue
+        lines = source.splitlines()
+        for fn in _top_level_functions(tree):
+            key = (rel, fn.name)
+            req = CONSENSUS_SITES.get(key)
+            if _has_collective_call(fn):
+                if req is None:
+                    errs.append(
+                        f"{rel}:{fn.lineno}: `{fn.name}` calls a "
+                        "host-side collective but is not registered in "
+                        "CONSENSUS_SITES — route its verdict through "
+                        "parallel/consensus (agree / agree_flag / "
+                        "agree_trigger / agree_triggers) and register "
+                        "it, or register a documented exemption")
+                    continue
+            if req is None:
+                continue
+            if req.startswith("calls:"):
+                want = req.split(":", 1)[1]
+                if not _calls_name(fn, want):
+                    errs.append(
+                        f"{rel}:{fn.lineno}: collective site "
+                        f"`{fn.name}` no longer calls its registered "
+                        f"consensus primitive `{want}` — a divergent "
+                        "group verdict wedges the fleet")
+            elif req == "exempt":
+                seg = "\n".join(
+                    lines[fn.lineno - 1:fn.end_lineno or fn.lineno])
+                if "consensus-exempt:" not in seg:
+                    errs.append(
+                        f"{rel}:{fn.lineno}: collective site "
+                        f"`{fn.name}` is registered exempt but carries "
+                        "no `consensus-exempt:` comment — document why "
+                        "the verdict needs no agreement, or route it "
+                        "through parallel/consensus")
+        names = {fn.name for fn in _top_level_functions(tree)}
+        for (f, name), _req in CONSENSUS_SITES.items():
+            if f == rel and name not in names:
+                errs.append(
+                    f"{rel}:0: CONSENSUS_SITES registers `{name}` but "
+                    "no such function exists — update the registry")
+    return errs
+
+
+@rule("consensus-coverage", kind="ast", fast=True,
+      doc="every host-side collective call site on the dispatch path "
+          "(driver.py / chunked.py / resilience engine) routes its "
+          "group verdict through parallel/consensus or carries a "
+          "documented `consensus-exempt:` justification")
+def consensus_coverage_rule(ctx) -> List[Finding]:
+    sources = {}
+    for rel in CONSENSUS_COVERAGE_FILES:
+        path = os.path.join(REPO, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError as e:
+            return [Finding(rule="consensus-coverage", loc=rel,
+                            message=f"unreadable ({e})")]
+    findings = []
+    for err in check_consensus_coverage(sources):
+        loc, _, msg = err.partition(": ")
+        findings.append(Finding(rule="consensus-coverage", loc=loc,
+                                message=msg))
+    return findings

@@ -191,9 +191,12 @@ def cached_partition_shards(cache_dir: str, *, glue_key: str,
         legacy_pm = load_partition(cache_dir, legacy_key)
     can_serve = shard_warm or legacy_pm is not None
     if comm is not None and getattr(comm, "n_procs", 1) > 1:
-        (agreed,), = comm.allreduce_groups(
-            [([np.asarray([int(can_serve)], dtype=np.int64)], "min")])
-        can_serve = bool(int(agreed[0]))
+        # warm/cold GATES collective code paths — group-agreed (min:
+        # all ranks must be able to serve warm) via the shared
+        # consensus primitive (parallel/consensus, ISSUE 18)
+        from pcg_mpi_solver_tpu.parallel.consensus import agree_flag
+
+        can_serve = agree_flag(comm, can_serve)
     if can_serve and shard_warm:
         pm = join(glue, shards)
         if recorder is not None:

@@ -182,10 +182,13 @@ def _add_preflight_flag(p) -> None:
                         "(env default: PCG_TPU_PREFLIGHT)")
 
 
-def _add_resilience_flags(p, granularity: str) -> None:
+def _add_resilience_flags(p, granularity: str,
+                          elastic: bool = False) -> None:
     """--snapshot-every / --max-recoveries / --resume, shared by the
     solve, dynamics and newmark subcommands; ``granularity`` names what
-    one snapshot interval means on that path."""
+    one snapshot interval means on that path.  ``elastic`` additionally
+    exposes --resume-elastic (the quasi-static driver only — the path
+    ``Solver.resume_elastic`` serves)."""
     p.add_argument("--snapshot-every", type=int, default=0,
                    help=f"resumable snapshots (resilience/): persist "
                         f"state every N {granularity} so a "
@@ -200,6 +203,18 @@ def _add_resilience_flags(p, granularity: str) -> None:
     p.add_argument("--resume", action="store_true",
                    help=f"continue from the latest snapshot/checkpoint "
                         f"of this run ({granularity} granularity)")
+    if not elastic:
+        return
+    p.add_argument("--resume-elastic", default=None, metavar="DIR",
+                   nargs="?", const="",
+                   help="resume a MULTI-PROCESS run's committed snapshot "
+                        "epochs / checkpoints on THIS (typically smaller) "
+                        "process count (resilience/distributed, ISSUE "
+                        "18): re-joins the group-consistent shards and "
+                        "accepts the n_procs fingerprint mismatch as a "
+                        "named elastic_resume event.  DIR = the dead "
+                        "fleet's checkpoint dir (default: this config's "
+                        "checkpoint path)")
 
 
 def _add_telemetry_flags(p) -> None:
@@ -278,8 +293,12 @@ def cmd_solve(args):
                elem_part=elem_part, backend=args.backend)
     print(f">backend: {s.backend}")
     store = RunStore(cfg.result_path, cfg.model_name)
-    res = s.solve(store=None if cfg.speed_test else store,
-                  resume=bool(args.resume))
+    out_store = None if cfg.speed_test else store
+    if getattr(args, "resume_elastic", None) is not None:
+        res = s.resume_elastic(args.resume_elastic or None,
+                               store=out_store)
+    else:
+        res = s.solve(store=out_store, resume=bool(args.resume))
     # With --resume, earlier steps were restored: label only the ones run.
     t_first = len(s.flags) - len(res) + 1
     for t, r in enumerate(res, t_first):
@@ -989,7 +1008,7 @@ def main(argv=None):
                         "(reference SpeedTestFlag)")
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="write a solver checkpoint every N time steps")
-    _add_resilience_flags(p, "mid-Krylov chunk boundaries")
+    _add_resilience_flags(p, "mid-Krylov chunk boundaries", elastic=True)
     p.add_argument("--backend",
                    choices=["auto", "structured", "hybrid", "general"],
                    default="auto",

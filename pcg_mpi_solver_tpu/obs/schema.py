@@ -149,6 +149,21 @@ EVENT_KINDS: Dict[str, tuple] = {
     # `in_flight` the union of unclosed flight brackets (what the run
     # was doing when it wedged)
     "stall": ("path", "silent_s", "threshold_s", "in_flight"),
+    # a deadline-guarded host collective expired
+    # (resilience/distributed.GuardedComm, ISSUE 18): which labelled
+    # round stalled, the configured deadline, and the most
+    # flight-silent peer rank (-1 when no peer shard was readable) —
+    # the record a DeadPeerError post-mortem starts from
+    "collective_timeout": ("label", "deadline_s", "suspect"),
+    # one group-consistent snapshot epoch
+    # (resilience/distributed.GroupSnapshotStore two-phase commit):
+    # epoch number, in-flight step, shard count, and whether the commit
+    # marker was (or will be) published; op="restore" on the read side
+    "snapshot_epoch": ("epoch", "step", "shards", "committed"),
+    # an armed elastic resume accepted an ``n_procs`` fingerprint
+    # mismatch (Solver.resume_elastic): the writing fleet's process
+    # count, this fleet's, and which store took it (snap | many | ckpt)
+    "elastic_resume": ("from_procs", "to_procs", "prefix"),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }

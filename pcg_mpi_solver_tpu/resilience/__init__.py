@@ -22,13 +22,24 @@ than report them:
   implicit Newmark stepper and the explicit dynamics driver;
 * deterministic fault injection so every path above is exercised in
   tier-1 on CPU (:mod:`pcg_mpi_solver_tpu.resilience.faultinject`),
-  including the step domain (``kill@s:N``) for time histories.
+  including the step domain (``kill@s:N``) for time histories and the
+  rank domain (``kill@rank:R:N``) for multi-process chaos runs;
+* multi-process fault tolerance (ISSUE 18,
+  :mod:`pcg_mpi_solver_tpu.resilience.distributed`): deadline-guarded
+  host collectives that turn a dead peer into a named
+  :class:`~pcg_mpi_solver_tpu.resilience.distributed.DeadPeerError`
+  in bounded time, group-consistent two-phase snapshot epochs, and
+  elastic resume of an N-process run onto M processes
+  (``Solver.resume_elastic``).
 
 Import contract: jax-free at module load (the fault poisoners and the
 state put/fetch closures import jax lazily), matching ``cache/`` and
 ``obs/``.
 """
 
+from pcg_mpi_solver_tpu.resilience.distributed import (
+    DeadPeerError, GroupSnapshotStore, GuardedComm,
+    collective_deadline_s, suspect_dead_rank)
 from pcg_mpi_solver_tpu.resilience.engine import (
     ManyRecoveryHooks, RecoveryHooks, TimeHistoryGuard,
     kinematic_state_io, run_many_with_recovery, run_with_recovery)
@@ -42,7 +53,12 @@ __all__ = [
     "FaultPlan",
     "InjectedDispatchError",
     "SimulatedKill",
+    "DeadPeerError",
     "DispatchGuard",
+    "GroupSnapshotStore",
+    "GuardedComm",
+    "collective_deadline_s",
+    "suspect_dead_rank",
     "ManyRecoveryHooks",
     "RecoveryHooks",
     "RecoveryLadder",

@@ -29,6 +29,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from pcg_mpi_solver_tpu.parallel.consensus import (
+    agree_trigger, agree_triggers)
 from pcg_mpi_solver_tpu.resilience.recovery import (
     RecoveryLadder, breakdown_trigger, column_trigger, is_device_loss)
 
@@ -83,6 +85,7 @@ def run_with_recovery(engine, data, fext, carry, normr0, n2b, prec, *,
     """
     rec = recorder
     note = rec.note if rec is not None else (lambda s: None)
+    comm = getattr(resilience, "comm", None)
     eng, eng_data, eng_prec = engine, data, prec
     ladder = None
     total = int(total0)
@@ -101,6 +104,16 @@ def run_with_recovery(engine, data, fext, carry, normr0, n2b, prec, *,
             if scfg.max_recoveries <= 0 or not is_device_loss(e):
                 raise
             trigger, restart_x, err = "device_loss", None, e
+        # group consensus: every rank must take the SAME ladder branch
+        # (a divergent branch pairs a live collective against a missing
+        # one and wedges the fleet) — max-reduce the encoded triggers so
+        # one rank's breakdown drives every rank's ladder in lockstep
+        trigger = agree_trigger(comm, trigger)
+        if trigger == "device_loss" and err is None:
+            # another rank lost a device: this rank's carry is fine but
+            # the group restart must be identical everywhere, and only
+            # the cold start state is rank-independently reconstructible
+            restart_x = None
         if trigger is None:
             break
         if ladder is None:
@@ -111,6 +124,14 @@ def run_with_recovery(engine, data, fext, carry, normr0, n2b, prec, *,
         if action is None:              # recovery budget spent
             if err is not None:
                 raise err
+            if trigger == "device_loss":
+                # group-agreed loss seen on ANOTHER rank: this rank has
+                # no local exception to re-raise, but returning normally
+                # while the failing rank raises would diverge the fleet
+                raise RuntimeError(
+                    "group-agreed device loss with the recovery budget "
+                    f"spent ({ladder.attempt} attempts); the failing "
+                    "rank carries the original error")
             note(f"recovery budget exhausted ({ladder.attempt} "
                  f"attempts); reporting flag={flag} relres={relres:.3e}")
             break
@@ -257,6 +278,12 @@ def run_many_with_recovery(carry, *, scfg, nrhs: int, hooks, recorder,
     drift_prev = np.zeros(R, np.int64)
     x_fin = carry["x"]
     while np.any(flags == 1) and total < max_iter:
+        # group liveness first, OUTSIDE the dispatch guard: a dead peer
+        # must surface as DeadPeerError (named, bounded by the deadline)
+        # rather than as an XLA collective hanging inside the dispatch
+        # and being misread as a retryable device loss
+        if resilience is not None:
+            resilience.sync_boundary()
         try:
             if faults is not None:
                 faults.on_dispatch()
@@ -305,6 +332,16 @@ def run_many_with_recovery(carry, *, scfg, nrhs: int, hooks, recorder,
             t = column_trigger(int(flags[k]), float(normr[k]))
             if t is not None:
                 triggers[k] = t
+        # group consensus: one packed max-reduce so every rank drives
+        # the SAME per-column ladders (divergent restart/quarantine
+        # masks would change the jitted recover dispatch shape on one
+        # rank only and wedge the next collective)
+        comm = getattr(resilience, "comm", None)
+        if comm is not None and getattr(comm, "n_procs", 1) > 1:
+            triggers = {k: t
+                        for k, t in agree_triggers(comm, triggers,
+                                                   R).items()
+                        if k not in quarantined}
         if triggers:
             restart_m = np.zeros(R, bool)
             fb_m = np.zeros(R, bool)

@@ -10,16 +10,20 @@ A :class:`FaultPlan` is parsed from a spec string (env ``PCG_TPU_FAULTS``
 or passed programmatically, e.g. ``Solver.fault_plan = FaultPlan(...)``):
 
     spec     := term ("," term)*
-    term     := mode "@" ["s:" | "col:"] index ["*" count]
+    term     := mode "@" ["s:" | "col:" | "rank:" rank ":"] index
+                ["*" count]
     mode     := "kill" | "exc" | "nan" | "inf" | "rho0" | "sleep"
     index    := 0-based position in the mode's counter (see below);
                 with the "s:" prefix, the ABSOLUTE timestep number of a
                 time-history run; with the "col:" prefix, the COLUMN
-                index of a blocked multi-RHS solve
+                index of a blocked multi-RHS solve; with the "rank:"
+                prefix, the dispatch/boundary counter index on process
+                ``rank`` only (omitted index = 0: ``kill@rank:1`` ==
+                ``kill@rank:1:0``)
     count    := consecutive firings (default 1; "exc@3*2" also fails the
                 first retry of dispatch 3)
 
-Four counter domains.  The first two are monotone over the life of the
+Five counter domains.  The first two are monotone over the life of the
 plan (they keep running across recovery restarts, so a second fault can
 be aimed at a later ladder rung):
 
@@ -47,7 +51,17 @@ be aimed at a later ladder rung):
   in tier-1 while every other column stays bit-identical (the poison is
   a ``jnp.where`` column select, never a whole-block rescale).
   ``*count`` re-fires it at that many consecutive boundaries to defeat
-  a bounded per-column recovery budget.
+  a bounded per-column recovery budget;
+* the RANK domain ("rank:" prefix — ``kill@rank:1``, ``exc@rank:0``,
+  ``sleep@rank:1:3``) gates a dispatch/boundary-counter fault on ONE
+  process of a multi-controller run, so distributed chaos drills are
+  deterministic: every process parses the same spec
+  (``PCG_TPU_FAULTS`` is shared), but the fault fires only where
+  ``jax.process_index()`` matches.  ``exc`` rides the dispatch
+  counter, the other modes the boundary counter, exactly like their
+  unprefixed twins.  A rank at/past ``jax.process_count()`` follows
+  the cannot-land contract (neither consumed nor recorded), same as a
+  column fault aimed past the block width.
 
 Modes and the recovery path each one exercises:
 
@@ -105,11 +119,13 @@ class InjectedDispatchError(RuntimeError):
 
 def _parse(spec: str):
     """spec string -> ({mode: {index: count}}, {mode: {step: count}},
-    {mode: {col: count}}) for the dispatch/boundary domains, the step
-    domain, and the per-column domain of blocked multi-RHS solves."""
+    {mode: {col: count}}, {mode: {(rank, index): count}}) for the
+    dispatch/boundary domains, the step domain, the per-column domain
+    of blocked multi-RHS solves, and the per-process rank domain."""
     out: Dict[str, Dict[int, int]] = {}
     steps: Dict[str, Dict[int, int]] = {}
     cols: Dict[str, Dict[int, int]] = {}
+    ranks: Dict[str, Dict[tuple, int]] = {}
     for term in (t.strip() for t in spec.split(",")):
         if not term:
             continue
@@ -122,20 +138,31 @@ def _parse(spec: str):
             rest = rest.strip()
             step_domain = rest.startswith("s:")
             col_domain = rest.startswith("col:")
-            idx = int(rest[4:] if col_domain
-                      else rest[2:] if step_domain else rest)
+            rank_domain = rest.startswith("rank:")
+            rank = None
+            if rank_domain:
+                bits = rest[len("rank:"):].split(":")
+                if len(bits) > 2:
+                    raise ValueError(rest)
+                rank = int(bits[0])
+                idx = int(bits[1]) if len(bits) > 1 else 0
+            else:
+                idx = int(rest[4:] if col_domain
+                          else rest[2:] if step_domain else rest)
         except ValueError:
             raise ValueError(
                 f"bad fault term {term!r} "
-                "(want mode@[s:|col:]index[*count])")
+                "(want mode@[s:|col:|rank:R:]index[*count])")
         mode = mode.strip()
         if mode not in MODES:
             raise ValueError(f"unknown fault mode {mode!r} "
                              f"(valid: {', '.join(MODES)})")
-        if idx < 0 or count < 1:
-            raise ValueError(f"bad fault term {term!r}: index >= 0, "
-                             f"count >= 1")
-        if step_domain:
+        if idx < 0 or count < 1 or (rank is not None and rank < 0):
+            raise ValueError(f"bad fault term {term!r}: rank >= 0, "
+                             f"index >= 0, count >= 1")
+        if rank_domain:
+            ranks.setdefault(mode, {})[(rank, idx)] = count
+        elif step_domain:
             if mode not in _STEP_MODES:
                 raise ValueError(
                     f"fault mode {mode!r} has no step-domain trigger "
@@ -149,7 +176,7 @@ def _parse(spec: str):
             cols.setdefault(mode, {})[idx] = count
         else:
             out.setdefault(mode, {})[idx] = count
-    return out, steps, cols
+    return out, steps, cols, ranks
 
 
 class FaultPlan:
@@ -161,7 +188,8 @@ class FaultPlan:
     """
 
     def __init__(self, spec: str, recorder=None):
-        self._faults, self._step_faults, self._col_faults = _parse(spec)
+        (self._faults, self._step_faults, self._col_faults,
+         self._rank_faults) = _parse(spec)
         self.recorder = recorder
         self.dispatches = 0         # completed Krylov dispatches
         self.boundaries = 0         # completed chunk boundaries
@@ -181,7 +209,7 @@ class FaultPlan:
     @property
     def armed(self) -> bool:
         return (any(self._faults.values()) or self.step_armed
-                or self.col_armed)
+                or self.col_armed or any(self._rank_faults.values()))
 
     @property
     def step_armed(self) -> bool:
@@ -210,6 +238,43 @@ class FaultPlan:
             del pending[idx]
         return True
 
+    @staticmethod
+    def _process_slot():
+        """``(process_index, process_count)`` of an ALREADY-IMPORTED
+        jax (never importing it here — faultinject stays import-light),
+        defaulting to the single-process identity."""
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return 0, 1
+        try:
+            return int(jax.process_index()), int(jax.process_count())
+        except Exception:                               # noqa: BLE001
+            return 0, 1     # backend not initialized: single-process
+
+    def _take_rank(self, mode: str, idx: int) -> bool:
+        """Consume a pending rank-domain fault of ``mode`` at counter
+        position ``idx`` aimed at THIS process; True when it fires
+        here.  A rank at/past the process count cannot land — neither
+        consumed nor recorded (cannot-land contract); a fault aimed at
+        a DIFFERENT live rank stays pending on this process (its plan
+        never fires it, but `armed` must keep every process's
+        resilience context engaged for the collective snapshot/resume
+        protocol)."""
+        pending = self._rank_faults.get(mode, {})
+        here, n_procs = self._process_slot()
+        for rank, at in sorted(pending):
+            if at != idx or pending[(rank, at)] <= 0:
+                continue
+            if rank >= n_procs or rank != here:
+                continue
+            pending[(rank, at)] -= 1
+            if pending[(rank, at)] <= 0:
+                del pending[(rank, at)]
+            return True
+        return False
+
     def _fire(self, mode: str, point: str, idx: int) -> None:
         self.fired.append({"mode": mode, "point": point, "at": idx})
         if self.recorder is not None:
@@ -227,6 +292,11 @@ class FaultPlan:
             raise InjectedDispatchError(
                 f"injected device loss before dispatch {idx} "
                 "(PCG_TPU_FAULTS)")
+        if self._take_rank("exc", idx):
+            self._fire("exc", "rank-dispatch", idx)
+            raise InjectedDispatchError(
+                f"injected device loss before dispatch {idx} on this "
+                "process (PCG_TPU_FAULTS rank domain)")
 
     def on_dispatch_done(self) -> None:
         """Called after a dispatch completes successfully."""
@@ -257,9 +327,15 @@ class FaultPlan:
             # just arrives late at the next collective
             self._fire("sleep", "boundary", idx)
             time.sleep(self.sleep_s)
+        if self._take_rank("sleep", idx):
+            self._fire("sleep", "rank-boundary", idx)
+            time.sleep(self.sleep_s)
         for mode, leaf in (("nan", "r"), ("inf", "r"), ("rho0", "rho")):
             if leaf in carry and self._take(mode, idx):
                 self._fire(mode, "boundary", idx)
+                carry = _poison(carry, mode)
+            if leaf in carry and self._take_rank(mode, idx):
+                self._fire(mode, "rank-boundary", idx)
                 carry = _poison(carry, mode)
         if blocked:
             # block width from the carry itself: a column fault aimed
@@ -281,6 +357,11 @@ class FaultPlan:
             self._fire("kill", "boundary", idx)
             raise SimulatedKill(
                 f"injected kill at chunk boundary {idx} (PCG_TPU_FAULTS)")
+        if self._take_rank("kill", idx):
+            self._fire("kill", "rank-boundary", idx)
+            raise SimulatedKill(
+                f"injected kill at chunk boundary {idx} on this process "
+                "(PCG_TPU_FAULTS rank domain)")
         return carry
 
     def _take_col(self, mode: str, col: int) -> bool:

@@ -208,7 +208,7 @@ class ResilienceContext:
                  guard: Optional[DispatchGuard] = None,
                  faults: Optional[FaultPlan] = None,
                  recorder=None, resume: bool = False,
-                 ladder_armed: bool = False):
+                 ladder_armed: bool = False, comm=None):
         self.store = store
         self.step = int(step)
         self.snapshot_every = int(snapshot_every)
@@ -217,12 +217,34 @@ class ResilienceContext:
         self.guard = guard
         self.faults = faults
         self.recorder = recorder
+        # deadline-guarded host-collective group of a multi-process run
+        # (resilience.distributed.GuardedComm), or None: drives the
+        # chunk-boundary liveness sync and the consensus agreements of
+        # the recovery engine
+        self.comm = comm
         # whether the driver will actually consume engine.restart_x — the
         # engine skips the per-cycle restart-iterate copy otherwise
         self.ladder_armed = bool(ladder_armed)
         self._allow_resume = bool(resume)
         self._mem: Optional[Dict[str, Any]] = None   # last good host state
         self._since_snapshot = 0
+
+    # -- group liveness -------------------------------------------------
+    def sync_boundary(self) -> None:
+        """Chunk-boundary liveness probe of a multi-process run: one
+        tiny deadline-guarded collective at the TOP of each chunk
+        iteration, OUTSIDE the dispatch try/except — a dead peer
+        surfaces as a named DeadPeerError in bounded time (never an
+        infinite psum hang, never a dispatch-guard retry), before any
+        device work of the next chunk is enqueued.  No-op without a
+        multi-process comm."""
+        comm = self.comm
+        if comm is None or getattr(comm, "n_procs", 1) <= 1:
+            return
+        if hasattr(comm, "barrier"):
+            comm.barrier("chunk_boundary")
+        else:
+            comm.allreduce(np.ones(1, dtype=np.int64), "min")
 
     # -- snapshots ------------------------------------------------------
     def load_resume_state(self) -> Optional[Dict[str, Any]]:

@@ -405,6 +405,12 @@ class ChunkedEngine:
                             and resilience.ladder_armed)
             good_x = jnp.copy(x) if keep_restart else None
             while flag == 1 and total < scfg.max_iter:
+                # group liveness first, OUTSIDE the dispatch guard: a
+                # dead peer surfaces as a named DeadPeerError within the
+                # deadline instead of an XLA collective hanging inside
+                # the refinement dispatch
+                if resilience is not None:
+                    resilience.sync_boundary()
                 prev = cur
                 try:
                     # One refinement cycle: run the f32 inner solve to ITS
@@ -553,6 +559,12 @@ class ChunkedEngine:
                 carry, total, relres = _restore_direct(resume)
                 x_fin = carry["x"]
             while flag == 1 and total < scfg.max_iter:
+                # group liveness first, OUTSIDE the dispatch guard: a
+                # dead peer surfaces as a named DeadPeerError within the
+                # deadline instead of an XLA collective hanging inside
+                # the cycle dispatch being misread as device loss
+                if resilience is not None:
+                    resilience.sync_boundary()
                 budget = jnp.asarray(scfg.max_iter - total, jnp.int32)
                 try:
                     if faults is not None:

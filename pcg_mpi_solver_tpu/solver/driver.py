@@ -97,6 +97,8 @@ def _pallas_enabled(mode: str, mesh, shapes=()) -> bool:
             # fingerprint would only record the primary's choice).
             from jax.experimental import multihost_utils
 
+            # consensus-exempt: unconditional data gather reached by
+            # every process (the AND below is itself the agreement)
             all_ok = multihost_utils.process_allgather(
                 np.asarray([ok], dtype=bool))
             ok = bool(np.all(all_ok))
@@ -357,10 +359,10 @@ class Solver:
             # a split decision deadlocks the group on its first
             # unmatched collective.  Every process reaches this reduce
             # (the inputs above are process-invariant).
+            from pcg_mpi_solver_tpu.parallel.consensus import agree_flag
+
             comm = HostComm()
-            (agreed,), = comm.allreduce_groups(
-                [([np.asarray([int(ok)], dtype=np.int64)], "min")])
-            if bool(int(agreed[0])):
+            if agree_flag(comm, ok):
                 self._setup_range = rng
                 self._setup_comm = comm
                 from pcg_mpi_solver_tpu.parallel.partition import (
@@ -776,6 +778,9 @@ class Solver:
         self._resume_pending = False     # solve(resume=True) arms mid-step
         #                                  snapshot resume for its steps
         self._snap_store = None          # lazy: fingerprints the model once
+        self._group_comm = None          # lazy: deadline-guarded HostComm
+        self._elastic_dir = None         # resume_elastic() arms the named
+        #                                  n_procs-mismatch resume path
         self._many_progs = {}            # nrhs -> jitted blocked programs
         self._many_snap = {}             # nrhs -> blocked snapshot store
         self._restart_post_fn = None     # lazy: ladder restart program
@@ -870,6 +875,8 @@ class Solver:
             [arrs[n][lo:hi].ravel().astype(np.float64)
              for n in ("weight", "node_weight") if n in arrs]
             or [np.zeros(0)])
+        # consensus-exempt: unconditional layout data exchange — every
+        # engaged process reaches both gathers (engage is group-agreed)
         g_int = np.asarray(mh.process_allgather(ints))
         g_flt = np.asarray(mh.process_allgather(flts))
         for proc in range(g_int.shape[0]):
@@ -1357,25 +1364,71 @@ class Solver:
     # ------------------------------------------------------------------
     # Resilience subsystem (resilience/): context + recovery programs
     # ------------------------------------------------------------------
+    def _collective_comm(self):
+        """Deadline-guarded host-collective group for the dispatch path
+        (resilience/distributed.GuardedComm), cached; None single-process
+        or when no deadline is armed (PCG_TPU_COLLECTIVE_DEADLINE_S
+        unset) — the guard is opt-in because a watchdog thread per
+        collective is pure overhead on a healthy fleet."""
+        if jax.process_count() <= 1:
+            return None
+        from pcg_mpi_solver_tpu.resilience.distributed import (
+            GuardedComm, collective_deadline_s)
+
+        deadline = collective_deadline_s()
+        if deadline is None:
+            return None
+        if self._group_comm is None:
+            from pcg_mpi_solver_tpu.parallel.distributed import HostComm
+
+            self._group_comm = GuardedComm(
+                self._setup_comm or HostComm(), deadline_s=deadline,
+                recorder=self._rec, index=jax.process_index())
+        return self._group_comm
+
+    def _snapshot_store(self):
+        """Per-step mid-Krylov snapshot store (lazy).  Multi-process —
+        or an armed elastic resume reading a multi-process epoch — gets
+        the group-consistent epoch store (two-phase commit markers,
+        resilience/distributed.GroupSnapshotStore); single-process keeps
+        the plain per-file SnapshotStore."""
+        if self._snap_store is None:
+            if jax.process_count() > 1 or self._elastic_dir is not None:
+                from pcg_mpi_solver_tpu.resilience.distributed import (
+                    GroupSnapshotStore)
+
+                self._snap_store = GroupSnapshotStore.for_solver(
+                    self, comm=self._collective_comm(),
+                    recorder=self._rec,
+                    elastic=self._elastic_dir is not None)
+                if self._elastic_dir is not None:
+                    # re-point at the dead fleet's directory and rescan:
+                    # continuation epochs must number past the ones
+                    # already committed there, not restart at 0
+                    self._snap_store.path = self._elastic_dir
+                    self._snap_store._epoch = \
+                        self._snap_store._scan_next_epoch()
+            else:
+                from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+                self._snap_store = SnapshotStore.for_solver(self)
+        return self._snap_store
+
     def _make_resilience(self):
         """Per-step resilience context for the chunked budget loop, or
         None when the subsystem is fully disabled (no ladder budget, no
-        snapshot cadence, no fault plan)."""
+        snapshot cadence, no fault plan, no collective deadline)."""
         scfg = self.config.solver
         every = int(getattr(self.config, "snapshot_every", 0))
         plan = self.fault_plan
-        if scfg.max_recoveries <= 0 and every <= 0 and plan is None:
+        comm = self._collective_comm()
+        if (scfg.max_recoveries <= 0 and every <= 0 and plan is None
+                and comm is None):
             return None
         from pcg_mpi_solver_tpu.resilience.recovery import (
             DispatchGuard, ResilienceContext)
 
-        store = None
-        if every > 0:
-            if self._snap_store is None:
-                from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
-
-                self._snap_store = SnapshotStore.for_solver(self)
-            store = self._snap_store
+        store = self._snapshot_store() if every > 0 else None
         from pcg_mpi_solver_tpu.resilience.recovery import retry_deadline_s
 
         return ResilienceContext(
@@ -1385,7 +1438,7 @@ class Solver:
                                 deadline_s=retry_deadline_s(),
                                 recorder=self._rec),
             faults=plan, recorder=self._rec, resume=self._resume_pending,
-            ladder_armed=scfg.max_recoveries > 0)
+            ladder_armed=scfg.max_recoveries > 0, comm=comm)
 
     def _fetch_state(self, state):
         """Device state pytree -> host numpy (collective on multi-host:
@@ -1952,10 +2005,23 @@ class Solver:
         loudly instead of continuing the wrong Krylov space)."""
         key = (R, rhs_hash)
         if key not in self._many_snap:
-            from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+            if jax.process_count() > 1 or self._elastic_dir is not None:
+                from pcg_mpi_solver_tpu.resilience.distributed import (
+                    GroupSnapshotStore)
 
-            self._many_snap[key] = SnapshotStore.for_many_solver(
-                self, R, rhs_hash=rhs_hash)
+                store = GroupSnapshotStore.for_many_solver(
+                    self, R, rhs_hash=rhs_hash,
+                    comm=self._collective_comm(), recorder=self._rec,
+                    elastic=self._elastic_dir is not None)
+                if self._elastic_dir is not None:
+                    store.path = self._elastic_dir
+                    store._epoch = store._scan_next_epoch()
+                self._many_snap[key] = store
+            else:
+                from pcg_mpi_solver_tpu.utils.checkpoint import SnapshotStore
+
+                self._many_snap[key] = SnapshotStore.for_many_solver(
+                    self, R, rhs_hash=rhs_hash)
         return self._many_snap[key]
 
     def _make_many_resilience(self, store, resume: bool):
@@ -1967,7 +2033,9 @@ class Solver:
         scfg = self.config.solver
         every = int(getattr(self.config, "snapshot_every", 0))
         plan = self.fault_plan
-        if store is None and plan is None and scfg.max_recoveries <= 0:
+        comm = self._collective_comm()
+        if (store is None and plan is None and scfg.max_recoveries <= 0
+                and comm is None):
             return None
         from pcg_mpi_solver_tpu.resilience.recovery import (
             DispatchGuard, ResilienceContext, retry_deadline_s)
@@ -1983,7 +2051,7 @@ class Solver:
                                 deadline_s=retry_deadline_s(),
                                 recorder=self._rec),
             faults=plan, recorder=self._rec, resume=resume,
-            ladder_armed=scfg.max_recoveries > 0)
+            ladder_armed=scfg.max_recoveries > 0, comm=comm)
 
     def _solve_many_chunked(self, fb_dev, R: int, progs, resume: bool,
                             rhs_hash: str = ""):
@@ -2114,6 +2182,37 @@ class Solver:
                             **self.last_trace.to_event_fields(step_i))
         return res
 
+    def resume_elastic(self, snapshot_dir: Optional[str] = None,
+                       **solve_kw):
+        """Resume a MULTI-PROCESS run's persisted state on a DIFFERENT
+        (typically smaller) process count — the elastic-resume path
+        (ISSUE 18).
+
+        Group-consistent snapshot epochs
+        (resilience/distributed.GroupSnapshotStore) carry each shard's
+        part rows, so a committed N-process epoch re-joins into the full
+        global state on any process count; completed-step checkpoints
+        are globally-fetched on the primary already.  Both resumes would
+        normally refuse on the ``n_procs`` fingerprint mismatch — this
+        entry point arms the NAMED elastic path instead: the mismatch
+        (confined to ``n_procs``) becomes an ``elastic_resume``
+        telemetry event and the solve continues bit-identically.
+
+        ``snapshot_dir`` points at the dead fleet's checkpoint
+        directory; None reads this config's ``checkpoint_path``.
+        Remaining keywords pass through to :meth:`solve`."""
+        self._elastic_dir = snapshot_dir or self.config.checkpoint_path
+        # a store built before arming lacks the elastic marker (and, on
+        # a shrunk fleet, possibly the epoch protocol entirely): rebuild
+        self._snap_store = None
+        self._many_snap = {}
+        try:
+            return self.solve(resume=True, **solve_kw)
+        finally:
+            self._elastic_dir = None
+            self._snap_store = None
+            self._many_snap = {}
+
     def solve(self, on_step: Optional[Callable[[int, StepResult], None]] = None,
               store=None, resume: bool = False):
         """Run the full quasi-static schedule (skips step 0, like the
@@ -2144,9 +2243,12 @@ class Solver:
         if self.config.checkpoint_every > 0 or resume:
             from pcg_mpi_solver_tpu.utils.checkpoint import CheckpointManager
 
-            ckpt_mgr = CheckpointManager(self.config.checkpoint_path)
+            ckpt_mgr = CheckpointManager(self._elastic_dir
+                                         or self.config.checkpoint_path)
         if resume and ckpt_mgr is not None:
-            t_done = ckpt_mgr.restore(self)
+            t_done = ckpt_mgr.restore(
+                self, elastic=self._elastic_dir is not None,
+                recorder=self._rec)
             if t_done is not None:
                 t_start = t_done + 1
         # Mid-Krylov snapshot resume (resilience/): only an EXPLICIT
@@ -2167,6 +2269,8 @@ class Solver:
                 # stranded in the rotated dir.  Barrier before any writes.
                 from jax.experimental import multihost_utils
 
+                # consensus-exempt: plain barrier, unconditional on the
+                # multi-process export path (no verdict to agree)
                 multihost_utils.sync_global_devices("runstore_prepared")
             store.write_map("Dof", self.export_dof_map())
             if self._nodal_vars():

@@ -79,6 +79,21 @@ def _model_hash(solver) -> str:
     return h.hexdigest()
 
 
+def _process_count() -> int:
+    """Process-group size for the fingerprint, without importing jax as
+    a side effect of loading this module (a backend not initialized yet
+    reads as single-process — the value every legacy record implies)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 1
+    try:
+        return int(jax.process_count())
+    except Exception:                                   # noqa: BLE001
+        return 1
+
+
 def _fingerprint(solver) -> dict:
     """Everything that must not drift between checkpoint and resume: the
     model content, the numerics (precision/tol), the schedule values, and
@@ -129,6 +144,12 @@ def _fingerprint(solver) -> dict:
                         float(cfg.solver.mixed_progress_ratio),
                         float(cfg.solver.mixed_progress_min_gain)],
         "trace_len": int(getattr(solver, "trace_len", 0)),
+        # process-group shape: shard-per-rank snapshot epochs
+        # (resilience/distributed.GroupSnapshotStore) are written by N
+        # cooperating processes; a same-count resume must match, and a
+        # different-count restore is only legal through the NAMED
+        # elastic path (Solver.resume_elastic), never silently.
+        "n_procs": _process_count(),
         "deltas": [float(d) for d in th.time_step_delta],
         "export": [bool(th.export_flag), int(th.export_frame_rate),
                    [int(f) for f in th.export_frames], th.export_vars],
@@ -309,10 +330,16 @@ class CheckpointManager:
             return t
         return None
 
-    def restore(self, solver, t: Optional[int] = None) -> Optional[int]:
+    def restore(self, solver, t: Optional[int] = None, *,
+                elastic: bool = False,
+                recorder=None) -> Optional[int]:
         """Load the checkpoint for step ``t`` (default: latest) into
         ``solver``.  Returns the restored step index, or None when no
-        checkpoint exists.  Raises on fingerprint mismatch."""
+        checkpoint exists.  Raises on fingerprint mismatch — except a
+        mismatch confined to ``n_procs`` under ``elastic=True``: step
+        checkpoints hold the globally-fetched state, so restoring onto
+        a different process count is exact, and the NAMED elastic path
+        records an ``elastic_resume`` event instead of refusing."""
         if t is None:
             t = self.latest_step()
             if t is None:
@@ -363,13 +390,23 @@ class CheckpointManager:
             # checks for legacy checkpoints rather than guess (the
             # matvec_form precedent above).
             for k in ("dot_dtype", "max_stag_steps", "inner_tol",
-                      "mixed_knobs", "trace_len"):
+                      "mixed_knobs", "trace_len", "n_procs"):
                 saved.setdefault(k, want[k])
             if saved != want:
                 diffs = {k: (saved.get(k), want[k]) for k in want
                          if saved.get(k) != want[k]}
-                raise ValueError(
-                    f"checkpoint/solver mismatch (saved, current): {diffs}")
+                if elastic and set(diffs) == {"n_procs"}:
+                    if recorder is not None:
+                        recorder.event(
+                            "elastic_resume",
+                            from_procs=int(saved.get("n_procs", -1)),
+                            to_procs=int(want["n_procs"]),
+                            prefix="ckpt")
+                        recorder.inc("resilience.elastic_resume")
+                else:
+                    raise ValueError(
+                        f"checkpoint/solver mismatch (saved, current): "
+                        f"{diffs}")
             load_state_dict(solver, {k: z[k] for k in z.files
                                      if k not in ("t", "fingerprint")})
         return t
@@ -491,9 +528,17 @@ class SnapshotStore:
     def _prune(self) -> None:
         """Drop all but the newest K snapshots of this prefix.  Runs
         only after a successful atomic publish, so the newest file is
-        always a complete record; zero-padded names sort by step."""
-        files = sorted(_glob.glob(
-            os.path.join(self.path, f"{self.prefix}_*.npz")))
+        always a complete record; zero-padded names sort by step.  Only
+        files of THIS store's ``<prefix>_<step>.npz`` naming count:
+        the epoch shards/markers a GroupSnapshotStore keeps under the
+        same prefix (``<prefix>_e<E>.p<idx>.npz``) have their own
+        committed-epoch retention, and a per-file prune racing across
+        rank shards is exactly how retention used to split a group's
+        ``latest()`` resolution."""
+        files = sorted(
+            p for p in _glob.glob(
+                os.path.join(self.path, f"{self.prefix}_*.npz"))
+            if os.path.basename(p)[len(self.prefix) + 1:-4].isdigit())
         for p in files[:-self.retention()]:
             try:
                 os.remove(p)
@@ -562,45 +607,58 @@ class SnapshotStore:
                           "step from its start state")
             return None
         flat.pop("__t", None)
+        self._reconcile_fingerprint(saved)
+        return _unflatten(flat)
+
+    def _reconcile_fingerprint(self, saved: dict) -> None:
+        """Apply the legacy shims to a record's saved fingerprint, then
+        compare against this store's live fingerprint, dispatching any
+        mismatch to :meth:`_fingerprint_mismatch` (shared by the base
+        per-file reads and the epoch-shard joins of
+        ``resilience.distributed.GroupSnapshotStore``)."""
+        if self.fingerprint is None:
+            return
         # snapshots written before the nrhs field existed can only have
         # come from the width-1 scalar paths (same back-compat shim as
         # CheckpointManager.restore — without it every pre-existing
         # snap_*/step_* resume point would mismatch on upgrade).  Only
         # when THIS store's fingerprint carries the field: a custom
         # fingerprint without it must keep comparing equal to itself.
-        if self.fingerprint is not None and "nrhs" in self.fingerprint:
+        if "nrhs" in self.fingerprint:
             saved.setdefault("nrhs", 1)
-        if self.fingerprint is not None \
-                and "many_fallback" in self.fingerprint:
+        if "many_fallback" in self.fingerprint:
             # blocked snapshots written before the per-column fallback
             # wiring existed can only have come from programs without
             # the fallback operand
             saved.setdefault("many_fallback", False)
-        if self.fingerprint is not None \
-                and "mg_shape" in self.fingerprint:
+        if "mg_shape" in self.fingerprint:
             # snapshots written before the mg_shape field existed can
             # only have come from a non-mg preconditioner — resuming
             # them under precond='mg' still mismatches (on precond AND
             # on "n/a" != the live shape), loudly
             saved.setdefault("mg_shape", "n/a")
-        if self.fingerprint is not None:
-            # snapshots written before the fingerprint-completeness
-            # sweep (analysis/) did not record these numerics knobs;
-            # their historical values are unknowable — skip the new
-            # checks for legacy snapshots rather than guess (same
-            # rationale and guard as the nrhs shim above)
-            for k in ("dot_dtype", "max_stag_steps", "inner_tol",
-                      "mixed_knobs", "trace_len"):
-                if k in self.fingerprint:
-                    saved.setdefault(k, self.fingerprint[k])
-        if self.fingerprint is not None and saved != self.fingerprint:
+        # snapshots written before the fingerprint-completeness sweep
+        # (analysis/) did not record these numerics knobs; their
+        # historical values are unknowable — skip the new checks for
+        # legacy snapshots rather than guess (same rationale and guard
+        # as the nrhs shim above)
+        for k in ("dot_dtype", "max_stag_steps", "inner_tol",
+                  "mixed_knobs", "trace_len", "n_procs"):
+            if k in self.fingerprint:
+                saved.setdefault(k, self.fingerprint[k])
+        if saved != self.fingerprint:
             diffs = {k: (saved.get(k), self.fingerprint[k])
                      for k in self.fingerprint
                      if saved.get(k) != self.fingerprint[k]}
-            raise ValueError(
-                f"mid-solve snapshot/solver mismatch (saved, current): "
-                f"{diffs}")
-        return _unflatten(flat)
+            self._fingerprint_mismatch(saved, diffs)
+
+    def _fingerprint_mismatch(self, saved: dict, diffs: dict) -> None:
+        """Mismatch outcome hook: the base store always refuses; the
+        group store's elastic path tolerates an ``n_procs``-only diff
+        as a named event."""
+        raise ValueError(
+            f"mid-solve snapshot/solver mismatch (saved, current): "
+            f"{diffs}")
 
     def discard(self, t: int) -> None:
         from pcg_mpi_solver_tpu.utils.io import is_primary

@@ -630,6 +630,75 @@ def test_recovery_coverage_seeded_violations():
 
 
 # ----------------------------------------------------------------------
+# consensus-coverage (ISSUE 18): host-side collectives on the dispatch
+# path route their verdicts through parallel/consensus or are exempted
+# ----------------------------------------------------------------------
+
+def test_consensus_coverage_clean_on_real_tree():
+    from pcg_mpi_solver_tpu.analysis.rules_ast import (
+        consensus_coverage_rule)
+
+    assert consensus_coverage_rule(None) == []
+
+
+def test_consensus_coverage_seeded_violations():
+    """Every failure class fires on seeded sources: an unregistered
+    collective call site, a registered site that dropped its consensus
+    call, an exempt site without the documented marker, and a stale
+    registry entry — plus the `warmup` negative control (the unrelated
+    compile-warmup method must never register as a collective)."""
+    from pcg_mpi_solver_tpu.analysis.rules_ast import (
+        check_consensus_coverage)
+
+    rel = "pcg_mpi_solver_tpu/solver/driver.py"
+    src = (
+        "def _pallas_enabled():\n"
+        "    # consensus-exempt: test stub\n"
+        "    return process_allgather(x)\n"
+        "class Solver:\n"
+        "    def __init__(self):\n"
+        "        ok = agree_flag(comm, ok)\n"
+        "    def _exchange_export_glue(self):\n"
+        "        # consensus-exempt: test stub\n"
+        "        mh.process_allgather(i)\n"
+        "    def solve(self):\n"
+        "        # consensus-exempt: test stub\n"
+        "        multihost_utils.sync_global_devices('prepared')\n"
+        "    def warm_compile(self):\n"
+        "        self.engine.warmup()\n"
+        "    def sneaky_branch(self):\n"
+        "        if comm.allreduce(v, 'min'):\n"
+        "            pass\n")
+
+    # (0) clean seeded tree modulo the one unregistered site; warmup
+    # must not be flagged
+    errs = check_consensus_coverage({rel: src})
+    assert any("sneaky_branch" in e and "not registered" in e
+               for e in errs), errs
+    assert not any("warm_compile" in e for e in errs), errs
+    assert not any("__init__" in e for e in errs), errs
+
+    # (2) registered site that no longer calls its consensus primitive
+    src2 = src.replace("ok = agree_flag(comm, ok)", "pass")
+    errs2 = check_consensus_coverage({rel: src2})
+    assert any("__init__" in e and "agree_flag" in e
+               for e in errs2), errs2
+
+    # (3) exempt site without the documented marker
+    src3 = src.replace(
+        "        # consensus-exempt: test stub\n"
+        "        multihost_utils.sync_global_devices('prepared')\n",
+        "        multihost_utils.sync_global_devices('prepared')\n")
+    errs3 = check_consensus_coverage({rel: src3})
+    assert any("`solve`" in e and "consensus-exempt" in e
+               for e in errs3), errs3
+
+    # (4) stale registry entry: the registered function vanished
+    errs4 = check_consensus_coverage({rel: "x = 1\n"})
+    assert any("no such function" in e for e in errs4), errs4
+
+
+# ----------------------------------------------------------------------
 # cost-model-completeness (ISSUE 12): the analytic per-iteration cost
 # model covers every canonical variant x precond combination, loudly
 # ----------------------------------------------------------------------

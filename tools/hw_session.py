@@ -419,6 +419,19 @@ def run_priority_queue(path, quick: bool):
     # poisoned column must recover (flag 0 after a ladder restart).
     run_step(path, "blocked-resilience smoke", ["-c", _MANY_SMOKE],
              env_extra={"PCG_TPU_RETRY_BACKOFF_S": "0.01"}, timeout=900)
+    # Step 0.6: distributed-chaos smoke (ISSUE 18) — a 2-process CPU
+    # gloo group with a rank-targeted kill (`kill@rank:1:2`): the
+    # survivor must raise the NAMED DeadPeerError within the collective
+    # deadline (not hang in gloo), and a same-count relaunch must resume
+    # from the group-committed snapshot epoch bit-identically.
+    # CPU-only (jax.distributed child processes; never touches the
+    # accelerator grant) and BEFORE the setup ladder, so a broken
+    # fault-tolerance path fails the window in minutes, not at 3 a.m.
+    run_step(path, "distributed-chaos smoke",
+             ["-m", "pytest", "-x", "-q",
+              "tests/test_distributed_ft.py::"
+              "test_dead_peer_named_and_resume_scalar"],
+             env_extra={"JAX_PLATFORMS": "cpu"}, timeout=1200, gate_s=0)
     # BENCH_NX exported unconditionally so the flagship size is pinned
     # HERE, not silently inherited from bench.py's default
     cache = {"BENCH_CACHE_DIR": os.path.join(REPO, ".pcg_cache")}
