@@ -166,9 +166,15 @@ class GuardedComm:
     way to cancel it), which is why it is a daemon: the process is
     expected to exit/relaunch after a dead-peer verdict, not to retry.
 
-    With no deadline configured (or a single-process group) every call
-    is a plain pass-through, so this wrapper is safe to install
-    unconditionally on the multi-process dispatch path.
+    With no deadline configured every call runs inline (no watchdog
+    thread, no flight span), but on a multi-process group the
+    transport-failure classification still applies — a killed gloo
+    peer's fast connection-reset error becomes the same named
+    :class:`DeadPeerError` verdict with or without the knob.  The
+    wrapper is therefore installed unconditionally on the multi-process
+    dispatch path (``Solver._collective_comm``): the consensus
+    agreements it carries are correctness-critical; only the watchdog
+    is opt-in.
     """
 
     def __init__(self, comm, *, deadline_s: Optional[float] = None,
@@ -213,10 +219,60 @@ class GuardedComm:
         self._guarded(label, lambda: self.comm.allreduce(
             np.ones(1, dtype=np.int64), "min"))
 
+    def _suspect(self) -> Tuple[Optional[int], str]:
+        """``(rank, description)`` of the most flight-silent peer."""
+        rank, silent = suspect_dead_rank(self.flight_base(), self.index)
+        who = (f"process {rank} (flight-silent {silent:.1f}s)"
+               if rank is not None else
+               "unknown (no peer flight shard readable)")
+        return rank, who
+
+    def _raise_transport_death(self, label: str, err: BaseException,
+                               waited: float, flight, seq) -> None:
+        """Record + raise the dead-peer transport verdict: a killed peer
+        usually surfaces as a FAST gloo connection error, not a hang —
+        same verdict as a deadline expiry, same named error (the
+        original rides along as ``__cause__`` — its XlaRuntimeError
+        shape would otherwise read as a retryable device loss and burn
+        the dispatch-guard budget re-entering the same dead group).
+        Shared by the watchdog path and the no-deadline inline path."""
+        rank, who = self._suspect()
+        if self.recorder is not None:
+            self.recorder.event(
+                "collective_timeout", label=label,
+                deadline_s=float(self.deadline_s or 0.0),
+                suspect=(-1 if rank is None else int(rank)))
+            self.recorder.inc("resilience.collective_timeout")
+        if flight is not None:
+            flight.end(seq, f"collective:{label}", ok=False,
+                       error="collective transport failure",
+                       waited_s=round(waited, 3),
+                       suspect=(-1 if rank is None else int(rank)))
+        raise DeadPeerError(
+            f"collective '{label}' failed on the transport after "
+            f"{waited:.1f}s ({type(err).__name__}: a peer's "
+            f"connection dropped mid-round, {self.n_procs} "
+            f"processes); suspected dead peer: {who}") from err
+
     def _guarded(self, label: str, fn):
         deadline = self.deadline_s
-        if deadline is None or self.n_procs <= 1:
+        if self.n_procs <= 1:
             return fn()
+        if deadline is None:
+            # no watchdog armed: run inline (no thread, no flight span)
+            # — but the transport classification is a correctness
+            # concern, not a watchdog concern, so it applies to every
+            # multi-process group regardless of the deadline knob
+            t0 = time.monotonic()
+            try:
+                return fn()
+            except DeadPeerError:
+                raise
+            except BaseException as err:    # noqa: BLE001 — classified below
+                if is_transport_failure(err):
+                    self._raise_transport_death(
+                        label, err, time.monotonic() - t0, None, None)
+                raise
         box: Dict[str, Any] = {}
         done = threading.Event()
 
@@ -238,7 +294,7 @@ class GuardedComm:
         done.wait(deadline)
         if not done.is_set():
             waited = time.monotonic() - t0
-            rank, silent = suspect_dead_rank(self.flight_base(), self.index)
+            rank, who = self._suspect()
             if self.recorder is not None:
                 self.recorder.event(
                     "collective_timeout", label=label,
@@ -250,9 +306,6 @@ class GuardedComm:
                            error="collective stalled",
                            waited_s=round(waited, 3),
                            suspect=(-1 if rank is None else int(rank)))
-            who = (f"process {rank} (flight-silent {silent:.1f}s)"
-                   if rank is not None else
-                   "unknown (no peer flight shard readable)")
             # NB: phrased to stay outside is_device_loss()'s marker set —
             # a dead peer must propagate, not burn dispatch retries.
             raise DeadPeerError(
@@ -261,33 +314,8 @@ class GuardedComm:
                 f"{self.n_procs} processes); suspected dead peer: {who}")
         err = box.get("err")
         if err is not None and is_transport_failure(err):
-            # a killed peer usually surfaces as a FAST gloo connection
-            # error, not a hang: same verdict as the deadline expiry,
-            # same named error (the original rides along as __cause__ —
-            # its XlaRuntimeError shape would otherwise read as a
-            # retryable device loss and burn the dispatch-guard budget
-            # re-entering the same dead group)
-            waited = time.monotonic() - t0
-            rank, silent = suspect_dead_rank(self.flight_base(), self.index)
-            if self.recorder is not None:
-                self.recorder.event(
-                    "collective_timeout", label=label,
-                    deadline_s=float(deadline),
-                    suspect=(-1 if rank is None else int(rank)))
-                self.recorder.inc("resilience.collective_timeout")
-            if flight is not None:
-                flight.end(seq, f"collective:{label}", ok=False,
-                           error="collective transport failure",
-                           waited_s=round(waited, 3),
-                           suspect=(-1 if rank is None else int(rank)))
-            who = (f"process {rank} (flight-silent {silent:.1f}s)"
-                   if rank is not None else
-                   "unknown (no peer flight shard readable)")
-            raise DeadPeerError(
-                f"collective '{label}' failed on the transport after "
-                f"{waited:.1f}s ({type(err).__name__}: a peer's "
-                f"connection dropped mid-round, {self.n_procs} "
-                f"processes); suspected dead peer: {who}") from err
+            self._raise_transport_death(label, err,
+                                        time.monotonic() - t0, flight, seq)
         if flight is not None:
             flight.end(seq, f"collective:{label}",
                        ok=err is None,
@@ -547,6 +575,7 @@ class GroupSnapshotStore(SnapshotStore):
         ranged = sorted(
             ((tuple(int(v) for v in flat["__part_range"]), flat)
              for flat in shards), key=lambda pair: pair[0])
+        n_parts = int(meta.get("n_parts", 0)) or int(self.n_parts or 0)
         joined: Dict[str, Any] = {}
         for k in shards[0]:
             if k.startswith("__"):
@@ -562,6 +591,15 @@ class GroupSnapshotStore(SnapshotStore):
                         return None
                     pieces.append(flat[k])
                     pos = p1
+                if n_parts and pos != n_parts:
+                    # contiguous but short: e.g. leftover shards of a
+                    # shrunk fleet matching an old marker's n_shards —
+                    # a truncated global array must not restore
+                    warnings.warn(
+                        f"epoch {epoch} shards tile only {pos} of "
+                        f"{n_parts} part rows; falling back to an "
+                        "older committed epoch")
+                    return None
                 joined[k] = np.concatenate(pieces, axis=0)
             else:
                 joined[k] = shards[0][k]

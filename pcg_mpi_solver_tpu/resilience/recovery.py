@@ -217,10 +217,11 @@ class ResilienceContext:
         self.guard = guard
         self.faults = faults
         self.recorder = recorder
-        # deadline-guarded host-collective group of a multi-process run
-        # (resilience.distributed.GuardedComm), or None: drives the
-        # chunk-boundary liveness sync and the consensus agreements of
-        # the recovery engine
+        # host-collective group of a multi-process run
+        # (resilience.distributed.GuardedComm; watchdog armed only when
+        # PCG_TPU_COLLECTIVE_DEADLINE_S is set), or None single-process:
+        # drives the chunk-boundary liveness sync and the consensus
+        # agreements of the recovery engine
         self.comm = comm
         # whether the driver will actually consume engine.restart_x — the
         # engine skips the per-cycle restart-iterate copy otherwise

@@ -778,7 +778,7 @@ class Solver:
         self._resume_pending = False     # solve(resume=True) arms mid-step
         #                                  snapshot resume for its steps
         self._snap_store = None          # lazy: fingerprints the model once
-        self._group_comm = None          # lazy: deadline-guarded HostComm
+        self._group_comm = None          # lazy: guarded multi-proc HostComm
         self._elastic_dir = None         # resume_elastic() arms the named
         #                                  n_procs-mismatch resume path
         self._many_progs = {}            # nrhs -> jitted blocked programs
@@ -1365,24 +1365,27 @@ class Solver:
     # Resilience subsystem (resilience/): context + recovery programs
     # ------------------------------------------------------------------
     def _collective_comm(self):
-        """Deadline-guarded host-collective group for the dispatch path
-        (resilience/distributed.GuardedComm), cached; None single-process
-        or when no deadline is armed (PCG_TPU_COLLECTIVE_DEADLINE_S
-        unset) — the guard is opt-in because a watchdog thread per
-        collective is pure overhead on a healthy fleet."""
+        """Host-collective group for the dispatch path
+        (resilience/distributed.GuardedComm), cached; None
+        single-process.  Every multi-process run gets a REAL group: the
+        consensus agreements (snapshot commit/resume epoch, recovery
+        ladder, engage) are correctness-critical regardless of
+        configuration, so they must never silently degrade to local
+        verdicts.  Only the deadline WATCHDOG stays opt-in
+        (PCG_TPU_COLLECTIVE_DEADLINE_S — a watchdog thread per
+        collective is pure overhead on a healthy fleet); with no
+        deadline armed the wrapper runs collectives inline but still
+        classifies transport death as DeadPeerError."""
         if jax.process_count() <= 1:
-            return None
-        from pcg_mpi_solver_tpu.resilience.distributed import (
-            GuardedComm, collective_deadline_s)
-
-        deadline = collective_deadline_s()
-        if deadline is None:
             return None
         if self._group_comm is None:
             from pcg_mpi_solver_tpu.parallel.distributed import HostComm
+            from pcg_mpi_solver_tpu.resilience.distributed import (
+                GuardedComm, collective_deadline_s)
 
             self._group_comm = GuardedComm(
-                self._setup_comm or HostComm(), deadline_s=deadline,
+                self._setup_comm or HostComm(),
+                deadline_s=collective_deadline_s(),
                 recorder=self._rec, index=jax.process_index())
         return self._group_comm
 

@@ -154,15 +154,28 @@ def test_guardedcomm_transport_failure_is_dead_peer(tmp_path):
     assert isinstance(ei.value.__cause__, RuntimeError)
     (ev,) = _kinds(cap, "collective_timeout")
     assert ev["suspect"] == 1
-    # without a deadline armed the guard is a pass-through: the raw
-    # transport error keeps its own type
-    g = GuardedComm(_ResetComm(), deadline_s=None, index=0)
-    with pytest.raises(RuntimeError, match="Gloo"):
+
+
+def test_guardedcomm_transport_classified_without_deadline():
+    """Regression: the transport-failure-to-DeadPeerError classification
+    is a correctness concern, not a watchdog concern — a killed gloo
+    peer's connection-reset error must get the dead-peer verdict even
+    on a default-config run (PCG_TPU_COLLECTIVE_DEADLINE_S unset),
+    instead of keeping its retryable-device-loss shape and burning
+    dispatch-guard retries re-entering the dead group."""
+    cap = _Cap()
+    g = GuardedComm(_ResetComm(), deadline_s=None,
+                    recorder=MetricsRecorder(sinks=[cap]), index=0)
+    with pytest.raises(DeadPeerError) as ei:
         g.allreduce(np.ones(1), "min")
+    assert not is_device_loss(ei.value)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    (ev,) = _kinds(cap, "collective_timeout")
+    assert ev["deadline_s"] == 0.0          # verdict without a watchdog
 
 
 def test_guardedcomm_passthrough_and_error_rethrow():
-    # no deadline -> plain pass-through, errors keep their own type
+    # non-transport errors keep their own type, deadline armed or not
     g = GuardedComm(_BoomComm(), deadline_s=None, index=0)
     with pytest.raises(ValueError, match="boom"):
         g.allreduce(np.ones(1), "min")
@@ -225,6 +238,35 @@ def test_consensus_group_reduction():
     comm = _ScriptedComm(script=[[0, encode_trigger("nan_carry"), 0,
                                   encode_trigger("flag4")]])
     assert agree_triggers(comm, {}, 4) == {1: "nan_carry", 3: "flag4"}
+
+
+def test_collective_comm_real_group_without_deadline(monkeypatch):
+    """Regression (review): Solver._collective_comm must return a REAL
+    group on every multi-process run — the consensus agreements
+    (snapshot commit markers, recovery ladder, resume epoch) are
+    correctness-critical regardless of configuration — with the
+    deadline watchdog layered on only when
+    PCG_TPU_COLLECTIVE_DEADLINE_S is armed.  Before the fix it returned
+    None without the knob, silently degrading every agree() to a local
+    verdict (rank 0 committed epochs after checking only its OWN shard
+    write)."""
+    import pcg_mpi_solver_tpu.solver.driver as driver_mod
+    from pcg_mpi_solver_tpu.solver.driver import Solver
+
+    monkeypatch.delenv("PCG_TPU_COLLECTIVE_DEADLINE_S", raising=False)
+    monkeypatch.setattr(driver_mod.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(driver_mod.jax, "process_index", lambda: 0)
+    s = Solver.__new__(Solver)
+    s._group_comm, s._setup_comm, s._rec = None, _ScriptedComm(), None
+    comm = s._collective_comm()
+    assert isinstance(comm, GuardedComm)
+    assert comm.deadline_s is None and comm.n_procs == 2
+    # consensus rounds genuinely reduce through the wrapped group
+    assert agree(comm, [3], "max")[0] == 3
+    # ... and the watchdog arms once the knob is set
+    monkeypatch.setenv("PCG_TPU_COLLECTIVE_DEADLINE_S", "7")
+    s._group_comm = None
+    assert s._collective_comm().deadline_s == 7.0
 
 
 # ----------------------------------------------------------------------
@@ -328,6 +370,22 @@ def test_torn_epoch_falls_back_to_older_committed(tmp_path):
     np.testing.assert_array_equal(got["x"], a["x"])
 
 
+def test_truncated_shard_set_falls_back(tmp_path):
+    """Regression: a shard set that tiles contiguously from part 0 but
+    ends SHORT of the marker's n_parts (e.g. leftover shards of a
+    shrunk fleet matching an old marker's n_shards) must not restore a
+    truncated global array — same named fallback as a torn epoch."""
+    mk = lambda idx, rng: GroupSnapshotStore(
+        str(tmp_path), dict(_FP2), comm=None, index=idx, n_shards=2,
+        part_range=rng, n_parts=8)
+    s0, s1 = mk(0, (0, 4)), mk(1, (4, 6))    # rows 6:8 never written
+    a = _state(4)
+    s1.save(1, a)
+    s0.save(1, a)       # commits: every shard LANDED, but the set is short
+    with pytest.warns(UserWarning, match="tile only 6 of 8 part rows"):
+        assert _reader(tmp_path).load(1) is None
+
+
 def test_uncommitted_save_stays_invisible(tmp_path):
     s0, s1 = _pair_stores(tmp_path)
     a = _state(1)
@@ -423,7 +481,11 @@ scratch = sys.argv[3]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=4")
-os.environ["PCG_TPU_COLLECTIVE_DEADLINE_S"] = "5"
+if MODE != "ref":
+    # the ref run stays DEFAULT-CONFIG (no watchdog knob): the group
+    # consensus + commit-marker protocol must hold without it, and the
+    # resume run's bit-identical digest proves it did
+    os.environ["PCG_TPU_COLLECTIVE_DEADLINE_S"] = "5"
 os.environ["PCG_TPU_FLIGHT_HEARTBEAT_S"] = "0.2"
 if MODE == "kill":
     os.environ["PCG_TPU_FAULTS"] = "kill@rank:1:3"
@@ -525,7 +587,10 @@ scratch = sys.argv[3]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=4")
-os.environ["PCG_TPU_COLLECTIVE_DEADLINE_S"] = "5"
+if MODE != "ref":
+    # ref stays default-config: consensus/commit must hold without the
+    # watchdog knob (see the scalar child)
+    os.environ["PCG_TPU_COLLECTIVE_DEADLINE_S"] = "5"
 os.environ["PCG_TPU_FLIGHT_HEARTBEAT_S"] = "0.2"
 if MODE == "kill":
     os.environ["PCG_TPU_FAULTS"] = "kill@rank:1:2"
