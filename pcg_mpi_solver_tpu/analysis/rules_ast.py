@@ -29,7 +29,8 @@ from pcg_mpi_solver_tpu.analysis.engine import REPO, Finding, rule
 PKG = os.path.join(REPO, "pcg_mpi_solver_tpu")
 
 #: scanned packages: the historical recovery scope + the ISSUE-7
-#: extension (ops/parallel/obs).
+#: extension (ops/parallel/obs) + the solve service (ISSUE 19 — a
+#: swallowed daemon failure silently loses a tenant's job).
 DEFAULT_SCOPE = (
     os.path.join(PKG, "solver"),
     os.path.join(PKG, "cache"),
@@ -38,6 +39,7 @@ DEFAULT_SCOPE = (
     os.path.join(PKG, "ops"),
     os.path.join(PKG, "parallel"),
     os.path.join(PKG, "obs"),
+    os.path.join(PKG, "serve"),
 )
 
 # Exception names considered "broad" when caught: anything narrower
@@ -148,7 +150,8 @@ def recovery_paths_rule(ctx) -> List[Finding]:
 #: ``resilience/engine.py`` are harness-INTERNAL — their dispatches are
 #: only ever reached through a wrapped caller below.
 COVERAGE_FILES = ("pcg_mpi_solver_tpu/solver/driver.py",
-                  "pcg_mpi_solver_tpu/solver/newmark.py")
+                  "pcg_mpi_solver_tpu/solver/newmark.py",
+                  "pcg_mpi_solver_tpu/serve/daemon.py")
 
 #: Krylov-TERMINAL dispatch-span names: a swept function whose subtree
 #: opens ``<recorder>.dispatch("<one of these>")`` — or calls the
@@ -177,6 +180,11 @@ RECOVERY_SURFACES = {
     ("pcg_mpi_solver_tpu/solver/newmark.py", "_step_chunked"):
         "calls:run_with_recovery",
     ("pcg_mpi_solver_tpu/solver/newmark.py", "step"): "exempt",
+    # solve-service dispatch (ISSUE 19): jobs reach the solver ONLY
+    # through Solver.solve_many — the per-column recovery/quarantine
+    # path — so a poisoned tenant cannot fail its co-batched block
+    ("pcg_mpi_solver_tpu/serve/daemon.py", "_dispatch_block"):
+        "calls:solve_many",
 }
 
 
@@ -439,5 +447,115 @@ def consensus_coverage_rule(ctx) -> List[Finding]:
     for err in check_consensus_coverage(sources):
         loc, _, msg = err.partition(": ")
         findings.append(Finding(rule="consensus-coverage", loc=loc,
+                                message=msg))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# serve-admission-events: every admission-decision outcome of the solve
+# service emits its schema-versioned telemetry event (ISSUE 19) — the
+# no-silent-drops contract, proven statically.
+# ----------------------------------------------------------------------
+
+#: Files swept for admission/lifecycle decision sites.
+ADMISSION_COVERAGE_FILES = (
+    "pcg_mpi_solver_tpu/serve/admission.py",
+    "pcg_mpi_solver_tpu/serve/daemon.py",
+)
+
+#: (file, function) -> the event kinds the function MUST emit via a
+#: constant-first-arg ``.event("<kind>", ...)`` call.  Each kind must
+#: also exist in obs/schema.EVENT_KINDS (a registered typo would vouch
+#: for an event no consumer can validate).  A registered function that
+#: disappears is itself a finding — the registry cannot go stale
+#: silently.
+ADMISSION_EVENT_SITES = {
+    ("pcg_mpi_solver_tpu/serve/admission.py", "admit"):
+        ("job_admit",),
+    ("pcg_mpi_solver_tpu/serve/admission.py", "_reject"):
+        ("job_reject",),
+    ("pcg_mpi_solver_tpu/serve/admission.py", "shed_past_deadline"):
+        ("job_shed",),
+    ("pcg_mpi_solver_tpu/serve/daemon.py", "_dispatch_block"):
+        ("job_done", "job_quarantine"),
+    ("pcg_mpi_solver_tpu/serve/daemon.py", "_finish_failed"):
+        ("job_done",),
+    ("pcg_mpi_solver_tpu/serve/daemon.py", "run"):
+        ("serve_drain",),
+}
+
+
+def _emits_event(fn: ast.FunctionDef, kind: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and node.args:
+            f = node.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else getattr(f, "id", ""))
+            a = node.args[0]
+            if name == "event" and isinstance(a, ast.Constant) \
+                    and a.value == kind:
+                return True
+    return False
+
+
+def check_admission_events(sources) -> List[str]:
+    """Violations for ``{relpath: source}`` (the rule feeds the real
+    files; tests feed seeded-violation sources)."""
+    from pcg_mpi_solver_tpu.obs.schema import EVENT_KINDS
+
+    errs: List[str] = []
+    for rel, source in sources.items():
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            errs.append(f"{rel}:0: unparseable ({e})")
+            continue
+        fns = {fn.name: fn for fn in _top_level_functions(tree)}
+        for (f, name), kinds in sorted(ADMISSION_EVENT_SITES.items()):
+            if f != rel:
+                continue
+            fn = fns.get(name)
+            if fn is None:
+                errs.append(
+                    f"{rel}:0: ADMISSION_EVENT_SITES registers "
+                    f"`{name}` but no such function exists — update "
+                    "the registry")
+                continue
+            for kind in kinds:
+                if kind not in EVENT_KINDS:
+                    errs.append(
+                        f"{rel}:{fn.lineno}: ADMISSION_EVENT_SITES "
+                        f"requires `{name}` to emit `{kind}`, which is "
+                        "not a schema EVENT_KINDS kind — fix the "
+                        "registry or add the kind to obs/schema.py")
+                    continue
+                if not _emits_event(fn, kind):
+                    errs.append(
+                        f"{rel}:{fn.lineno}: admission-decision site "
+                        f"`{name}` no longer emits the "
+                        f"schema-versioned `{kind}` event — a service "
+                        "outcome would go silent")
+    return errs
+
+
+@rule("serve-admission-events", kind="ast", fast=True,
+      doc="every solve-service admission/lifecycle outcome (admit, "
+          "reject, shed, done, quarantine, drain) emits its "
+          "schema-versioned telemetry event — decisions are never "
+          "silent")
+def serve_admission_events_rule(ctx) -> List[Finding]:
+    sources = {}
+    for rel in ADMISSION_COVERAGE_FILES:
+        path = os.path.join(REPO, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError as e:
+            return [Finding(rule="serve-admission-events", loc=rel,
+                            message=f"unreadable ({e})")]
+    findings = []
+    for err in check_admission_events(sources):
+        loc, _, msg = err.partition(": ")
+        findings.append(Finding(rule="serve-admission-events", loc=loc,
                                 message=msg))
     return findings

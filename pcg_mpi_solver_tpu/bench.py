@@ -1295,6 +1295,14 @@ def main():
         from pcg_mpi_solver_tpu.setup_ladder import main as ladder_main
 
         sys.exit(ladder_main())
+    if os.environ.get("BENCH_SERVE"):
+        # ISSUE 19: sustained solve-service throughput — saturated
+        # queue with nrhs packing vs one-at-a-time dispatch, in one
+        # process (no orchestration; the leg times dispatch, not the
+        # probe ladder)
+        from pcg_mpi_solver_tpu.serve.bench import main as serve_main
+
+        sys.exit(serve_main())
     # a stale provisional file from a previous crashed run must not be
     # salvageable as THIS run's number
     try:

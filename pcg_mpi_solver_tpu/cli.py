@@ -22,6 +22,9 @@ orchestrated by examples/run_basic_script.bash) as one typed CLI.
     pcg-tpu summary   <run.jsonl> [...]                # offline telemetry summary
     pcg-tpu watch     <run.jsonl> [--once]             # live monitor + stall alarm
     pcg-tpu telemetry-merge <run.jsonl> --out M.jsonl  # merge per-process shards
+    pcg-tpu serve     --spool DIR [model opts]         # multi-tenant solve daemon
+    pcg-tpu submit    --spool DIR --scale S            # drop a job into the spool
+    pcg-tpu jobs      --spool DIR                      # job table from the journal
 
 Settings come from ``--settings settings.json`` (same shape as the
 reference's GlobSettings: TimeHistoryParam/SolverParam,
@@ -395,6 +398,121 @@ def cmd_solve_many(args):
     print(f">solutions (n_dof, nrhs) -> {out}.npy")
     _finish_telemetry(s, args)
     print(">success!")
+
+
+def cmd_serve(args):
+    """Run the multi-tenant solve service (serve/, ISSUE 19): one warm
+    partitioned operator serving filesystem-submitted jobs exactly once.
+
+    The daemon polls ``--spool``/incoming for specs (``pcg-tpu
+    submit``), prices each admission with the analytic cost model
+    against the job's deadline, packs compatible jobs into standard
+    nrhs blocks and dispatches them through ``Solver.solve_many`` — a
+    poisoned tenant's column quarantines alone (PR 8) while co-batched
+    tenants finish.  Every lifecycle transition is an fsync'd record in
+    ``spool/journal.jsonl``; restarting the daemon over the same spool
+    replays the journal (no job lost, none solved twice).  SIGTERM
+    drains gracefully; watch the journal live with ``pcg-tpu watch
+    spool/journal.jsonl``."""
+    from pcg_mpi_solver_tpu.serve.daemon import ServeDaemon
+    from pcg_mpi_solver_tpu.solver.driver import Solver
+
+    cfg = _load_settings(args.settings, args)
+    if args.synthetic:
+        from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+
+        try:
+            dims = [int(v) for v in args.synthetic.split(",")]
+        except ValueError:
+            raise SystemExit(f"serve: --synthetic {args.synthetic!r} is "
+                             "not NX[,NY,NZ]")
+        dims += [0] * (3 - len(dims))
+        model = make_cube_model(dims[0], dims[1], dims[2], E=30e9,
+                                nu=0.2, load="traction", load_value=1e6,
+                                heterogeneous=True)
+    elif args.scratch:
+        from pcg_mpi_solver_tpu.models.mdf import read_mdf
+
+        cfg.scratch_path = args.scratch
+        model = read_mdf(os.path.join(args.scratch, "ModelData", "MDF"))
+    else:
+        raise SystemExit("serve: pass a <scratch> dir or --synthetic NX")
+    try:
+        widths = sorted({int(v) for v in args.widths.split(",")})
+    except ValueError:
+        raise SystemExit(f"serve: --widths {args.widths!r} is not a "
+                         "comma-separated list of ints")
+    n_parts, elem_part, n_dev, n_dev_used = _resolve_partition_mesh(
+        args.n_parts, args.scratch)
+
+    from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+
+    print(f">serve: warming {model.n_dof} dofs on {n_dev_used}/{n_dev} "
+          f"device(s), {n_parts} parts..")
+    s = Solver(model, cfg, mesh=make_mesh(n_dev_used), n_parts=n_parts,
+               elem_part=elem_part, backend=args.backend)
+    daemon = ServeDaemon(
+        s, args.spool, queue_max=args.queue_max, widths=widths,
+        expected_iters=args.expected_iters, poll_s=args.poll_s)
+    print(f">serve: spool={args.spool} queue_max={args.queue_max} "
+          f"widths={daemon.widths} (SIGTERM drains; journal="
+          f"{daemon.journal.path})")
+    reason = daemon.run(max_blocks=args.max_blocks,
+                        idle_exit_s=args.idle_exit_s)
+    print(f">serve: drained ({reason}) — {daemon.jobs_done} done, "
+          f"{daemon.jobs_failed} failed, "
+          f"{daemon.admission.shed_count} shed, "
+          f"{daemon.blocks} block(s)")
+    _finish_telemetry(s, args)
+    print(">success!")
+
+
+def cmd_submit(args):
+    """Submit one job to a solve-service spool (import-light: works
+    from a login node without the accelerator environment).  Prints the
+    job id; poll ``spool/results/<job>.json`` — every submitted job
+    eventually gets a result with a named verdict."""
+    from pcg_mpi_solver_tpu.serve import jobs as sjobs
+
+    spec = {"deadline_s": args.deadline_s}
+    if args.job_id:
+        spec["job"] = args.job_id
+    if args.rhs is not None:
+        spec["rhs"] = args.rhs
+    if args.scale is not None:
+        spec["scale"] = args.scale
+    try:
+        job = sjobs.submit(args.spool, spec)
+    except ValueError as e:
+        raise SystemExit(f"submit: {e}")
+    print(f">submitted {job} -> "
+          f"{sjobs.result_path(args.spool, job)}")
+
+
+def cmd_jobs(args):
+    """Job table of a solve-service spool, folded from the journal —
+    works on a live daemon's spool (the journal is append-only and
+    torn-tail tolerant) and on a crashed one (what WOULD replay)."""
+    from pcg_mpi_solver_tpu.serve import jobs as sjobs
+    from pcg_mpi_solver_tpu.serve.journal import read_journal, replay_jobs
+
+    path = sjobs.journal_path(args.spool)
+    if not os.path.exists(path):
+        raise SystemExit(f"jobs: no journal at {path}")
+    events, truncated = read_journal(path)
+    states = replay_jobs(events)
+    if truncated:
+        print(f">warning: {truncated} torn journal line(s) skipped")
+    print(f">{'job':12s} {'ordinal':>7s} {'state':12s} verdict")
+    for st in sorted(states.values(),
+                     key=lambda s: (s["ordinal"] is None,
+                                    s["ordinal"] or 0)):
+        o = "-" if st["ordinal"] is None else str(st["ordinal"])
+        print(f">{st['job']:12s} {o:>7s} {st['op'] or '?':12s} "
+              f"{st['verdict'] or ''}")
+    n_term = sum(st["terminal"] for st in states.values())
+    print(f">{len(states)} job(s), {n_term} terminal, "
+          f"{len(states) - n_term} in flight")
 
 
 def cmd_validate(args):
@@ -1051,6 +1169,82 @@ def main(argv=None):
     _add_cache_flag(p)
     _add_preflight_flag(p)
     p.set_defaults(fn=cmd_solve_many)
+
+    p = sub.add_parser("serve",
+                       help="multi-tenant solve daemon: admit filesystem-"
+                            "submitted jobs against one warm operator "
+                            "(cost-model deadline pricing, bounded queue "
+                            "with load shedding, nrhs packing, crash-"
+                            "durable exactly-once journal)")
+    p.add_argument("scratch", nargs="?", default=None,
+                   help="scratch dir with an ingested model (or use "
+                        "--synthetic)")
+    p.add_argument("--spool", required=True, metavar="DIR",
+                   help="service root: incoming/, results/, "
+                        "journal.jsonl")
+    p.add_argument("--synthetic", default=None, metavar="NX[,NY,NZ]",
+                   help="serve a synthetic heterogeneous cube instead "
+                        "of a scratch model")
+    p.add_argument("--queue-max", type=int, default=16,
+                   help="bounded admission queue depth (default 16); "
+                        "arrivals beyond it shed past-deadline jobs or "
+                        "are rejected queue_full")
+    p.add_argument("--widths", default="1,2,4,8",
+                   help="standard nrhs block widths jobs are packed "
+                        "into (default 1,2,4,8; the AOT cache compiles "
+                        "once per width)")
+    p.add_argument("--expected-iters", type=int, default=None,
+                   help="iteration count admission prices deadlines "
+                        "against (default: the solver max_iter cap — "
+                        "conservative)")
+    p.add_argument("--poll-s", type=float, default=0.05,
+                   help="incoming-directory poll interval (default 0.05)")
+    p.add_argument("--idle-exit-s", type=float, default=None,
+                   help="drain after this long idle (default: serve "
+                        "forever until SIGTERM)")
+    p.add_argument("--max-blocks", type=int, default=None,
+                   help="drain after dispatching N blocks (bench/test "
+                        "knob)")
+    p.add_argument("--settings", default=None)
+    p.add_argument("--n-parts", type=int, default=None)
+    p.add_argument("--tol", type=float, default=None)
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--precision", choices=["direct", "mixed"], default=None)
+    p.add_argument("--precond", choices=_precond_choices(), default=None)
+    _add_variant_flag(p)
+    p.add_argument("--backend",
+                   choices=["auto", "structured", "hybrid", "general"],
+                   default="auto")
+    _add_telemetry_flags(p)
+    _add_cache_flag(p)
+    _add_preflight_flag(p)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("submit",
+                       help="submit one job to a solve-service spool "
+                            "(atomic drop; import-light — works from a "
+                            "login node)")
+    p.add_argument("--spool", required=True, metavar="DIR")
+    p.add_argument("--scale", type=float, default=None,
+                   help="load case = scale * the model's reference "
+                        "load F")
+    p.add_argument("--rhs", default=None, metavar="FILE.npy",
+                   help="load case = an (n_dof,) .npy column (exactly "
+                        "one of --scale / --rhs)")
+    p.add_argument("--deadline-s", type=float, default=3600.0,
+                   help="relative deadline admission prices against "
+                        "(default 3600)")
+    p.add_argument("--job-id", default=None,
+                   help="explicit job id (default: generated); "
+                        "resubmitting a consumed id is dropped — "
+                        "exactly-once is per id")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("jobs",
+                       help="job table of a solve-service spool, folded "
+                            "from the crash-durable journal")
+    p.add_argument("--spool", required=True, metavar="DIR")
+    p.set_defaults(fn=cmd_jobs)
 
     p = sub.add_parser("validate",
                        help="run the validate/ preflight checks against "

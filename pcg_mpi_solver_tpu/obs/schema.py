@@ -164,6 +164,37 @@ EVENT_KINDS: Dict[str, tuple] = {
     # mismatch (Solver.resume_elastic): the writing fleet's process
     # count, this fleet's, and which store took it (snap | many | ckpt)
     "elastic_resume": ("from_procs", "to_procs", "prefix"),
+    # one ADMITTED solve-service job (serve/admission.py): its absolute
+    # admission ordinal, the PR 12 cost-model price the admission was
+    # judged against (predicted block seconds; null when the model is
+    # unavailable — the pricing degrades to admit, never to a crash)
+    # and the job's relative deadline
+    "job_admit": ("job", "ordinal", "predicted_s", "deadline_s"),
+    # one REJECTED admission with its NAMED reason
+    # (deadline_infeasible | queue_full | draining | bad_spec) — the
+    # no-silent-drops contract: a job the service will not run always
+    # says why, in the stream and in its result file
+    "job_reject": ("job", "reason"),
+    # one load-SHED job (bounded-queue backpressure, serve/): the queue
+    # was full and this already-admitted job was past its deadline, so
+    # it was dropped — oldest first — with a named reason, never
+    # silently
+    "job_shed": ("job", "reason"),
+    # one FINISHED solve-service job: ok = converged (flag 0); failed
+    # jobs carry the named verdict ("injected: ..." for a chaos-
+    # injected failure, "flagN" for a solver flag, "quarantined" for a
+    # PR 8 column quarantine)
+    "job_done": ("job", "ok", "verdict"),
+    # a tenant's request quarantined without failing its co-batched
+    # block: either the PR 8 per-column quarantine fired in-solve (the
+    # event adds `rhs`, the column index) or the service boundary
+    # caught a poisoned/non-finite RHS before dispatch
+    "job_quarantine": ("job", "verdict"),
+    # solve-service daemon drain/exit record (reason = sigterm | idle |
+    # max_blocks): in-flight blocks finished, new admissions rejected,
+    # journal closed clean — the graceful twin of the SIGKILL the job
+    # journal replays through
+    "serve_drain": ("reason",),
     # end-of-run counter/gauge/span snapshot
     "run_summary": ("counters", "gauges"),
 }
@@ -214,6 +245,14 @@ BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline")
 #  on single-process captures and whenever the fleet report carried no
 #  matched collectives — same never-fabricate contract as the ISSUE 15
 #  fields above.
+#  ``jobs_per_s`` / ``jobs_per_s_serial`` / ``queue_depth_max`` /
+#  ``jobs_shed`` (ISSUE 19, serve/) are the BENCH_SERVE=1 sustained-
+#  throughput fields: completed jobs per second with the saturated
+#  queue packing nrhs blocks, the one-at-a-time (width-1) dispatch
+#  baseline the ratio is judged against, the deepest the bounded queue
+#  got, and how many jobs backpressure shed.  ABSENT (not null) on
+#  every other leg — a line must never fabricate service throughput
+#  that was not served.
 BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "nrhs_planned", "dof_iter_rhs_per_s",
                         "nrhs_quarantined", "nrhs_recoveries",
@@ -223,7 +262,9 @@ BENCH_DETAIL_NUMERIC = ("setup_s", "time_to_first_iter_s", "nrhs",
                         "partition_serial_s", "cold_setup_s",
                         "warm_setup_s", "ingest_peak_bytes",
                         "measured_ms_per_iter_matvec", "overlap_frac",
-                        "skew_frac", "straggler_rank")
+                        "skew_frac", "straggler_rank",
+                        "jobs_per_s", "jobs_per_s_serial",
+                        "queue_depth_max", "jobs_shed")
 # ``setup_cache``: warm-path partition attribution (cache/ subsystem).
 BENCH_SETUP_CACHE_VALUES = ("off", "cold", "warm")
 # ``pcg_variant``: the engaged PCG loop formulation of the line's

@@ -23,6 +23,14 @@ it, and when will it finish.
   model-paced but data-rated.  Every input is optional; a missing one
   degrades the ETA to a named reason, never a crash.
 
+Pointed at a solve-service journal (``pcg-tpu watch
+spool/journal.jsonl``, ISSUE 19) the same snapshot additionally folds
+the job lifecycle: per-op counts, the in-flight job set, and the
+graceful-drain marker (a drained journal reads DONE — silence after the
+drain record is the expected end state, while a SIGKILLed daemon's
+journal keeps its ``serve`` bracket open and trips the same stall alarm
+over the missing heartbeats).
+
 Import-light by contract (no jax/numpy): watching must work from a
 laptop over an rsync'd artifact dir, and from ``tools/hw_session.py``
 before any accelerator env is configured.  Read-side only — the monitor
@@ -129,6 +137,46 @@ def _rate_decades_per_iter(events: List[Dict[str, Any]]
     return None
 
 
+def _serve_section(events: List[Dict[str, Any]]
+                   ) -> Optional[Dict[str, Any]]:
+    """Fold serve-journal records (ISSUE 19: job-lifecycle ops tagged
+    with the ``journal`` schema field) into per-op counts + the in-
+    flight job set; None when the stream is not a serve journal.  The
+    daemon's liveness rides the same heartbeats the stall detector
+    already watches — this section adds the per-job progress."""
+    from pcg_mpi_solver_tpu.serve.journal import (
+        DRAIN_OP, JOB_OPS, TERMINAL_OPS)
+
+    counts: Dict[str, int] = {}
+    in_flight: Dict[str, str] = {}
+    drained = False
+    drain_reason = None
+    for ev in events:
+        if ev.get("kind") != "flight" or not ev.get("journal"):
+            continue
+        op = ev.get("op")
+        if op == DRAIN_OP:
+            drained = True
+            drain_reason = ev.get("reason")
+            continue
+        if op not in JOB_OPS:
+            continue
+        counts[op] = counts.get(op, 0) + 1
+        jobs = ev.get("jobs") if isinstance(ev.get("jobs"), list) \
+            else [ev.get("job")]
+        for job in jobs:
+            if not isinstance(job, str):
+                continue
+            if op in TERMINAL_OPS:
+                in_flight.pop(job, None)
+            else:
+                in_flight[job] = op
+    if not counts and not drained:
+        return None
+    return {"jobs": counts, "in_flight": sorted(in_flight),
+            "drained": drained, "drain_reason": drain_reason}
+
+
 def watch_snapshot(path: str, now: Optional[float] = None,
                    stall_after_s: Optional[float] = None,
                    tol: float = 1e-8) -> Dict[str, Any]:
@@ -193,11 +241,18 @@ def watch_snapshot(path: str, now: Optional[float] = None,
         iters_left = math.log10(last_relres / tol) / (-rate)
         eta_s = round(iters_left * predicted_ms / 1e3, 3)
 
+    serve = _serve_section(all_events)
     live = [sh for sh in shards if sh["last_t"] is not None]
     silent = [sh for sh in shards
               if sh["silent_s"] is None or sh["silent_s"] > threshold]
     done = bool(live) and all(sh["done"] for sh in live) \
         and not any(sh["in_flight"] for sh in live)
+    # a gracefully-drained serve journal is DONE, not stalled: the
+    # daemon stamped its drain record and closed the bracket — silence
+    # after that is the expected end state, not a wedged run
+    if serve is not None and serve["drained"] \
+            and not any(sh["in_flight"] for sh in live):
+        done = bool(live)
     if not live:
         status = "empty"
     elif done:
@@ -218,6 +273,7 @@ def watch_snapshot(path: str, now: Optional[float] = None,
                     ("path", "truncated", "last_t", "silent_s",
                      "in_flight", "done", "salvaged_tail")}
                    for sh in shards],
+        "serve": serve,
         "dispatches": dispatches, "steps": steps,
         "last_note": last_note, "last_relres": last_relres,
         "rate_decades_per_iter": round(rate, 5) if rate is not None
@@ -246,6 +302,15 @@ def format_watch(snap: Dict[str, Any]) -> str:
             extra += "  done"
         lines.append(f"  shard {os.path.basename(sh['path'])}: "
                      f"last record {age}{extra}")
+    srv = snap.get("serve")
+    if srv is not None:
+        ops = "  ".join(f"{k}={v}" for k, v in sorted(srv["jobs"].items()))
+        lines.append(f"  serve jobs: {ops}" if ops else "  serve jobs: -")
+        if srv["in_flight"]:
+            lines.append("  in-flight jobs: "
+                         + ", ".join(srv["in_flight"]))
+        if srv["drained"]:
+            lines.append(f"  serve drained ({srv['drain_reason']})")
     if snap["dispatches"]:
         disp = "  ".join(f"{k}x{v}"
                          for k, v in sorted(snap["dispatches"].items()))

@@ -10,20 +10,22 @@ A :class:`FaultPlan` is parsed from a spec string (env ``PCG_TPU_FAULTS``
 or passed programmatically, e.g. ``Solver.fault_plan = FaultPlan(...)``):
 
     spec     := term ("," term)*
-    term     := mode "@" ["s:" | "col:" | "rank:" rank ":"] index
-                ["*" count]
+    term     := mode "@" ["s:" | "col:" | "job:" | "rank:" rank ":"]
+                index ["*" count]
     mode     := "kill" | "exc" | "nan" | "inf" | "rho0" | "sleep"
     index    := 0-based position in the mode's counter (see below);
                 with the "s:" prefix, the ABSOLUTE timestep number of a
                 time-history run; with the "col:" prefix, the COLUMN
-                index of a blocked multi-RHS solve; with the "rank:"
-                prefix, the dispatch/boundary counter index on process
-                ``rank`` only (omitted index = 0: ``kill@rank:1`` ==
+                index of a blocked multi-RHS solve; with the "job:"
+                prefix, the ABSOLUTE admission ordinal of a solve-
+                service job (serve/); with the "rank:" prefix, the
+                dispatch/boundary counter index on process ``rank``
+                only (omitted index = 0: ``kill@rank:1`` ==
                 ``kill@rank:1:0``)
     count    := consecutive firings (default 1; "exc@3*2" also fails the
                 first retry of dispatch 3)
 
-Five counter domains.  The first two are monotone over the life of the
+Six counter domains.  The first two are monotone over the life of the
 plan (they keep running across recovery restarts, so a second fault can
 be aimed at a later ladder rung):
 
@@ -52,6 +54,20 @@ be aimed at a later ladder rung):
   a ``jnp.where`` column select, never a whole-block rescale).
   ``*count`` re-fires it at that many consecutive boundaries to defeat
   a bounded per-column recovery budget;
+* the JOB domain ("job:" prefix — ``exc@job:1``, ``nan@job:0``,
+  ``sleep@job:2``) is indexed by the ABSOLUTE admission ordinal of a
+  solve-service job (``serve/``, :meth:`FaultPlan.at_job`): the fault
+  fires at the SERVICE BOUNDARY, when the daemon is about to dispatch
+  the block containing the k-th admitted job — ``exc`` raises
+  :class:`InjectedDispatchError` (the job fails with a named verdict,
+  its co-batched tenants dispatch unharmed), ``nan`` asks the daemon
+  to poison THAT job's RHS column (the service-boundary quarantine
+  drill), ``sleep`` delays the whole block on the host (the
+  deterministic window the SIGKILL chaos test fires inside).  Ordinals
+  never reset: a restarted daemon continues the journal's admission
+  numbering, and replay pre-consumes the ordinals the journal proves
+  already passed the boundary (:meth:`FaultPlan.replay_consume_job`) —
+  same never-re-fire contract as the step domain's absolute indexing;
 * the RANK domain ("rank:" prefix — ``kill@rank:1``, ``exc@rank:0``,
   ``sleep@rank:1:3``) gates a dispatch/boundary-counter fault on ONE
   process of a multi-controller run, so distributed chaos drills are
@@ -101,6 +117,7 @@ _DISPATCH_MODES = ("exc",)
 _BOUNDARY_MODES = ("kill", "nan", "inf", "rho0", "sleep")
 _STEP_MODES = ("kill", "nan", "inf")
 _COL_MODES = ("nan", "inf", "rho0")
+_JOB_MODES = ("exc", "nan", "sleep")
 
 
 class SimulatedKill(BaseException):
@@ -119,12 +136,15 @@ class InjectedDispatchError(RuntimeError):
 
 def _parse(spec: str):
     """spec string -> ({mode: {index: count}}, {mode: {step: count}},
-    {mode: {col: count}}, {mode: {(rank, index): count}}) for the
-    dispatch/boundary domains, the step domain, the per-column domain
-    of blocked multi-RHS solves, and the per-process rank domain."""
+    {mode: {col: count}}, {mode: {job: count}},
+    {mode: {(rank, index): count}}) for the dispatch/boundary domains,
+    the step domain, the per-column domain of blocked multi-RHS solves,
+    the per-job domain of the solve service, and the per-process rank
+    domain."""
     out: Dict[str, Dict[int, int]] = {}
     steps: Dict[str, Dict[int, int]] = {}
     cols: Dict[str, Dict[int, int]] = {}
+    jobs: Dict[str, Dict[int, int]] = {}
     ranks: Dict[str, Dict[tuple, int]] = {}
     for term in (t.strip() for t in spec.split(",")):
         if not term:
@@ -138,6 +158,7 @@ def _parse(spec: str):
             rest = rest.strip()
             step_domain = rest.startswith("s:")
             col_domain = rest.startswith("col:")
+            job_domain = rest.startswith("job:")
             rank_domain = rest.startswith("rank:")
             rank = None
             if rank_domain:
@@ -147,12 +168,12 @@ def _parse(spec: str):
                 rank = int(bits[0])
                 idx = int(bits[1]) if len(bits) > 1 else 0
             else:
-                idx = int(rest[4:] if col_domain
+                idx = int(rest[4:] if col_domain or job_domain
                           else rest[2:] if step_domain else rest)
         except ValueError:
             raise ValueError(
                 f"bad fault term {term!r} "
-                "(want mode@[s:|col:|rank:R:]index[*count])")
+                "(want mode@[s:|col:|job:|rank:R:]index[*count])")
         mode = mode.strip()
         if mode not in MODES:
             raise ValueError(f"unknown fault mode {mode!r} "
@@ -174,9 +195,15 @@ def _parse(spec: str):
                     f"fault mode {mode!r} has no column-domain trigger "
                     f"(valid at col: indices: {', '.join(_COL_MODES)})")
             cols.setdefault(mode, {})[idx] = count
+        elif job_domain:
+            if mode not in _JOB_MODES:
+                raise ValueError(
+                    f"fault mode {mode!r} has no job-domain trigger "
+                    f"(valid at job: indices: {', '.join(_JOB_MODES)})")
+            jobs.setdefault(mode, {})[idx] = count
         else:
             out.setdefault(mode, {})[idx] = count
-    return out, steps, cols, ranks
+    return out, steps, cols, jobs, ranks
 
 
 class FaultPlan:
@@ -189,7 +216,7 @@ class FaultPlan:
 
     def __init__(self, spec: str, recorder=None):
         (self._faults, self._step_faults, self._col_faults,
-         self._rank_faults) = _parse(spec)
+         self._job_faults, self._rank_faults) = _parse(spec)
         self.recorder = recorder
         self.dispatches = 0         # completed Krylov dispatches
         self.boundaries = 0         # completed chunk boundaries
@@ -209,7 +236,8 @@ class FaultPlan:
     @property
     def armed(self) -> bool:
         return (any(self._faults.values()) or self.step_armed
-                or self.col_armed or any(self._rank_faults.values()))
+                or self.col_armed or self.job_armed
+                or any(self._rank_faults.values()))
 
     @property
     def step_armed(self) -> bool:
@@ -220,6 +248,11 @@ class FaultPlan:
     def col_armed(self) -> bool:
         """Any column-domain fault still pending."""
         return any(self._col_faults.values())
+
+    @property
+    def job_armed(self) -> bool:
+        """Any job-domain (service-boundary) fault still pending."""
+        return any(self._job_faults.values())
 
     def next_step_fault(self, after: int) -> Optional[int]:
         """Smallest pending step-domain index > ``after``, or None — the
@@ -372,6 +405,54 @@ class FaultPlan:
         if pending[col] <= 0:
             del pending[col]
         return True
+
+    def _take_job(self, mode: str, job: int) -> bool:
+        pending = self._job_faults.get(mode, {})
+        if pending.get(job, 0) <= 0:
+            return False
+        pending[job] -= 1
+        if pending[job] <= 0:
+            del pending[job]
+        return True
+
+    def at_job(self, ordinal: int) -> Optional[str]:
+        """Called by the solve service at the SERVICE BOUNDARY — the
+        daemon is about to dispatch the block containing the job with
+        ABSOLUTE admission ordinal ``ordinal`` (serve/daemon.py).
+        Fires in straggler-first order, like :meth:`at_boundary`:
+        ``sleep`` delays the host (the whole block arrives late — the
+        deterministic window the SIGKILL chaos test fires inside), then
+        ``nan`` returns ``"nan"`` asking the caller to poison THAT
+        job's RHS column, then ``exc`` raises
+        :class:`InjectedDispatchError` (the job fails with a named
+        verdict while its co-batched tenants dispatch unharmed).  A job
+        ordinal never admitted simply never reaches this hook —
+        the cannot-land contract needs no width check here."""
+        poison = None
+        if self._take_job("sleep", ordinal):
+            self._fire("sleep", "job", ordinal)
+            time.sleep(self.sleep_s)
+        if self._take_job("nan", ordinal):
+            self._fire("nan", "job", ordinal)
+            poison = "nan"
+        if self._take_job("exc", ordinal):
+            self._fire("exc", "job", ordinal)
+            raise InjectedDispatchError(
+                f"injected service-boundary failure for job ordinal "
+                f"{ordinal} (PCG_TPU_FAULTS job domain)")
+        return poison
+
+    def replay_consume_job(self, ordinal: int) -> None:
+        """Journal-replay pre-consumption: drop every pending job-domain
+        fault aimed at ``ordinal`` WITHOUT firing or recording it.  A
+        restarted daemon re-parses ``PCG_TPU_FAULTS`` into a fresh plan,
+        but the journal proves ordinal ``ordinal`` already passed the
+        service boundary (a ``dispatched`` or terminal record) — its
+        fault was consumed by the dead process, and the absolute-
+        indexing contract (step-domain precedent) says replay must
+        never re-fire it."""
+        for pending in self._job_faults.values():
+            pending.pop(ordinal, None)
 
     def _take_step(self, mode: str, t: int) -> bool:
         pending = self._step_faults.get(mode, {})

@@ -1,0 +1,37 @@
+"""Multi-tenant solve service (ISSUE 19): admission control,
+backpressure, nrhs packing and crash-durable exactly-once job execution
+over the blocked solve engine.
+
+The service is a filesystem protocol — no network dependency:
+
+* ``spool/incoming/<job>.json`` — atomically-submitted job specs
+  (``pcg-tpu submit``, :mod:`serve.jobs`);
+* ``spool/results/<job>.json`` (+ ``.npy``) — atomically-written
+  outcomes, ALWAYS carrying a named verdict (done, failed, rejected or
+  shed — the no-silent-drops contract);
+* ``spool/journal.jsonl`` — the fsync'd job journal
+  (:mod:`serve.journal`, riding the PR 12 flight-recorder idiom):
+  ``admitted``/``packed``/``dispatched``/``done``/``failed`` brackets
+  whose replay gives exactly-once semantics across daemon death.
+
+Layers: :mod:`serve.jobs` (spool IO), :mod:`serve.journal` (durable
+journal + replay), :mod:`serve.admission` (cost-model pricing, bounded
+queue, load shedding), :mod:`serve.packer` (standard nrhs widths),
+:mod:`serve.daemon` (the loop: signals, dispatch through
+``Solver.solve_many`` so PR 8 per-column quarantine isolates a
+poisoned tenant).  Everything except the daemon is import-light (no
+jax/numpy) so admission/journal logic is unit-testable in milliseconds.
+"""
+
+from pcg_mpi_solver_tpu.serve.admission import AdmissionController
+from pcg_mpi_solver_tpu.serve.daemon import ServeDaemon
+from pcg_mpi_solver_tpu.serve.journal import (
+    JOB_OPS, SERVE_JOURNAL_SCHEMA, TERMINAL_OPS, JobJournal,
+    read_journal, replay_jobs)
+from pcg_mpi_solver_tpu.serve.packer import STANDARD_WIDTHS, pack_block
+
+__all__ = [
+    "AdmissionController", "JobJournal", "JOB_OPS", "SERVE_JOURNAL_SCHEMA",
+    "ServeDaemon", "TERMINAL_OPS", "STANDARD_WIDTHS", "pack_block",
+    "read_journal", "replay_jobs",
+]

@@ -1,0 +1,153 @@
+"""Admission control + bounded-queue backpressure for the solve service
+(ISSUE 19 tentpole).
+
+Every admission is PRICED with the PR 12 analytic cost model: the
+solver's roofline-predicted ms/iter at the service's widest standard
+block width x the expected iteration count is the predicted wall a job
+will wait+run, judged against the job's deadline — infeasible jobs are
+rejected at the door with the named ``deadline_infeasible`` reason
+instead of admitted into certain SLO violation.  A degraded model
+(exotic platform, no profile) prices as None and ADMITS: pricing is an
+observability-derived optimization, never a solve gate.
+
+The queue is BOUNDED (``queue_max``).  When an arrival finds it full,
+backpressure sheds the oldest already-past-deadline queued job first
+(``job_shed`` event + journal record + result file — never silent); if
+nothing is sheddable the arrival itself is rejected ``queue_full``.
+
+Every decision outcome — accept, reject, shed — emits a
+schema-versioned telemetry event (obs/schema.py: ``job_admit`` /
+``job_reject`` / ``job_shed``), which the analysis/
+``serve-admission-events`` fast rule statically enforces against THIS
+module.
+
+Import-light by contract (no jax/numpy): admission logic unit-tests in
+milliseconds with a stub pricer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: Named rejection reasons (the full vocabulary — tests and the RUNBOOK
+#: table key off these strings).
+REJECT_DEADLINE = "deadline_infeasible"
+REJECT_QUEUE_FULL = "queue_full"
+REJECT_DRAINING = "draining"
+SHED_PAST_DEADLINE = "past_deadline_backpressure"
+
+
+def price_admission(predicted_ms_per_iter: Optional[float],
+                    expected_iters: int) -> Optional[float]:
+    """Predicted seconds to serve one block: cost-model ms/iter x the
+    expected iteration count.  None (model unavailable) means the
+    pricing cannot reject — admission degrades open, loudly."""
+    if predicted_ms_per_iter is None:
+        return None
+    return float(predicted_ms_per_iter) * max(1, int(expected_iters)) \
+        / 1e3
+
+
+class AdmissionController:
+    """Bounded admission queue with cost-model pricing and load-shedding
+    backpressure.
+
+    ``pricer(nrhs) -> ms_per_iter | None`` is the cost-model hook (the
+    daemon passes ``Solver.predicted_ms_per_iter``); ``journal`` and
+    ``recorder`` take the durable record and the telemetry event of
+    every decision.  The controller owns ordinals (continuing the
+    journal's numbering via ``ordinal0``) and the queue; the daemon owns
+    dispatch.
+    """
+
+    def __init__(self, queue_max: int, *, pricer: Callable, journal,
+                 recorder, expected_iters: int, price_width: int = 1,
+                 ordinal0: int = 0,
+                 on_shed: Optional[Callable] = None):
+        self.queue_max = max(1, int(queue_max))
+        self._pricer = pricer
+        self._journal = journal
+        self._rec = recorder
+        self.expected_iters = max(1, int(expected_iters))
+        self.price_width = max(1, int(price_width))
+        self._next_ordinal = int(ordinal0)
+        self._on_shed = on_shed      # daemon hook: result file per shed
+        self.queue: List[Dict[str, Any]] = []
+        self.depth_max = 0
+        self.shed_count = 0
+        self.draining = False
+
+    # -- decisions ------------------------------------------------------
+    def admit(self, spec: Dict[str, Any],
+              now: Optional[float] = None) -> Tuple[str, Any]:
+        """One admission decision for a validated spec: ``("admitted",
+        entry)`` or ``("rejected", reason)``.  Every path journals and
+        emits — no silent outcome exists."""
+        now = time.time() if now is None else now
+        job = spec["job"]
+        if self.draining:
+            return self._reject(job, REJECT_DRAINING)
+        deadline_s = float(spec.get("deadline_s", 0.0))
+        predicted_s = price_admission(self._pricer(self.price_width),
+                                      self.expected_iters)
+        if predicted_s is not None and predicted_s > deadline_s:
+            return self._reject(
+                job, REJECT_DEADLINE,
+                predicted_s=round(predicted_s, 6), deadline_s=deadline_s)
+        if len(self.queue) >= self.queue_max:
+            self.shed_past_deadline(now)
+            if len(self.queue) >= self.queue_max:
+                return self._reject(job, REJECT_QUEUE_FULL,
+                                    queue_depth=len(self.queue))
+        entry = {"job": job, "spec": dict(spec),
+                 "ordinal": self._next_ordinal,
+                 "deadline_t": now + deadline_s, "admit_t": now}
+        self._next_ordinal += 1
+        self.queue.append(entry)
+        self.depth_max = max(self.depth_max, len(self.queue))
+        self._journal.record("admitted", job, spec=entry["spec"],
+                             ordinal=entry["ordinal"],
+                             deadline_t=entry["deadline_t"])
+        self._rec.event("job_admit", job=job, ordinal=entry["ordinal"],
+                        predicted_s=predicted_s, deadline_s=deadline_s)
+        return "admitted", entry
+
+    def requeue(self, entry: Dict[str, Any]) -> None:
+        """Journal replay re-enqueues an already-admitted job with its
+        ORIGINAL ordinal/deadline — no second ``admitted`` record, no
+        second pricing: the admission already happened and survived the
+        crash."""
+        self.queue.append(dict(entry))
+        self.queue.sort(key=lambda e: e["ordinal"])
+        self.depth_max = max(self.depth_max, len(self.queue))
+        self._next_ordinal = max(self._next_ordinal,
+                                 int(entry["ordinal"]) + 1)
+
+    def shed_past_deadline(self, now: Optional[float] = None
+                           ) -> List[Dict[str, Any]]:
+        """Backpressure: drop queued jobs already past their deadline,
+        oldest first, each with the named ``job_shed`` reason (journal
+        record + event; the daemon writes their result files).  Returns
+        the shed entries."""
+        now = time.time() if now is None else now
+        keep, shed = [], []
+        for e in sorted(self.queue, key=lambda e: e["ordinal"]):
+            (shed if e["deadline_t"] < now else keep).append(e)
+        if shed:
+            self.queue = keep
+            self.shed_count += len(shed)
+            for e in shed:
+                self._journal.record("shed", e["job"],
+                                     reason=SHED_PAST_DEADLINE,
+                                     ordinal=e["ordinal"])
+                self._rec.event("job_shed", job=e["job"],
+                                reason=SHED_PAST_DEADLINE)
+                if self._on_shed is not None:
+                    self._on_shed(e, SHED_PAST_DEADLINE)
+        return shed
+
+    def _reject(self, job: str, reason: str, **fields) -> Tuple[str, str]:
+        self._journal.record("rejected", job, reason=reason, **fields)
+        self._rec.event("job_reject", job=job, reason=reason, **fields)
+        return "rejected", reason

@@ -1,0 +1,420 @@
+"""The solve-service daemon (ISSUE 19 tentpole): poll -> admit ->
+pack -> dispatch, exactly once per job, forever (or until drained).
+
+One :class:`ServeDaemon` owns one warm :class:`~pcg_mpi_solver_tpu.
+solver.driver.Solver` and one spool directory.  The loop:
+
+1. **poll** ``spool/incoming`` (serve/jobs.py) — validate each spec,
+   drop duplicates the journal already knows (crash remnants / double
+   submissions), and push the rest through admission control
+   (serve/admission.py: cost-model pricing, bounded queue, shedding);
+2. **pack** compatible queued jobs into an nrhs block of standard
+   width (serve/packer.py) and journal the ``packed`` bracket;
+3. **dispatch** the block through ``Solver.solve_many`` — the PR 8
+   per-column recovery/quarantine path, so one tenant's poisoned RHS
+   quarantines ALONE while its co-batched tenants finish unharmed;
+4. **finish** each job: atomic result file FIRST, then the terminal
+   journal record (``done``/``failed``) — the crash-ordering contract
+   that makes replay exactly-once.
+
+**Crash durability**: every lifecycle transition is an fsync'd journal
+record (serve/journal.py).  On startup :meth:`ServeDaemon` replays the
+journal — terminal jobs stay terminal, a dispatched-but-unrecorded job
+whose result file survived is completed from it (``replayed=true``),
+anything else re-enqueues with its ORIGINAL ordinal and deadline.  A
+SIGKILL therefore never loses a job and never solves one twice.
+
+**Faults**: the ``@job:`` domain of resilience/faultinject.py fires at
+the service boundary per absolute admission ordinal (``exc@job:k``
+fails the job with a named verdict, ``nan@job:k`` poisons its RHS
+column so quarantine isolation is exercised end-to-end, ``sleep@job:k``
+delays the block).  Replay pre-consumes ordinals the journal shows as
+already dispatched/terminal, so a restart never re-fires a fault a
+previous daemon generation already consumed.
+
+**Signals**: SIGTERM flips admission into draining (new arrivals
+rejected ``draining``), finishes every in-flight/queued block, stamps
+the ``drain`` journal record + ``serve_drain`` event and exits clean.
+SIGKILL is the chaos case the journal exists for.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Any, Dict, List, Optional
+
+from pcg_mpi_solver_tpu.serve import jobs as sjobs
+from pcg_mpi_solver_tpu.serve.admission import AdmissionController
+from pcg_mpi_solver_tpu.serve.journal import (
+    JobJournal, next_ordinal, read_journal, replay_jobs)
+from pcg_mpi_solver_tpu.serve.packer import (
+    STANDARD_WIDTHS, normalize_widths, pack_block)
+
+DEFAULT_QUEUE_MAX = 16
+DEFAULT_POLL_S = 0.05
+
+
+class ServeDaemon:
+    """Multi-tenant solve service over one warm solver + one spool.
+
+    ``solver`` must already be constructed (operator partitioned and
+    resident); ``spool`` is the filesystem protocol root.  ``run()``
+    is the loop; ``poll_once()`` + ``serve_block()`` are the testable
+    single steps.  Construction replays the journal, so building a
+    daemon over a crashed spool IS the recovery procedure.
+    """
+
+    def __init__(self, solver, spool: str, *,
+                 queue_max: int = DEFAULT_QUEUE_MAX,
+                 widths=STANDARD_WIDTHS,
+                 expected_iters: Optional[int] = None,
+                 fault_plan=None,
+                 poll_s: float = DEFAULT_POLL_S,
+                 journal_fsync: Optional[bool] = None):
+        self.solver = solver
+        self.spool = spool
+        sjobs.ensure_spool(spool)
+        self._rec = solver.recorder
+        self.widths = normalize_widths(widths)
+        self.poll_s = float(poll_s)
+        self.journal = JobJournal(sjobs.journal_path(spool),
+                                  fsync=journal_fsync)
+        if fault_plan is None:
+            from pcg_mpi_solver_tpu.resilience import FaultPlan
+
+            fault_plan = FaultPlan.from_env(recorder=self._rec)
+        self.fault_plan = fault_plan
+        if expected_iters is None:
+            # conservative default: a job must be feasible even if it
+            # runs to the iteration cap (admission prices worst case)
+            expected_iters = int(solver.config.solver.max_iter)
+        self.admission = AdmissionController(
+            queue_max, pricer=solver.predicted_ms_per_iter,
+            journal=self.journal, recorder=self._rec,
+            expected_iters=expected_iters,
+            price_width=max(self.widths),
+            on_shed=self._finish_shed)
+        self.jobs_done = 0
+        self.jobs_failed = 0
+        self.blocks = 0
+        self._seen: set = set()      # every job id the journal knows
+        self._drain_requested = False
+        self._replay()
+
+    # -- replay ---------------------------------------------------------
+    def _replay(self) -> None:
+        """Fold the journal into queue + seen-set + fault state: the
+        exactly-once restart path (no-op on a fresh spool)."""
+        events, truncated = read_journal(self.journal.path)
+        states = replay_jobs(events)
+        if truncated:
+            self._rec.note(f"serve journal: {truncated} torn line(s) "
+                           f"skipped (crash artifact)")
+        self.admission._next_ordinal = next_ordinal(states)
+        plan = self.fault_plan
+        for st in sorted(states.values(),
+                         key=lambda s: (s["ordinal"] is None,
+                                        s["ordinal"] or 0)):
+            job = st["job"]
+            self._seen.add(job)
+            ordinal = st["ordinal"]
+            if st["terminal"]:
+                # a consumed service-boundary fault must not re-fire
+                if plan is not None and isinstance(ordinal, int):
+                    plan.replay_consume_job(ordinal)
+                continue
+            if plan is not None and isinstance(ordinal, int) \
+                    and "dispatched" in st["ops"]:
+                plan.replay_consume_job(ordinal)
+            result = sjobs.read_result(self.spool, job)
+            if result is not None:
+                # crashed AFTER the result write but BEFORE the
+                # terminal record: complete from the result, never
+                # re-solve (the exactly-once ordering contract)
+                ok = bool(result.get("ok"))
+                verdict = result.get("verdict", "unknown")
+                self.journal.record("done" if ok else "failed", job,
+                                    verdict=verdict, replayed=True)
+                self._rec.event("job_done", job=job, ok=ok,
+                                verdict=verdict, replayed=True)
+                self._count_finish(ok)
+                continue
+            if st["spec"] is None or ordinal is None:
+                self._finish_failed(
+                    {"job": job, "ordinal": -1},
+                    "replay_unrecoverable: admitted record incomplete")
+                continue
+            self.admission.requeue({
+                "job": job, "spec": st["spec"], "ordinal": ordinal,
+                "deadline_t": st["deadline_t"] or 0.0,
+                "admit_t": st["deadline_t"] or 0.0})
+        if self.admission.queue:
+            self._rec.note(f"serve replay: {len(self.admission.queue)} "
+                           f"job(s) re-enqueued from journal")
+
+    # -- admission ------------------------------------------------------
+    def poll_once(self, now: Optional[float] = None) -> int:
+        """One incoming-directory sweep; returns the number of jobs
+        admitted.  Every file is consumed with a journaled outcome —
+        admitted, rejected (named reason) or duplicate-dropped."""
+        admitted = 0
+        for path, spec in sjobs.list_incoming(self.spool):
+            job = ((spec or {}).get("job")
+                   or os.path.basename(path)[:-len(".json")])
+            if not isinstance(job, str) or not job:
+                job = os.path.basename(path)[:-len(".json")]
+            if job in self._seen:
+                # journal already knows this id (crash remnant of a
+                # consumed submission, or a double submit): exactly-
+                # once means the file is dropped, not re-admitted
+                self._unlink(path)
+                continue
+            err = ("bad_spec: unreadable/unparseable file"
+                   if spec is None else sjobs.check_spec(spec))
+            self._seen.add(job)
+            if err:
+                self.journal.record("rejected", job, reason=err)
+                self._rec.event("job_reject", job=job, reason=err)
+                sjobs.write_result(self.spool, job,
+                                   {"ok": False,
+                                    "verdict": f"rejected: {err}"})
+                self._unlink(path)
+                continue
+            verdict, out = self.admission.admit(spec, now=now)
+            if verdict == "admitted":
+                admitted += 1
+            else:
+                sjobs.write_result(self.spool, job,
+                                   {"ok": False,
+                                    "verdict": f"rejected: {out}"})
+            self._unlink(path)
+        return admitted
+
+    def _unlink(self, path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass     # already consumed by a racing sweep — harmless
+
+    # -- dispatch -------------------------------------------------------
+    def serve_block(self) -> int:
+        """Pack + dispatch ONE block off the queue; returns the number
+        of jobs it consumed (0 when idle)."""
+        block = pack_block(self.admission.queue, self.widths)
+        if not block:
+            return 0
+        blk = self.blocks
+        self.blocks += 1
+        self.journal.record("packed", None, block=blk,
+                            jobs=[e["job"] for e in block],
+                            ordinals=[e["ordinal"] for e in block],
+                            width=len(block))
+        self._dispatch_block(block, blk)
+        return len(block)
+
+    def _dispatch_block(self, block: List[Dict[str, Any]],
+                        blk: int) -> None:
+        """One packed block through ``Solver.solve_many`` (the PR 8
+        per-column recovery path — registered as a dispatch surface in
+        analysis/rules_ast.RECOVERY_SURFACES, so the harness-coverage
+        lint proves this stays the one way jobs reach the solver)."""
+        import numpy as np
+
+        from pcg_mpi_solver_tpu.resilience.faultinject import (
+            InjectedDispatchError)
+        from pcg_mpi_solver_tpu.solver.pcg import QUARANTINE_FLAG
+
+        t0 = time.monotonic()
+        # service-boundary faults: per-job, by absolute ordinal
+        plan, poison, live = self.fault_plan, set(), []
+        for e in block:
+            if plan is not None and plan.job_armed:
+                try:
+                    p = plan.at_job(e["ordinal"])
+                except InjectedDispatchError as exc:
+                    self._finish_failed(e, f"injected: {exc}", block=blk)
+                    continue
+                if p == "nan":
+                    poison.add(e["job"])
+            live.append(e)
+        # build the RHS block; a bad column fails ITS job only
+        cols, kept = [], []
+        for e in live:
+            try:
+                col = self._rhs_column(e["spec"])
+            except (OSError, ValueError) as exc:
+                self._finish_failed(
+                    e, f"rhs_load_failed: {type(exc).__name__}: {exc}",
+                    block=blk)
+                continue
+            if e["job"] in poison:
+                col = col * np.nan     # injected tenant poison
+            if not np.isfinite(col).all():
+                # service-boundary quarantine: solve_many's preflight
+                # rejects a non-finite column by failing the WHOLE
+                # block — one tenant's poison must not do that, so the
+                # daemon screens per column and quarantines it alone
+                self._rec.event("job_quarantine", job=e["job"],
+                                verdict="rhs_nonfinite")
+                self._finish_failed(e, "rhs_nonfinite", block=blk)
+                continue
+            cols.append(col)
+            kept.append(e)
+        if not kept:
+            return
+        fb = np.stack(cols, axis=-1)
+        self.journal.record("dispatched", None, block=blk,
+                            jobs=[e["job"] for e in kept],
+                            width=len(kept))
+        try:
+            res = self.solver.solve_many(fb)
+        except Exception as exc:                       # noqa: BLE001
+            # whole-block dispatch failure (compile error, device loss
+            # past the recovery ladder): every co-batched job fails
+            # with a NAMED verdict — never a silent drop
+            self._rec.note(f"serve block {blk} dispatch failed: "
+                           f"{type(exc).__name__}: {exc}")
+            for e in kept:
+                self._finish_failed(
+                    e, f"dispatch_failed: {type(exc).__name__}: {exc}",
+                    block=blk)
+            return
+        u = self.solver.displacement_global_many(res.x)
+        wall = time.monotonic() - t0
+        now = time.time()
+        for j, e in enumerate(kept):
+            flag = int(res.flags[j])
+            quarantined = (j in tuple(res.quarantined)
+                           or flag == QUARANTINE_FLAG)
+            ok = flag == 0
+            verdict = ("converged" if ok
+                       else "quarantined" if quarantined
+                       else f"flag{flag}")
+            result = {"ok": ok, "verdict": verdict, "flag": flag,
+                      "relres": float(res.relres[j]),
+                      "iters": int(res.iters[j]),
+                      "block": blk, "width": len(kept),
+                      "wall_s": round(wall, 6),
+                      "deadline_met": now <= float(e["deadline_t"])}
+            # solution first (even quarantined jobs get their min-
+            # residual iterate), then result json, then the terminal
+            # record: replay's crash-ordering contract
+            np.save(sjobs.solution_path(self.spool, e["job"]), u[:, j])
+            sjobs.write_result(self.spool, e["job"], result)
+            if quarantined:
+                self._rec.event("job_quarantine", job=e["job"],
+                                verdict=verdict, rhs=j)
+            self.journal.record("done" if ok else "failed", e["job"],
+                                verdict=verdict, block=blk)
+            self._rec.event("job_done", job=e["job"], ok=ok,
+                            verdict=verdict)
+            self._count_finish(ok)
+
+    def _rhs_column(self, spec: Dict[str, Any]):
+        """One (n_dof,) load column from a validated spec: ``scale`` x
+        the model's reference load, or an ``rhs`` .npy path."""
+        import numpy as np
+
+        n_dof = int(self.solver._model.n_dof)
+        if spec.get("rhs"):
+            col = np.asarray(np.load(spec["rhs"]), dtype=np.float64)
+            col = col.reshape(-1)
+            if col.shape[0] != n_dof:
+                raise ValueError(
+                    f"rhs length {col.shape[0]} != n_dof {n_dof}")
+            return col
+        return (np.asarray(self.solver._model.F, dtype=np.float64)
+                * float(spec["scale"]))
+
+    # -- finishing ------------------------------------------------------
+    def _count_finish(self, ok: bool) -> None:
+        if ok:
+            self.jobs_done += 1
+        else:
+            self.jobs_failed += 1
+
+    def _finish_failed(self, entry: Dict[str, Any], verdict: str,
+                       block: Optional[int] = None) -> None:
+        """Terminal failure with a named verdict: result file first,
+        then journal record + ``job_done`` event (ok=false)."""
+        job = entry["job"]
+        sjobs.write_result(self.spool, job,
+                           {"ok": False, "verdict": verdict})
+        fields = {"verdict": verdict}
+        if block is not None:
+            fields["block"] = block
+        self.journal.record("failed", job, **fields)
+        self._rec.event("job_done", job=job, ok=False, verdict=verdict)
+        self._count_finish(False)
+
+    def _finish_shed(self, entry: Dict[str, Any], reason: str) -> None:
+        """Admission's shed hook: the journal record + ``job_shed``
+        event already happened inside the controller — the daemon adds
+        the result file (shed is terminal; the submitter must see it)."""
+        sjobs.write_result(self.spool, entry["job"],
+                           {"ok": False, "verdict": f"shed: {reason}"})
+
+    # -- the loop -------------------------------------------------------
+    def request_drain(self, *_args) -> None:
+        """SIGTERM handler (also callable directly): reject new
+        admissions from now on, finish what is queued, then exit."""
+        self._drain_requested = True
+        self.admission.draining = True
+
+    def run(self, max_blocks: Optional[int] = None,
+            idle_exit_s: Optional[float] = None,
+            install_signals: bool = True) -> str:
+        """Serve until drained; returns the drain reason.
+
+        ``max_blocks`` bounds the dispatch count (bench/test knob);
+        ``idle_exit_s`` drains after that long with an empty queue and
+        empty incoming dir (smoke/chaos knob — None serves forever);
+        ``install_signals`` wires SIGTERM to the graceful drain (off
+        when the daemon runs inside a test's main thread is not
+        available)."""
+        if install_signals:
+            try:
+                signal.signal(signal.SIGTERM, self.request_drain)
+            except ValueError:
+                self._rec.note("serve: not main thread, SIGTERM "
+                               "handler not installed")
+        last_work = time.monotonic()
+        reason = "drained"
+        while True:
+            admitted = self.poll_once()
+            served = self.serve_block() if self.admission.queue else 0
+            if admitted or served:
+                last_work = time.monotonic()
+            if max_blocks is not None and self.blocks >= max_blocks:
+                reason = "max_blocks"
+                break
+            if served:
+                continue
+            if self._drain_requested:
+                reason = "sigterm"
+                break
+            if (idle_exit_s is not None
+                    and time.monotonic() - last_work >= idle_exit_s):
+                reason = "idle"
+                break
+            time.sleep(self.poll_s)
+        # drain: reject any straggler submissions by name, then stamp
+        # the drain record inside the still-open serve bracket
+        self.admission.draining = True
+        self.poll_once()
+        if self.admission.queue:
+            self._rec.note(
+                f"serve drain: {len(self.admission.queue)} admitted "
+                f"job(s) left queued (journal replays them on restart)")
+        self.journal.drain(reason, jobs_done=self.jobs_done,
+                           jobs_failed=self.jobs_failed,
+                           jobs_shed=self.admission.shed_count,
+                           blocks=self.blocks)
+        self._rec.event("serve_drain", reason=reason,
+                        jobs_done=self.jobs_done,
+                        jobs_failed=self.jobs_failed,
+                        jobs_shed=self.admission.shed_count)
+        self.journal.close()
+        return reason

@@ -1,0 +1,168 @@
+"""Job spool IO: the filesystem submission protocol of the solve
+service (ISSUE 19).
+
+A spool directory holds three things::
+
+    spool/incoming/<job>.json    submitted specs (atomic tmp+rename)
+    spool/results/<job>.json     outcomes, always with a named verdict
+    spool/results/<job>.npy      the solution column (done jobs only)
+    spool/journal.jsonl          the crash-durable job journal
+
+Submission is ``write tmp -> os.replace``: the daemon's scan never sees
+a half-written spec.  Results are written the same way, and ALWAYS
+BEFORE the journal's terminal record — so a crash between the two is
+replayed as "complete from the existing result", never as a re-solve
+(the exactly-once ordering serve/journal.py documents).
+
+A job spec is a plain dict::
+
+    {"job": "a1b2c3", "scale": 0.5, "deadline_s": 60.0}
+    {"job": "a1b2c3", "rhs": "/path/loads.npy", "deadline_s": 60.0}
+
+``scale`` scales the model's reference load vector F (the solve-many
+``--scales`` semantics); ``rhs`` names an (n_dof,) .npy column instead.
+``deadline_s`` is RELATIVE at submission; admission converts it to the
+absolute wall deadline it prices against.
+
+Import-light by contract (no jax/numpy): submission must work from a
+login node without the accelerator environment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+INCOMING_DIR = "incoming"
+RESULTS_DIR = "results"
+JOURNAL_FILE = "journal.jsonl"
+
+#: A spec may only carry these keys (forward compatibility lives in the
+#: journal schema, not in free-form specs a typo'd submission could
+#: smuggle past admission).
+SPEC_KEYS = ("job", "scale", "rhs", "deadline_s", "submit_t")
+
+DEFAULT_DEADLINE_S = 3600.0
+
+
+def journal_path(spool: str) -> str:
+    return os.path.join(spool, JOURNAL_FILE)
+
+
+def incoming_dir(spool: str) -> str:
+    return os.path.join(spool, INCOMING_DIR)
+
+
+def results_dir(spool: str) -> str:
+    return os.path.join(spool, RESULTS_DIR)
+
+
+def ensure_spool(spool: str) -> None:
+    os.makedirs(incoming_dir(spool), exist_ok=True)
+    os.makedirs(results_dir(spool), exist_ok=True)
+
+
+def new_job_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+def write_json_atomic(path: str, obj: Any) -> None:
+    """tmp + ``os.replace``: readers never observe a torn file (the
+    same-directory rename is atomic on POSIX)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def check_spec(spec: Dict[str, Any]) -> Optional[str]:
+    """Spec validation: the named ``bad_spec`` reason, or None when
+    admissible.  Mirrors the preflight posture — reject with a reason
+    the submitter can act on, never crash the daemon."""
+    if not isinstance(spec, dict):
+        return f"bad_spec: not an object ({type(spec).__name__})"
+    unknown = sorted(set(spec) - set(SPEC_KEYS))
+    if unknown:
+        return f"bad_spec: unknown key(s) {', '.join(unknown)}"
+    has_scale = isinstance(spec.get("scale"), (int, float))
+    has_rhs = isinstance(spec.get("rhs"), str) and spec["rhs"]
+    if has_scale == bool(has_rhs):
+        return "bad_spec: exactly one of scale / rhs required"
+    dl = spec.get("deadline_s", DEFAULT_DEADLINE_S)
+    if not isinstance(dl, (int, float)) or dl <= 0:
+        return f"bad_spec: deadline_s must be > 0 (got {dl!r})"
+    return None
+
+
+def submit(spool: str, spec: Dict[str, Any],
+           submit_t: Optional[float] = None) -> str:
+    """Atomically drop one job spec into ``spool/incoming``; returns the
+    job id (generated when the spec carries none).  Raises ValueError on
+    a spec admission would reject as ``bad_spec`` — the submitter finds
+    out at submit time, not from a result file."""
+    spec = dict(spec)
+    spec.setdefault("job", new_job_id())
+    spec.setdefault("deadline_s", DEFAULT_DEADLINE_S)
+    spec["submit_t"] = float(time.time() if submit_t is None
+                             else submit_t)
+    err = check_spec(spec)
+    if err:
+        raise ValueError(f"submit: {err}")
+    ensure_spool(spool)
+    write_json_atomic(os.path.join(incoming_dir(spool),
+                                   f"{spec['job']}.json"), spec)
+    return spec["job"]
+
+
+def list_incoming(spool: str) -> List[Tuple[str, Dict[str, Any]]]:
+    """``(path, spec)`` for every readable incoming spec, oldest
+    submission first (ties broken by job id, so admission order — and
+    with it the ``@job:`` fault ordinals — is deterministic).  An
+    unreadable/unparseable file is returned with ``spec=None`` so the
+    daemon can reject it by name instead of skipping it silently."""
+    d = incoming_dir(spool)
+    try:
+        names = sorted(n for n in os.listdir(d) if n.endswith(".json"))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        path = os.path.join(d, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                spec = json.load(f)
+        except (OSError, ValueError):
+            spec = None
+        out.append((path, spec))
+    out.sort(key=lambda ps: ((ps[1] or {}).get("submit_t", 0.0),
+                             (ps[1] or {}).get("job", ps[0])))
+    return out
+
+
+def result_path(spool: str, job_id: str) -> str:
+    return os.path.join(results_dir(spool), f"{job_id}.json")
+
+
+def solution_path(spool: str, job_id: str) -> str:
+    return os.path.join(results_dir(spool), f"{job_id}.npy")
+
+
+def write_result(spool: str, job_id: str, result: Dict[str, Any]) -> None:
+    """Atomic result drop.  MUST be called before the journal's terminal
+    record for the job — replay completes a dispatched-but-unjournaled
+    job from this file instead of re-solving it."""
+    ensure_spool(spool)
+    write_json_atomic(result_path(spool, job_id), dict(result, job=job_id))
+
+
+def read_result(spool: str, job_id: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(result_path(spool, job_id), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

@@ -1,0 +1,137 @@
+"""Crash-durable job journal: the exactly-once backbone of the solve
+service (ISSUE 19).
+
+Rides the PR 12 flight-recorder idiom (obs/flight.py) rather than
+reinventing it: every journal record is an fsync'd ``kind="flight"``
+telemetry event, so a SIGKILL loses AT MOST the record being written,
+every JSONL consumer (``pcg-tpu summary`` / ``watch``) can ingest the
+journal, and the daemon's liveness heartbeats come for free from the
+recorder's open ``serve`` bracket.  Job records add ``op`` (the
+lifecycle bracket) + ``job`` (the id) + ``journal`` (this module's own
+schema tag, versioned independently of the telemetry schema).
+
+Lifecycle ops (:data:`JOB_OPS`)::
+
+    admitted --> packed --> dispatched --> done
+        \\                               \\-> failed
+         \\-> shed          (queue backpressure, named reason)
+    rejected                (never admitted, named reason)
+
+The ``admitted`` record carries the FULL job spec and the absolute
+admission ordinal, so replay needs nothing but the journal: a job whose
+newest op is non-terminal is re-enqueued with its original ordinal and
+deadline; a job whose result file exists but whose ``done`` record was
+lost to the kill is completed from the result (``replayed=true``),
+never re-solved — no loss, no double-completion (the exactly-once
+contract ``tests/test_serve.py`` SIGKILLs its way through).
+
+Import-light by contract (no jax/numpy): replay and the unit tests run
+without an accelerator environment.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from pcg_mpi_solver_tpu.obs.flight import FlightRecorder, read_jsonl_tolerant
+
+#: Versioned journal schema tag carried by every job record (bump the
+#: suffix on a BREAKING change; additive fields do not bump).
+SERVE_JOURNAL_SCHEMA = "pcg-tpu-serve-journal/1"
+
+#: Job lifecycle ops, in bracket order.
+JOB_OPS = ("admitted", "packed", "dispatched", "done", "failed",
+           "rejected", "shed")
+
+#: Ops after which a job must never run (or run again).
+TERMINAL_OPS = ("done", "failed", "rejected", "shed")
+
+#: Daemon lifecycle op: graceful drain record (SIGTERM / idle exit).
+DRAIN_OP = "drain"
+
+
+class JobJournal:
+    """fsync-per-record append-only job journal over one
+    :class:`~pcg_mpi_solver_tpu.obs.flight.FlightRecorder`.
+
+    Opening the journal opens a ``serve`` flight bracket, so heartbeats
+    flow while the daemon lives — ``pcg-tpu watch`` gets its stall
+    detector over daemon death for free.  A SIGKILL leaves the bracket
+    unclosed (the ``died`` flight verdict); :meth:`close` on a graceful
+    drain closes it and stamps the :data:`DRAIN_OP` record first.
+    """
+
+    def __init__(self, path: str, fsync: Optional[bool] = None):
+        self.path = path
+        self._fl = FlightRecorder(
+            path, meta={"component": "serve",
+                        "journal": SERVE_JOURNAL_SCHEMA},
+            fsync=fsync)
+        self._seq = self._fl.begin("serve")
+
+    def record(self, op: str, job: Optional[str] = None,
+               **fields) -> Dict[str, Any]:
+        """Write ONE durable journal record (flush + fsync before the
+        call returns — the crash-ordering contract replay depends on)."""
+        if job is not None:
+            fields["job"] = job
+        return self._fl.emit(op, journal=SERVE_JOURNAL_SCHEMA, **fields)
+
+    def drain(self, reason: str, **fields) -> None:
+        """Stamp the graceful-drain record (still inside the ``serve``
+        bracket, so it is fsync'd before the bracket closes)."""
+        self.record(DRAIN_OP, reason=reason, **fields)
+
+    def close(self) -> None:
+        self._fl.end(self._seq, "serve")
+        self._fl.close()
+
+
+def read_journal(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    """Tolerant read of a journal file: ``(events, truncated_count)``.
+    The exact artifact a SIGKILLed daemon leaves may end in a line cut
+    mid-object — skipped and counted, never raised on (the
+    obs/flight.py reader contract)."""
+    return read_jsonl_tolerant(path)
+
+
+def replay_jobs(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Fold journal events into per-job final states.
+
+    Returns ``{job_id: state}`` where ``state`` carries ``op`` (the
+    newest lifecycle op), ``ops`` (the full op history, replay-audit
+    order), ``spec`` / ``ordinal`` / ``deadline_t`` (from the
+    ``admitted`` record), ``terminal`` and ``verdict``.  Tolerates
+    anything: non-job records, unknown ops and jobs admitted by a
+    previous daemon generation all fold in order."""
+    jobs: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        op = ev.get("op")
+        job = ev.get("job")
+        if op not in JOB_OPS or not isinstance(job, str):
+            continue
+        st = jobs.setdefault(job, {"job": job, "ops": [], "op": None,
+                                   "spec": None, "ordinal": None,
+                                   "deadline_t": None, "terminal": False,
+                                   "verdict": None})
+        st["ops"].append(op)
+        st["op"] = op
+        if op == "admitted":
+            st["spec"] = ev.get("spec")
+            if isinstance(ev.get("ordinal"), int):
+                st["ordinal"] = ev["ordinal"]
+            if isinstance(ev.get("deadline_t"), (int, float)):
+                st["deadline_t"] = float(ev["deadline_t"])
+        if op in TERMINAL_OPS:
+            st["terminal"] = True
+            st["verdict"] = ev.get("verdict", ev.get("reason"))
+    return jobs
+
+
+def next_ordinal(jobs: Dict[str, Dict[str, Any]]) -> int:
+    """The next absolute admission ordinal: ordinals NEVER reset across
+    daemon restarts (the ``@job:`` fault domain and replay both index
+    by them), so a fresh daemon continues the journal's numbering."""
+    taken = [st["ordinal"] for st in jobs.values()
+             if isinstance(st.get("ordinal"), int)]
+    return max(taken) + 1 if taken else 0

@@ -1,0 +1,53 @@
+"""nrhs block packer: co-batch compatible jobs into standard widths.
+
+The AOT program cache (cache/, PR 6) is keyed per block width, so an
+arbitrary width would compile a fresh program per queue depth — the
+service instead packs from a SMALL set of standard widths and pays at
+most ``len(widths)`` compiles over the daemon's lifetime, all warm
+after the first block of each width.
+
+Packing is FIFO by admission ordinal (a deadline scheduler would
+re-order; the admission pricing already guaranteed each admitted job's
+deadline is feasible, so fairness-by-arrival is the simplest policy
+that cannot starve).  Import-light by contract (no jax/numpy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+#: Default standard block widths.  1 MUST be a member (a lone pending
+#: job must always be packable); powers of two match the AOT cache's
+#: per-nrhs keying and bound the compile count.
+STANDARD_WIDTHS = (1, 2, 4, 8)
+
+
+def normalize_widths(widths: Sequence[int]) -> tuple:
+    """Sorted, deduplicated, 1-inclusive widths (1 is forced in: a
+    width set without it would strand a single pending job forever)."""
+    ws = sorted({int(w) for w in widths if int(w) >= 1} | {1})
+    return tuple(ws)
+
+
+def pick_width(n_pending: int, widths: Sequence[int] = STANDARD_WIDTHS
+               ) -> int:
+    """Largest standard width <= the pending count (0 when idle)."""
+    if n_pending <= 0:
+        return 0
+    fit = [w for w in normalize_widths(widths) if w <= n_pending]
+    return max(fit)
+
+
+def pack_block(queue: List[Dict[str, Any]],
+               widths: Sequence[int] = STANDARD_WIDTHS
+               ) -> List[Dict[str, Any]]:
+    """Pop the next block off the admission queue: the ``pick_width``
+    oldest entries, by admission ordinal.  Mutates ``queue`` (the
+    popped entries are the daemon's to journal as ``packed``)."""
+    w = pick_width(len(queue), widths)
+    if w == 0:
+        return []
+    queue.sort(key=lambda e: e["ordinal"])
+    block = queue[:w]
+    del queue[:w]
+    return block

@@ -14,7 +14,7 @@ import dataclasses
 import os
 import time
 from functools import partial
-from typing import Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -650,7 +650,9 @@ class Solver:
         from pcg_mpi_solver_tpu.obs import perf as _perf
 
         self._perf_shape = None
+        self._perf_profile = None
         self._cost_model = None
+        self._cost_models_by_width: Dict[int, Any] = {}
         try:
             shape = _perf.shape_from_solver(self)
             profile = _perf.resolve_profile(
@@ -670,7 +672,10 @@ class Solver:
                                f"{type(e).__name__}: {e}")
             else:
                 self._perf_shape = shape
+                self._perf_profile = profile
                 self._cost_model = cm
+                self._cost_models_by_width[
+                    max(1, int(solver_cfg.nrhs))] = cm
                 try:
                     _perf.emit_cost_model(self._rec, cm)
                 except Exception as e:                  # noqa: BLE001
@@ -1596,6 +1601,28 @@ class Solver:
     # ------------------------------------------------------------------
     # Batched multi-RHS solves (ISSUE 6): many load cases, ONE operator
     # ------------------------------------------------------------------
+    def predicted_ms_per_iter(self, nrhs: int = 1) -> Optional[float]:
+        """Cost-model-predicted ms/iter at block width ``nrhs`` — the
+        serve/ admission-pricing hook (ISSUE 19).  None when the model
+        degraded at construction (exotic platform / shape derivation
+        failure): callers must treat None as "cannot price", never as
+        zero.  Models are cached per width (one table walk each, all
+        pure host arithmetic); an unknown variant/precond stays a loud
+        KeyError (the single-source-table contract)."""
+        if self._perf_shape is None:
+            return None
+        nrhs = max(1, int(nrhs))
+        cm = self._cost_models_by_width.get(nrhs)
+        if cm is None:
+            from pcg_mpi_solver_tpu.obs import perf as _perf
+
+            scfg = self.config.solver
+            cm = _perf.cost_model(
+                self._perf_shape, scfg.pcg_variant, scfg.precond,
+                nrhs, self._perf_profile)
+            self._cost_models_by_width[nrhs] = cm
+        return float(cm["predicted_ms_per_iter"])
+
     def solve_many(self, fexts, resume: bool = False) -> ManySolveResult:
         """Solve ``K.x_j = fext_j`` for a BLOCK of load cases against the
         one shared partitioned operator — the multi-tenant solve path.

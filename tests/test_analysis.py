@@ -493,7 +493,8 @@ def test_rule_catalog_complete():
                 "donation-integrity", "fingerprint-completeness",
                 "recovery-paths", "recovery-coverage", "telemetry-schema",
                 "cost-model-completeness", "partition-key-components",
-                "scope-labels", "doc-schema-sync"}
+                "scope-labels", "doc-schema-sync",
+                "serve-admission-events"}
     assert expected <= set(rules)
     assert len(expected) >= 5
     # the pre-hardware-window gate covers the structural claims
@@ -504,6 +505,7 @@ def test_rule_catalog_complete():
     assert rules["partition-key-components"].fast
     assert rules["scope-labels"].fast
     assert rules["doc-schema-sync"].fast
+    assert rules["serve-admission-events"].fast
     assert not rules["fingerprint-completeness"].fast
 
 
@@ -696,6 +698,64 @@ def test_consensus_coverage_seeded_violations():
     # (4) stale registry entry: the registered function vanished
     errs4 = check_consensus_coverage({rel: "x = 1\n"})
     assert any("no such function" in e for e in errs4), errs4
+
+
+# ----------------------------------------------------------------------
+# serve-admission-events (ISSUE 19): every admission-decision outcome
+# emits its schema-versioned telemetry event
+# ----------------------------------------------------------------------
+
+def test_serve_admission_events_clean_on_real_tree():
+    from pcg_mpi_solver_tpu.analysis.rules_ast import (
+        serve_admission_events_rule)
+
+    assert serve_admission_events_rule(None) == []
+
+
+def test_serve_admission_events_seeded_violations():
+    """Every failure class fires on seeded sources: a decision site
+    that dropped its event emission (the silent-outcome regression the
+    rule exists for), a stale registry entry, and a registry kind the
+    telemetry schema no longer knows."""
+    from pcg_mpi_solver_tpu.analysis.rules_ast import (
+        ADMISSION_EVENT_SITES, check_admission_events)
+
+    rel = "pcg_mpi_solver_tpu/serve/admission.py"
+    src = (
+        "class AdmissionController:\n"
+        "    def admit(self, spec, now=None):\n"
+        "        self._rec.event('job_admit', job=spec['job'])\n"
+        "    def _reject(self, job, reason, **fields):\n"
+        "        self._rec.event('job_reject', job=job, reason=reason)\n"
+        "    def shed_past_deadline(self, now=None):\n"
+        "        self._rec.event('job_shed', job='x', reason='r')\n")
+    assert check_admission_events({rel: src}) == []
+
+    # (1) a decision site stops emitting: the outcome goes silent
+    src1 = src.replace(
+        "        self._rec.event('job_shed', job='x', reason='r')\n",
+        "        pass\n")
+    errs1 = check_admission_events({rel: src1})
+    assert any("shed_past_deadline" in e and "`job_shed`" in e
+               for e in errs1), errs1
+
+    # (2) stale registry entry: the registered function vanished
+    src2 = src.replace("def admit", "def admit_renamed")
+    errs2 = check_admission_events({rel: src2})
+    assert any("`admit`" in e and "no such function" in e
+               for e in errs2), errs2
+
+    # (3) registry kinds must exist in obs/schema EVENT_KINDS — the
+    # real registry is checked live against the real schema
+    from pcg_mpi_solver_tpu.obs.schema import EVENT_KINDS
+    for kinds in ADMISSION_EVENT_SITES.values():
+        for kind in kinds:
+            assert kind in EVENT_KINDS, kind
+
+    # an emit of the WRONG kind does not satisfy the requirement
+    src3 = src.replace("'job_admit'", "'job_done'")
+    errs3 = check_admission_events({rel: src3})
+    assert any("`admit`" in e and "`job_admit`" in e for e in errs3), errs3
 
 
 # ----------------------------------------------------------------------

@@ -152,6 +152,31 @@ def test_priority_queue_setup_ladder_after_lint_before_variants(
         dict(steps)["flagship classic"]["BENCH_CACHE_DIR"]
 
 
+def test_priority_queue_serve_smoke_on_cpu_before_variants(
+        tmp_path, monkeypatch):
+    """ISSUE 19: the serve smoke (3 jobs through a live daemon, one
+    ``exc@job:`` fault, 2 done + 1 failed with a named verdict) runs on
+    CPU after the distributed-chaos smoke, before any hardware grant is
+    spent on the flagship legs."""
+    from tools import hw_session
+
+    steps = []
+
+    def fake_run_step(path, name, argv, env_extra=None, **kw):
+        steps.append((name, dict(env_extra or {})))
+        return "rc=0"
+
+    monkeypatch.setattr(hw_session, "run_step", fake_run_step)
+    hw_session.run_priority_queue(str(tmp_path / "log.txt"), quick=True)
+
+    names = [n for n, _ in steps]
+    i_chaos = names.index("distributed-chaos smoke")
+    i_serve = names.index("serve smoke")
+    i_c = names.index("flagship classic")
+    assert i_chaos < i_serve < i_c, names
+    assert dict(steps)["serve smoke"]["JAX_PLATFORMS"] == "cpu"
+
+
 def test_priority_queue_aborts_on_lint_failure(tmp_path, monkeypatch):
     """A FAILED step-0 lint must abort before any hardware step — the
     pipelined leg's overlap claim is exactly what the lint proves, so

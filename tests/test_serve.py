@@ -1,0 +1,740 @@
+"""Multi-tenant solve service (serve/, ISSUE 19): admission control,
+backpressure, per-job fault isolation, and crash-durable exactly-once
+execution.
+
+The headline contracts:
+
+* every admission-decision outcome — accept, reject, shed — produces a
+  NAMED reason: a schema-versioned telemetry event, a journal record
+  and a result file the submitter can read (never a silent drop);
+* a poisoned tenant's RHS quarantines ALONE while its co-batched
+  tenants finish with solutions bit-identical to the unpacked
+  single-RHS reference (the PR 8 isolation promise at service scope);
+* SIGKILLing the daemon mid-block and restarting over the same spool
+  loses no job and solves none twice (results are written BEFORE the
+  terminal journal record; replay completes from whichever survived);
+* the ``@job:`` fault domain fires by absolute admission ordinal and a
+  consumed fault never re-fires across a restart.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pcg_mpi_solver_tpu.config import (RunConfig, SolverConfig,
+                                       TimeHistoryConfig)
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+from pcg_mpi_solver_tpu.obs.metrics import MetricsRecorder
+from pcg_mpi_solver_tpu.obs.schema import validate_bench_line, validate_event
+from pcg_mpi_solver_tpu.parallel.mesh import make_mesh
+from pcg_mpi_solver_tpu.resilience import FaultPlan
+from pcg_mpi_solver_tpu.resilience.faultinject import InjectedDispatchError
+from pcg_mpi_solver_tpu.serve import jobs as sjobs
+from pcg_mpi_solver_tpu.serve.admission import (
+    REJECT_DEADLINE, REJECT_DRAINING, REJECT_QUEUE_FULL,
+    SHED_PAST_DEADLINE, AdmissionController, price_admission)
+from pcg_mpi_solver_tpu.serve.daemon import ServeDaemon
+from pcg_mpi_solver_tpu.serve.journal import (
+    JobJournal, TERMINAL_OPS, next_ordinal, read_journal, replay_jobs)
+from pcg_mpi_solver_tpu.serve.packer import (
+    normalize_widths, pack_block, pick_width)
+from pcg_mpi_solver_tpu.solver.driver import Solver
+
+
+class _Cap:
+    """Metrics sink collecting events for assertions."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, ev):
+        self.events.append(ev)
+
+    def close(self):
+        pass
+
+    def kinds(self, kind):
+        return [e for e in self.events if e.get("kind") == kind]
+
+
+class _StubJournal:
+    """Records journal (op, job, fields) tuples without touching disk."""
+
+    def __init__(self):
+        self.records = []
+
+    def record(self, op, job=None, **fields):
+        self.records.append((op, job, fields))
+
+
+def _cfg():
+    return RunConfig(
+        solver=SolverConfig(tol=1e-8, max_iter=2000,
+                            precision_mode="direct",
+                            iters_per_dispatch=-1, pcg_variant="classic"),
+        time_history=TimeHistoryConfig(time_step_delta=[0.0, 1.0]),
+    )
+
+
+@pytest.fixture(scope="module")
+def solver():
+    """One warm solver shared by the service tests — model parameters
+    match ``pcg-tpu serve --synthetic 4,3,3`` so the chaos test's
+    restarted generation serves the same operator the killed CLI
+    daemon did."""
+    model = make_cube_model(4, 3, 3, E=30e9, nu=0.2, load="traction",
+                            load_value=1e6, heterogeneous=True)
+    return Solver(model, _cfg(), mesh=make_mesh(2), n_parts=2,
+                  backend="general")
+
+
+@pytest.fixture
+def cap(solver):
+    c = _Cap()
+    solver.recorder.add_sink(c)
+    yield c
+    solver.recorder.remove_sink(c)
+
+
+def _terminal_counts(journal_file):
+    """{job: number of terminal journal records} over the whole journal
+    — the exactly-once audit (every value must be exactly 1)."""
+    events, _ = read_journal(journal_file)
+    counts = {}
+    for ev in events:
+        if ev.get("op") in TERMINAL_OPS and isinstance(ev.get("job"), str):
+            counts[ev["job"]] = counts.get(ev["job"], 0) + 1
+    return counts
+
+
+# ----------------------------------------------------------------------
+# import-light contract: submission works from a login node
+# ----------------------------------------------------------------------
+
+def test_serve_protocol_modules_import_jax_free():
+    """jobs/journal/packer/admission are the submission-side protocol —
+    ``pcg-tpu submit``/``jobs`` must work from a login node without the
+    accelerator environment, so their import graph stays jax-free."""
+    code = ("import sys; "
+            "import pcg_mpi_solver_tpu.serve.jobs; "
+            "import pcg_mpi_solver_tpu.serve.journal; "
+            "import pcg_mpi_solver_tpu.serve.packer; "
+            "import pcg_mpi_solver_tpu.serve.admission; "
+            "sys.exit(1 if 'jax' in sys.modules else 0)")
+    # strip the conftest's JAX_PLATFORMS=cpu: the package __init__
+    # deliberately imports jax to pin the backend when that env is set
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    r = subprocess.run([sys.executable, "-c", code],
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))),
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+
+
+# ----------------------------------------------------------------------
+# packer: standard widths, FIFO-by-ordinal packing
+# ----------------------------------------------------------------------
+
+def test_packer_widths_and_fifo_packing():
+    assert normalize_widths((8, 2, 2)) == (1, 2, 8)
+    assert normalize_widths(()) == (1,)          # 1 is always forced in
+    assert normalize_widths((0, -3, 4)) == (1, 4)
+    assert pick_width(0) == 0
+    assert pick_width(1) == 1
+    assert pick_width(3, (1, 2, 4)) == 2          # largest fit, not 4
+    assert pick_width(100, (1, 2, 4, 8)) == 8
+    queue = [{"job": f"j{o}", "ordinal": o} for o in (2, 0, 1)]
+    block = pack_block(queue, (1, 2))
+    assert [e["ordinal"] for e in block] == [0, 1]  # oldest first
+    assert [e["ordinal"] for e in queue] == [2]     # popped off the queue
+    assert pack_block([], (1, 2)) == []
+
+
+# ----------------------------------------------------------------------
+# jobs: spec validation + spool protocol
+# ----------------------------------------------------------------------
+
+def test_check_spec_names_every_rejection():
+    assert sjobs.check_spec({"job": "a", "scale": 1.0,
+                             "deadline_s": 60.0}) is None
+    assert sjobs.check_spec({"job": "a", "rhs": "/x.npy"}) is None
+    assert "not an object" in sjobs.check_spec([1, 2])
+    assert "unknown key" in sjobs.check_spec({"job": "a", "scale": 1.0,
+                                              "priority": 9})
+    # exactly one of scale / rhs
+    assert "exactly one" in sjobs.check_spec({"job": "a"})
+    assert "exactly one" in sjobs.check_spec(
+        {"job": "a", "scale": 1.0, "rhs": "/x.npy"})
+    assert "deadline_s" in sjobs.check_spec(
+        {"job": "a", "scale": 1.0, "deadline_s": -5})
+
+
+def test_submit_and_list_incoming_deterministic_order(tmp_path):
+    spool = str(tmp_path / "spool")
+    # deliberately out-of-order submit times: the scan must sort by them
+    jb = sjobs.submit(spool, {"job": "b", "scale": 2.0}, submit_t=1.0)
+    ja = sjobs.submit(spool, {"job": "a", "scale": 1.0}, submit_t=0.0)
+    jc = sjobs.submit(spool, {"scale": 3.0}, submit_t=2.0)  # id generated
+    assert (ja, jb) == ("a", "b") and len(jc) == 12
+    order = [spec["job"] for _, spec in sjobs.list_incoming(spool)]
+    assert order == ["a", "b", jc]
+    # a bad spec fails AT SUBMIT, not via a result file later
+    with pytest.raises(ValueError, match="exactly one"):
+        sjobs.submit(spool, {"job": "x"})
+    # an unparseable incoming file is surfaced with spec=None, not skipped
+    with open(os.path.join(sjobs.incoming_dir(spool), "torn.json"),
+              "w") as f:
+        f.write('{"job": "to')
+    pairs = sjobs.list_incoming(spool)
+    assert any(spec is None for _, spec in pairs)
+
+
+def test_result_roundtrip(tmp_path):
+    spool = str(tmp_path / "spool")
+    assert sjobs.read_result(spool, "nope") is None
+    sjobs.write_result(spool, "j1", {"ok": True, "verdict": "converged"})
+    res = sjobs.read_result(spool, "j1")
+    assert res["ok"] is True and res["job"] == "j1"
+
+
+# ----------------------------------------------------------------------
+# journal: durable records, replay folding, torn tails
+# ----------------------------------------------------------------------
+
+def test_journal_roundtrip_and_replay(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = JobJournal(path)
+    j.record("admitted", "a", spec={"job": "a", "scale": 1.0},
+             ordinal=0, deadline_t=100.0)
+    j.record("admitted", "b", spec={"job": "b", "scale": 2.0},
+             ordinal=1, deadline_t=200.0)
+    j.record("packed", None, block=0, jobs=["a", "b"], width=2)
+    j.record("dispatched", None, block=0, jobs=["a", "b"], width=2)
+    j.record("done", "a", verdict="converged", block=0)
+    j.record("rejected", "c", reason="queue_full")
+    j.drain("test")
+    j.close()
+
+    events, truncated = read_journal(path)
+    assert truncated == 0
+    states = replay_jobs(events)
+    assert states["a"]["terminal"] and states["a"]["verdict"] == "converged"
+    assert states["a"]["ordinal"] == 0
+    # b was packed+dispatched but never finished: non-terminal, spec kept
+    assert not states["b"]["terminal"]
+    assert states["b"]["spec"] == {"job": "b", "scale": 2.0}
+    assert states["b"]["deadline_t"] == 200.0
+    # c never got an ordinal (rejected at the door) but IS terminal
+    assert states["c"]["terminal"] and states["c"]["ordinal"] is None
+    # ordinals never reset across restarts
+    assert next_ordinal(states) == 2
+    assert next_ordinal({}) == 0
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    """The exact artifact a SIGKILL leaves: a line cut mid-object is
+    skipped and counted, and replay still folds the intact prefix."""
+    path = str(tmp_path / "journal.jsonl")
+    j = JobJournal(path)
+    j.record("admitted", "a", spec={"job": "a", "scale": 1.0},
+             ordinal=0, deadline_t=9.0)
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"kind": "flight", "op": "do')   # the kill, mid-write
+    events, truncated = read_journal(path)
+    assert truncated == 1
+    assert replay_jobs(events)["a"]["ordinal"] == 0
+
+
+# ----------------------------------------------------------------------
+# admission: pricing, bounded queue, shedding — all with named reasons
+# ----------------------------------------------------------------------
+
+def test_price_admission():
+    assert price_admission(None, 1000) is None    # degraded model: open
+    assert price_admission(2.0, 500) == pytest.approx(1.0)
+
+
+def _controller(cap, *, queue_max=2, ms=2.0, expected_iters=500,
+                on_shed=None):
+    rec = MetricsRecorder(sinks=[cap])
+    jn = _StubJournal()
+    ctl = AdmissionController(queue_max, pricer=lambda nrhs: ms,
+                             journal=jn, recorder=rec,
+                             expected_iters=expected_iters,
+                             price_width=4, on_shed=on_shed)
+    return ctl, jn
+
+
+def test_admission_prices_and_rejects_infeasible_deadlines(cap):
+    ctl, jn = _controller(cap)                    # predicted_s = 1.0
+    verdict, reason = ctl.admit({"job": "slow", "scale": 1.0,
+                                 "deadline_s": 0.5}, now=100.0)
+    assert (verdict, reason) == ("rejected", REJECT_DEADLINE)
+    verdict, entry = ctl.admit({"job": "ok", "scale": 1.0,
+                                "deadline_s": 10.0}, now=100.0)
+    assert verdict == "admitted" and entry["ordinal"] == 0
+    assert entry["deadline_t"] == pytest.approx(110.0)
+    # every decision journaled + evented, with the named reason
+    assert [r[0] for r in jn.records] == ["rejected", "admitted"]
+    (rej,) = cap.kinds("job_reject")
+    assert rej["reason"] == REJECT_DEADLINE
+    (adm,) = cap.kinds("job_admit")
+    assert adm["ordinal"] == 0 and adm["predicted_s"] == pytest.approx(1.0)
+    assert validate_event(rej) == [] and validate_event(adm) == []
+
+
+def test_admission_degrades_open_without_a_cost_model(cap):
+    ctl, _ = _controller(cap, ms=None)
+    verdict, entry = ctl.admit({"job": "a", "scale": 1.0,
+                                "deadline_s": 1e-9}, now=0.0)
+    assert verdict == "admitted"                   # pricing never gates
+    assert cap.kinds("job_admit")[0]["predicted_s"] is None
+
+
+def test_admission_backpressure_sheds_then_rejects_full(cap):
+    shed_hook = []
+    ctl, jn = _controller(cap, queue_max=2,
+                          on_shed=lambda e, r: shed_hook.append((e, r)))
+    for i in range(2):
+        v, _ = ctl.admit({"job": f"j{i}", "scale": 1.0,
+                          "deadline_s": 5.0}, now=0.0)
+        assert v == "admitted"
+    # queue full, nothing past deadline yet -> the arrival is rejected
+    v, reason = ctl.admit({"job": "j2", "scale": 1.0, "deadline_s": 50.0},
+                          now=1.0)
+    assert (v, reason) == ("rejected", REJECT_QUEUE_FULL)
+    # later, the queued jobs' deadlines have passed: shed oldest first,
+    # then the arrival fits
+    v, entry = ctl.admit({"job": "j3", "scale": 1.0, "deadline_s": 50.0},
+                         now=100.0)
+    assert v == "admitted" and ctl.shed_count == 2
+    assert [e["job"] for e, _ in shed_hook] == ["j0", "j1"]
+    assert all(r == SHED_PAST_DEADLINE for _, r in shed_hook)
+    sheds = cap.kinds("job_shed")
+    assert [e["job"] for e in sheds] == ["j0", "j1"]
+    assert all(validate_event(e) == [] for e in sheds)
+    assert [r[0] for r in jn.records].count("shed") == 2
+    # ordinals keep counting past shed jobs (absolute, never reused)
+    assert entry["ordinal"] == 2
+
+
+def test_admission_rejects_while_draining_and_requeue_keeps_ordinals(cap):
+    ctl, jn = _controller(cap)
+    ctl.requeue({"job": "old", "spec": {"job": "old", "scale": 1.0},
+                 "ordinal": 7, "deadline_t": 50.0, "admit_t": 0.0})
+    # replay re-enqueue: no second admitted record, numbering continues
+    assert jn.records == [] and ctl._next_ordinal == 8
+    v, entry = ctl.admit({"job": "new", "scale": 1.0, "deadline_s": 99.0},
+                         now=0.0)
+    assert v == "admitted" and entry["ordinal"] == 8
+    ctl.draining = True
+    v, reason = ctl.admit({"job": "late", "scale": 1.0,
+                           "deadline_s": 99.0}, now=0.0)
+    assert (v, reason) == ("rejected", REJECT_DRAINING)
+
+
+# ----------------------------------------------------------------------
+# @job: fault domain — absolute ordinals, replay pre-consumption
+# ----------------------------------------------------------------------
+
+def test_job_fault_domain_fires_by_absolute_ordinal(monkeypatch):
+    monkeypatch.setenv("PCG_TPU_FAULT_SLEEP_S", "0.0")
+    plan = FaultPlan("sleep@job:0,nan@job:2,exc@job:1")
+    assert plan.job_armed
+    assert plan.at_job(0) is None                  # sleep only delays
+    assert plan.at_job(2) == "nan"                 # caller poisons col
+    with pytest.raises(InjectedDispatchError, match="ordinal 1"):
+        plan.at_job(1)
+    # single-use: consumed faults never fire twice in one lifetime
+    assert plan.at_job(1) is None and plan.at_job(2) is None
+    assert [f["mode"] for f in plan.fired] == ["sleep", "nan", "exc"]
+    assert not plan.job_armed                      # all consumed
+    assert FaultPlan("").job_armed is False
+
+
+def test_job_fault_replay_consume_never_refires():
+    """A restarted daemon re-parses PCG_TPU_FAULTS into a fresh plan;
+    replay pre-consumes ordinals the journal shows already passed the
+    service boundary, so the fault fires at most once per journal."""
+    plan = FaultPlan("exc@job:3")
+    plan.replay_consume_job(3)
+    assert plan.at_job(3) is None and plan.fired == []
+
+
+def test_job_fault_spec_parse_errors():
+    with pytest.raises(ValueError):
+        FaultPlan("kill@job:0")                    # kill is not a job mode
+
+
+# ----------------------------------------------------------------------
+# schema: the new event kinds and bench detail fields
+# ----------------------------------------------------------------------
+
+def test_serve_event_kinds_are_schema_versioned():
+    cap = _Cap()
+    rec = MetricsRecorder(sinks=[cap])
+    rec.event("job_admit", job="a", ordinal=0, predicted_s=0.1,
+              deadline_s=60.0)
+    rec.event("job_reject", job="b", reason=REJECT_QUEUE_FULL)
+    rec.event("job_shed", job="c", reason=SHED_PAST_DEADLINE)
+    rec.event("job_done", job="a", ok=True, verdict="converged")
+    rec.event("job_quarantine", job="d", verdict="rhs_nonfinite")
+    rec.event("serve_drain", reason="idle")
+    assert all(validate_event(e) == [] for e in cap.events)
+    # a job_done missing its verdict is a schema error, not a pass
+    bad = dict(cap.events[3])
+    del bad["verdict"]
+    assert any("verdict" in e for e in validate_event(bad))
+
+
+def test_serve_bench_detail_fields_numeric_or_null():
+    line = {"schema": "pcg-tpu-bench/1", "metric": "serve_jobs_per_s",
+            "value": 120.0, "unit": "jobs/s", "vs_baseline": 1.4,
+            "detail": {"jobs_per_s": 120.0, "jobs_per_s_serial": 85.0,
+                       "queue_depth_max": 12, "jobs_shed": 0}}
+    assert validate_bench_line(line) == []
+    line["detail"]["jobs_per_s"] = "fast"
+    assert any("jobs_per_s" in e for e in validate_bench_line(line))
+
+
+# ----------------------------------------------------------------------
+# daemon end-to-end: fault isolation inside a packed block
+# ----------------------------------------------------------------------
+
+def test_daemon_serves_jobs_and_isolates_injected_failure(
+        tmp_path, solver, cap):
+    """Three tenants, one ``exc@job:1`` service-boundary fault: the
+    faulted job fails with a named ``injected:`` verdict, the other
+    two finish with solutions bit-identical to the unpacked single-RHS
+    reference, and the daemon drains idle."""
+    spool = str(tmp_path / "spool")
+    scales = {"t0": 1.0, "t1": 0.5, "t2": 2.0}
+    for i, (job, sc) in enumerate(sorted(scales.items())):
+        sjobs.submit(spool, {"job": job, "scale": sc}, submit_t=float(i))
+    d = ServeDaemon(solver, spool, queue_max=8, widths=(1, 2),
+                    fault_plan=FaultPlan("exc@job:1"), poll_s=0.001)
+    reason = d.run(idle_exit_s=0.0, install_signals=False)
+    assert reason == "idle"
+    assert (d.jobs_done, d.jobs_failed) == (2, 1)
+
+    results = {j: sjobs.read_result(spool, j) for j in scales}
+    assert results["t1"]["ok"] is False
+    assert results["t1"]["verdict"].startswith("injected:")
+    F = np.asarray(solver._model.F, dtype=np.float64)
+    for job in ("t0", "t2"):
+        assert results[job]["ok"] and results[job]["verdict"] == "converged"
+        ref = solver.solve_many(F * scales[job])
+        u_ref = np.asarray(solver.displacement_global_many(ref.x))[:, 0]
+        np.testing.assert_array_equal(
+            np.load(sjobs.solution_path(spool, job)), u_ref)
+
+    # every outcome evented with a named verdict + the drain stamp
+    done = {e["job"]: e for e in cap.kinds("job_done")}
+    assert set(done) == set(scales)
+    assert all(validate_event(e) == [] for e in done.values())
+    (drain,) = cap.kinds("serve_drain")
+    assert drain["reason"] == "idle" and validate_event(drain) == []
+    # exactly one terminal journal record per job
+    assert set(_terminal_counts(sjobs.journal_path(spool)).values()) == {1}
+
+
+def test_nan_poison_quarantines_alone_in_packed_block(
+        tmp_path, solver, cap):
+    """``nan@job:0`` poisons the first tenant's RHS column inside a
+    width-2 block: it quarantines ALONE (named verdict + event) and the
+    co-batched tenant converges bit-identically to its unpacked
+    reference — one tenant's poison never fails the block."""
+    spool = str(tmp_path / "spool")
+    sjobs.submit(spool, {"job": "bad", "scale": 1.0}, submit_t=0.0)
+    sjobs.submit(spool, {"job": "good", "scale": 2.0}, submit_t=1.0)
+    d = ServeDaemon(solver, spool, queue_max=8, widths=(1, 2),
+                    fault_plan=FaultPlan("nan@job:0"), poll_s=0.001)
+    d.run(idle_exit_s=0.0, install_signals=False)
+    assert (d.jobs_done, d.jobs_failed) == (1, 1)
+
+    bad = sjobs.read_result(spool, "bad")
+    assert bad["ok"] is False and bad["verdict"] == "rhs_nonfinite"
+    (q,) = cap.kinds("job_quarantine")
+    assert q["job"] == "bad" and validate_event(q) == []
+
+    good = sjobs.read_result(spool, "good")
+    assert good["ok"] and good["verdict"] == "converged"
+    F = np.asarray(solver._model.F, dtype=np.float64)
+    ref = solver.solve_many(F * 2.0)
+    u_ref = np.asarray(solver.displacement_global_many(ref.x))[:, 0]
+    np.testing.assert_array_equal(
+        np.load(sjobs.solution_path(spool, "good")), u_ref)
+
+
+def test_daemon_rejects_bad_specs_and_rhs_failures_by_name(
+        tmp_path, solver, cap):
+    """Submission-protocol garbage never crashes the daemon: an
+    unparseable file, an unknown-key spec and a wrong-length rhs all
+    fail THEIR job with a named verdict while valid tenants solve."""
+    spool = str(tmp_path / "spool")
+    sjobs.ensure_spool(spool)
+    inc = sjobs.incoming_dir(spool)
+    with open(os.path.join(inc, "torn.json"), "w") as f:
+        f.write('{"job": "to')                    # unparseable
+    sjobs.write_json_atomic(os.path.join(inc, "oddkey.json"),
+                            {"job": "oddkey", "scale": 1.0, "nice": True})
+    rhs = tmp_path / "short.npy"
+    np.save(rhs, np.ones(3))                      # wrong length for model
+    sjobs.submit(spool, {"job": "shortrhs", "rhs": str(rhs)},
+                 submit_t=0.0)
+    sjobs.submit(spool, {"job": "fine", "scale": 1.0}, submit_t=1.0)
+
+    d = ServeDaemon(solver, spool, queue_max=8, widths=(1, 2),
+                    fault_plan=FaultPlan(""), poll_s=0.001)
+    d.run(idle_exit_s=0.0, install_signals=False)
+
+    assert sjobs.read_result(spool, "torn")["verdict"].startswith(
+        "rejected: bad_spec")
+    assert "unknown key" in sjobs.read_result(spool, "oddkey")["verdict"]
+    short = sjobs.read_result(spool, "shortrhs")
+    assert short["verdict"].startswith("rhs_load_failed:")
+    assert sjobs.read_result(spool, "fine")["ok"] is True
+    assert not os.listdir(inc)                    # every file consumed
+    rejects = cap.kinds("job_reject")
+    assert {e["job"] for e in rejects} == {"torn", "oddkey"}
+    assert all(validate_event(e) == [] for e in rejects)
+
+
+# ----------------------------------------------------------------------
+# overload: shedding + named rejections at the daemon level
+# ----------------------------------------------------------------------
+
+def test_daemon_overload_sheds_with_named_verdicts(tmp_path, solver, cap):
+    """Saturate a queue_max=2 daemon, let the queued deadlines lapse,
+    and assert backpressure sheds them LOUDLY: journal record, event,
+    and a result file the submitter can read — then the infeasible-
+    deadline and draining rejections, each by name."""
+    spool = str(tmp_path / "spool")
+    t0 = 1000.0
+    sjobs.submit(spool, {"job": "q0", "scale": 1.0, "deadline_s": 0.5},
+                 submit_t=0.0)
+    sjobs.submit(spool, {"job": "q1", "scale": 1.0, "deadline_s": 0.5},
+                 submit_t=1.0)
+    d = ServeDaemon(solver, spool, queue_max=2, widths=(1,),
+                    fault_plan=FaultPlan(""), poll_s=0.001)
+    assert d.poll_once(now=t0) == 2
+
+    # the full queue + lapsed deadlines: both shed, the arrival admitted
+    sjobs.submit(spool, {"job": "q2", "scale": 1.0, "deadline_s": 500.0},
+                 submit_t=2.0)
+    assert d.poll_once(now=t0 + 50.0) == 1
+    assert d.admission.shed_count == 2
+    for job in ("q0", "q1"):
+        res = sjobs.read_result(spool, job)
+        assert res["verdict"] == f"shed: {SHED_PAST_DEADLINE}"
+    sheds = cap.kinds("job_shed")
+    assert {e["job"] for e in sheds} == {"q0", "q1"}
+    assert all(e["reason"] == SHED_PAST_DEADLINE for e in sheds)
+
+    # infeasible deadline: priced at the door (CPU cost model is live)
+    assert solver.predicted_ms_per_iter(1) is not None
+    sjobs.submit(spool, {"job": "rush", "scale": 1.0, "deadline_s": 1e-9},
+                 submit_t=3.0)
+    d.poll_once(now=t0 + 51.0)
+    assert sjobs.read_result(spool, "rush")["verdict"] == \
+        f"rejected: {REJECT_DEADLINE}"
+
+    # draining: new arrivals rejected by name, the queue still finishes
+    d.request_drain()
+    sjobs.submit(spool, {"job": "late", "scale": 1.0}, submit_t=4.0)
+    d.poll_once(now=t0 + 52.0)
+    assert sjobs.read_result(spool, "late")["verdict"] == \
+        f"rejected: {REJECT_DRAINING}"
+    reason = d.run(install_signals=False)
+    assert reason == "sigterm"
+    assert sjobs.read_result(spool, "q2")["ok"] is True
+    # the whole episode: exactly one terminal record per job, none silent
+    counts = _terminal_counts(sjobs.journal_path(spool))
+    assert set(counts) == {"q0", "q1", "q2", "rush", "late"}
+    assert set(counts.values()) == {1}
+
+
+# ----------------------------------------------------------------------
+# exactly-once: in-process crash-window replay
+# ----------------------------------------------------------------------
+
+def test_replay_completes_from_result_and_requeues_the_rest(
+        tmp_path, solver, cap):
+    """The narrowest crash window: the daemon died AFTER writing job
+    a's result file but BEFORE its terminal journal record.  Replay
+    completes `a` from the result (``replayed=true``) without
+    re-solving, re-enqueues `b` with its ORIGINAL ordinal, and drops a
+    duplicate re-submission of `a` on the floor."""
+    spool = str(tmp_path / "spool")
+    sjobs.submit(spool, {"job": "a", "scale": 1.0}, submit_t=0.0)
+    sjobs.submit(spool, {"job": "b", "scale": 2.0}, submit_t=1.0)
+    d1 = ServeDaemon(solver, spool, queue_max=8, widths=(1,),
+                     fault_plan=FaultPlan(""), poll_s=0.001)
+    d1.poll_once()
+    # simulate the kill: result written, terminal record lost
+    sjobs.write_result(spool, "a", {"ok": True, "verdict": "converged"})
+    d1.journal._fl.close()                        # no drain, no bracket end
+
+    # the duplicate re-submission a crashed client might retry
+    sjobs.submit(spool, {"job": "a", "scale": 1.0}, submit_t=2.0)
+
+    d2 = ServeDaemon(solver, spool, queue_max=8, widths=(1,),
+                     fault_plan=FaultPlan(""), poll_s=0.001)
+    # `a` completed from its surviving result — never re-queued
+    assert d2.jobs_done == 1
+    assert [e["job"] for e in d2.admission.queue] == ["b"]
+    assert d2.admission.queue[0]["ordinal"] == 1   # original ordinal kept
+    done = [e for e in cap.kinds("job_done") if e.get("replayed")]
+    assert done and done[0]["job"] == "a"
+
+    reason = d2.run(idle_exit_s=0.0, install_signals=False)
+    assert reason == "idle" and d2.jobs_done == 2
+    assert sjobs.read_result(spool, "b")["ok"] is True
+    counts = _terminal_counts(sjobs.journal_path(spool))
+    assert counts == {"a": 1, "b": 1}             # exactly once, each
+
+
+def test_replay_fails_incomplete_admitted_record_by_name(tmp_path, solver):
+    """A journal whose ``admitted`` record lost its spec (torn write)
+    cannot re-enqueue that job — replay fails it with a named verdict
+    instead of dropping it silently or crashing the daemon."""
+    spool = str(tmp_path / "spool")
+    sjobs.ensure_spool(spool)
+    j = JobJournal(sjobs.journal_path(spool))
+    j.record("admitted", "ghost")                 # no spec, no ordinal
+    j._fl.close()
+    d = ServeDaemon(solver, spool, queue_max=4, widths=(1,),
+                    fault_plan=FaultPlan(""), poll_s=0.001)
+    assert d.jobs_failed == 1 and d.admission.queue == []
+    res = sjobs.read_result(spool, "ghost")
+    assert res["verdict"].startswith("replay_unrecoverable")
+
+
+# ----------------------------------------------------------------------
+# chaos: SIGKILL the real daemon mid-block, restart, exactly once
+# ----------------------------------------------------------------------
+
+def test_sigkill_mid_block_restart_is_exactly_once(tmp_path, solver):
+    """The acceptance chaos leg: a real ``pcg-tpu serve`` process is
+    SIGKILLed inside a packed block (held open by ``sleep@job:0``), a
+    fresh daemon generation restarts over the same spool, and every
+    job finishes EXACTLY once — original ordinals, no re-fired fault,
+    solutions matching the unpacked reference."""
+    spool = str(tmp_path / "spool")
+    sjobs.submit(spool, {"job": "k0", "scale": 1.0}, submit_t=0.0)
+    sjobs.submit(spool, {"job": "k1", "scale": 2.0}, submit_t=1.0)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PCG_TPU_FAULTS"] = "sleep@job:0"         # holds the block open
+    env["PCG_TPU_FAULT_SLEEP_S"] = "600"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pcg_mpi_solver_tpu.cli", "serve",
+         "--spool", spool, "--synthetic", "4,3,3", "--widths", "1,2",
+         "--poll-s", "0.01", "--n-parts", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    journal_file = sjobs.journal_path(spool)
+    try:
+        deadline = time.monotonic() + 240.0
+        packed = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("serve daemon exited before packing: "
+                            + (proc.communicate()[0] or "")[-2000:])
+            if os.path.exists(journal_file):
+                events, _ = read_journal(journal_file)
+                if any(ev.get("op") == "packed" for ev in events):
+                    packed = True
+                    break
+            time.sleep(0.2)
+        assert packed, "daemon never journaled a packed block"
+        os.kill(proc.pid, signal.SIGKILL)         # the chaos
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # the kill left both jobs non-terminal and no results behind
+    events, _ = read_journal(journal_file)
+    assert not any(ev.get("op") in TERMINAL_OPS for ev in events)
+    assert not any(ev.get("op") == "drain" for ev in events)
+    assert sjobs.read_result(spool, "k0") is None
+
+    # generation 2: same spool, same fault spec re-parsed — replay must
+    # not re-fire ordinal 0's consumed... the journal shows it was never
+    # dispatched, so the sleep WOULD re-fire; the restarted operator
+    # runs with sleep_s=0 instead, proving restart liveness regardless
+    os.environ["PCG_TPU_FAULT_SLEEP_S"] = "0.0"
+    try:
+        plan2 = FaultPlan("sleep@job:0")
+    finally:
+        os.environ.pop("PCG_TPU_FAULT_SLEEP_S", None)
+    d2 = ServeDaemon(solver, spool, queue_max=8, widths=(1, 2),
+                     fault_plan=plan2, poll_s=0.001)
+    # replay re-enqueued both with their ORIGINAL ordinals
+    assert [e["ordinal"] for e in d2.admission.queue] == [0, 1]
+    reason = d2.run(idle_exit_s=0.0, install_signals=False)
+    assert reason == "idle" and d2.jobs_done == 2 and d2.jobs_failed == 0
+
+    F = np.asarray(solver._model.F, dtype=np.float64)
+    for job, sc in (("k0", 1.0), ("k1", 2.0)):
+        res = sjobs.read_result(spool, job)
+        assert res["ok"] and res["verdict"] == "converged"
+        ref = solver.solve_many(F * sc)
+        u_ref = np.asarray(solver.displacement_global_many(ref.x))[:, 0]
+        np.testing.assert_array_equal(
+            np.load(sjobs.solution_path(spool, job)), u_ref)
+    counts = _terminal_counts(journal_file)
+    assert counts == {"k0": 1, "k1": 1}           # the exactly-once audit
+
+
+# ----------------------------------------------------------------------
+# watch: the serve journal is a first-class watch target
+# ----------------------------------------------------------------------
+
+def test_watch_folds_serve_journal_and_drain_means_done(tmp_path):
+    from pcg_mpi_solver_tpu.obs.watch import format_watch, watch_snapshot
+
+    path = str(tmp_path / "journal.jsonl")
+    j = JobJournal(path)
+    j.record("admitted", "a", spec={"job": "a", "scale": 1.0},
+             ordinal=0, deadline_t=9.0)
+    j.record("admitted", "b", spec={"job": "b", "scale": 2.0},
+             ordinal=1, deadline_t=9.0)
+    j.record("packed", None, block=0, jobs=["a", "b"], width=2)
+    j.record("done", "a", verdict="converged", block=0)
+
+    snap = watch_snapshot(path)
+    srv = snap["serve"]
+    assert srv["jobs"] == {"admitted": 2, "packed": 1, "done": 1}
+    assert srv["in_flight"] == ["b"]               # a finished, b did not
+    assert not srv["drained"]
+    text = format_watch(snap)
+    assert "serve jobs:" in text and "in-flight jobs: b" in text
+
+    j.record("done", "b", verdict="converged", block=0)
+    j.drain("idle", jobs_done=2)
+    j.close()
+    snap2 = watch_snapshot(path)
+    # a gracefully drained journal is DONE — never a stall alarm
+    assert snap2["serve"]["drained"] and snap2["status"] == "done"
+    assert "serve drained (idle)" in format_watch(snap2)
+
+
+def test_watch_ignores_non_serve_streams(tmp_path):
+    from pcg_mpi_solver_tpu.obs.watch import watch_snapshot
+
+    path = str(tmp_path / "run.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "step", "t": 0.0, "iter": 3,
+                            "relres": 1e-3}) + "\n")
+    assert watch_snapshot(path)["serve"] is None

@@ -114,6 +114,43 @@ print("blocked-resilience smoke OK: poisoned column recovered "
 """
 
 
+# Solve-service smoke (priority preset step 0.7, ISSUE 19): a tiny
+# daemon over a temp spool serves 3 submitted jobs, one with an
+# injected service-boundary fault (`exc@job:1`).  Asserts 2 done + 1
+# failed WITH the named verdict, and that every job got a result file —
+# the admission/journal/dispatch loop proven live in seconds, on CPU
+# (the service layer is accelerator-agnostic; the flagship legs own the
+# device grant).
+_SERVE_SMOKE = """
+import tempfile
+from pcg_mpi_solver_tpu.config import RunConfig, SolverConfig
+from pcg_mpi_solver_tpu.models.synthetic import make_cube_model
+from pcg_mpi_solver_tpu.resilience import FaultPlan
+from pcg_mpi_solver_tpu.serve import jobs as sjobs
+from pcg_mpi_solver_tpu.serve.daemon import ServeDaemon
+from pcg_mpi_solver_tpu.solver.driver import Solver
+
+m = make_cube_model(6, 5, 5, heterogeneous=True)
+s = Solver(m, RunConfig(solver=SolverConfig(tol=1e-8, max_iter=2000)),
+           backend="general")
+spool = tempfile.mkdtemp(prefix="pcg_serve_smoke_")
+ids = [sjobs.submit(spool, {"scale": sc, "deadline_s": 3600.0},
+                    submit_t=float(i))
+       for i, sc in enumerate([1.0, 0.5, 2.0])]
+d = ServeDaemon(s, spool, queue_max=8, widths=(1, 2, 4),
+                fault_plan=FaultPlan("exc@job:1", recorder=s.recorder))
+reason = d.run(idle_exit_s=0.0, install_signals=False)
+results = [sjobs.read_result(spool, j) for j in ids]
+assert all(r is not None for r in results), results
+n_ok = sum(r["ok"] for r in results)
+failed = [r for r in results if not r["ok"]]
+assert n_ok == 2 and len(failed) == 1, results
+assert failed[0]["verdict"].startswith("injected:"), failed
+print("serve smoke OK: 2 done + 1 failed with named verdict "
+      f"({failed[0]['verdict']!r}), drain={reason}")
+"""
+
+
 def log_line(path, msg):
     stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%d %H:%M:%SZ")
@@ -432,6 +469,15 @@ def run_priority_queue(path, quick: bool):
               "tests/test_distributed_ft.py::"
               "test_dead_peer_named_and_resume_scalar"],
              env_extra={"JAX_PLATFORMS": "cpu"}, timeout=1200, gate_s=0)
+    # Step 0.7: solve-service smoke (ISSUE 19) — a tiny serve daemon
+    # over a temp spool: 3 submitted jobs, one injected service-
+    # boundary fault (`exc@job:1`), asserting 2 done + 1 failed with
+    # the NAMED verdict and a result file for every job.  CPU-only
+    # (the service layer is accelerator-agnostic; never touches the
+    # grant) and before the ladder — a broken admission/journal/
+    # dispatch loop fails the window in seconds.
+    run_step(path, "serve smoke", ["-c", _SERVE_SMOKE],
+             env_extra={"JAX_PLATFORMS": "cpu"}, timeout=900, gate_s=0)
     # BENCH_NX exported unconditionally so the flagship size is pinned
     # HERE, not silently inherited from bench.py's default
     cache = {"BENCH_CACHE_DIR": os.path.join(REPO, ".pcg_cache")}
